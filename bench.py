@@ -1,0 +1,71 @@
+"""Headline benchmark: fused-ABFT huge kernel at M=N=K=4096 on real TPU.
+
+Prints ONE JSON line:
+  metric      abft_kernel_huge GFLOPS at 4096 with reference-like injection
+  vs_baseline ratio vs the reference's abft_kernel_huge on sm_80
+              (4005 GFLOPS, reference README.md:53 / BASELINE.md)
+
+Also embeds context fields: XLA f32 dot GFLOPS on the same chip and the
+fraction of it we reach (north-star target >= 0.80, BASELINE.json), the
+plain (non-FT) kernel GFLOPS, and the fused-ABFT overhead.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import jax
+
+sys.path.insert(0, ".")
+
+from ft_sgemm_tpu import InjectionSpec, SHAPES, make_ft_sgemm, make_sgemm  # noqa: E402
+from ft_sgemm_tpu.ops.reference import sgemm_reference  # noqa: E402
+from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
+from ft_sgemm_tpu.utils.timing import bench_seconds_per_call  # noqa: E402
+
+SIZE = 4096
+REFERENCE_ABFT_HUGE_GFLOPS = 4005.0  # sm_80, reference README.md:53
+
+
+def time_chained(fn, a, b, c):
+    return bench_seconds_per_call(fn, a, b, c, min_device_time=2.0)
+
+
+def main():
+    rng = np.random.default_rng(10)
+    a = jax.device_put(generate_random_matrix(SIZE, SIZE, rng=rng))
+    b = jax.device_put(generate_random_matrix(SIZE, SIZE, rng=rng))
+    c = jax.device_put(generate_random_matrix(SIZE, SIZE, rng=rng))
+    flop = 2.0 * SIZE**3
+
+    xla = lambda a, b, x: sgemm_reference(a, b, x, 1.0, -1.5)  # noqa: E731
+    xla_gflops = flop / 1e9 / time_chained(xla, a, b, c)
+
+    plain = make_sgemm("huge", alpha=1.0, beta=-1.5)
+    plain_gflops = flop / 1e9 / time_chained(plain, a, b, c)
+
+    inj = InjectionSpec.reference_like(SIZE, SHAPES["huge"].bk)
+    ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5)
+    ft_fn = lambda a, b, x: ft(a, b, x, inj).c  # noqa: E731
+    ft_gflops = flop / 1e9 / time_chained(ft_fn, a, b, c)
+
+    print(json.dumps({
+        "metric": "abft_kernel_huge_gflops_4096",
+        "value": round(ft_gflops, 1),
+        "unit": "GFLOPS",
+        "vs_baseline": round(ft_gflops / REFERENCE_ABFT_HUGE_GFLOPS, 3),
+        "context": {
+            "xla_dot_gflops": round(xla_gflops, 1),
+            "kernel_sgemm_huge_gflops": round(plain_gflops, 1),
+            "ft_vs_xla": round(ft_gflops / xla_gflops, 3),
+            "abft_overhead": round(1.0 - ft_gflops / plain_gflops, 3),
+            "backend": jax.default_backend(),
+            "injected_faults_per_tile": inj.expected_faults(
+                SIZE, SHAPES["huge"].bk),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""ft_sgemm_tpu — TPU-native fault-tolerant SGEMM framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+shixun404/Fault-Tolerant-SGEMM-on-NVIDIA-GPUs (arXiv:2305.01024):
+
+- a parameterized Pallas MXU kernel family (6 named shapes) computing
+  ``C = alpha * A @ B.T + beta * C`` (reference: generated CUDA kernels in
+  ``kernel/ft_sgemm/include_code_gen/``),
+- a fused online-ABFT variant that encodes row/column checksums inside the
+  matmul pipeline, detects silent data corruption against a threshold, and
+  corrects the corrupted accumulator entries in the same kernel
+  (reference: ``include_code_gen/ft_sgemm_*.cuh``),
+- a two-pass (non-fused) ABFT baseline built from plain XLA ops
+  (reference: ``kernel/ft_sgemm/include/baseline_ft_sgemm.cuh``),
+- first-class, parameterized fault injection (the reference hardcodes
+  injection constants into the generated kernels, ``code_gen.py:333-337``),
+- an argv-compatible CLI driver + GFLOPS bench harness
+  (reference: ``kernel/ft_sgemm/sgemm.cu``; see ``ft_sgemm_tpu.cli``).
+
+Nothing here is a translation of the CUDA sources: block/warp/thread tiling
+becomes Pallas grid/BlockSpec tiling onto the 128x128 MXU, warp shuffles
+become tile-axis reductions, shared-memory double buffering becomes Mosaic's
+automatically pipelined VMEM blocks.
+"""
+
+from ft_sgemm_tpu import utils
+from ft_sgemm_tpu.configs import (
+    KernelShape,
+    SHAPES,
+    KERNEL_TABLE,
+    kernel_for_id,
+)
+from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.reference import sgemm_reference
+from ft_sgemm_tpu.ops.sgemm import make_sgemm, sgemm
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm, ft_sgemm
+from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "KernelShape",
+    "SHAPES",
+    "KERNEL_TABLE",
+    "kernel_for_id",
+    "InjectionSpec",
+    "sgemm_reference",
+    "make_sgemm",
+    "sgemm",
+    "make_ft_sgemm",
+    "ft_sgemm",
+    "abft_baseline_sgemm",
+]

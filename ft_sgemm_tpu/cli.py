@@ -1,0 +1,170 @@
+"""``ft_sgemm`` CLI driver — argv-compatible with the reference binary.
+
+Reference contract (``kernel/ft_sgemm/sgemm.cu:12-19``, ``README.md:12-17``):
+
+    ./ft_sgemm START_SIZE END_SIZE GAP_SIZE ST_KERNEL END_KERNEL
+
+Two passes, like ``main()`` there:
+
+  1. **Verification** at END_SIZE: every kernel id in [ST_KERNEL, END_KERNEL]
+     is checked against the vendor GEMM (cuBLAS there, XLA dot here) under
+     the ``utils.cu:61`` tolerance. FT kernels run with reference-like fault
+     injection ON — passing the diff proves detect+correct, exactly the
+     reference's implicit self-test (``sgemm.cu:222-227``).
+  2. **Performance**: a GFLOPS table over sizes START..END step GAP, one row
+     per kernel id in the 14-row table (``sgemm.cu:235-237``), 5 timed reps
+     (``num_tests``), alpha=1, beta=-1.5, GFLOPS = 2*reps*M*N*K/t
+     (``sgemm.cu:21-24,234,431-434``).
+
+Timing protocol is adapted to the device boundary: the rep loop runs inside
+one jitted computation with a dynamic trip count, chained data-dependently
+(C feeds back), reps auto-scaled until device time dominates, with the fixed
+dispatch overhead measured by a zero-rep run and subtracted (see
+``utils.timing.bench_seconds_per_call`` — the reference's cudaEvent bracket
+has no tunnel overhead to cancel).
+
+Usage:
+    python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
+        [--mintime=SECONDS] [--no-verify] [--no-perf]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.configs import KERNEL_TABLE, PERF_ROW_IDS, kernel_for_id
+from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+from ft_sgemm_tpu.ops.reference import sgemm_reference
+from ft_sgemm_tpu.ops.sgemm import make_sgemm
+from ft_sgemm_tpu.utils.matrices import generate_random_matrix, verify_matrix
+from ft_sgemm_tpu.utils.timing import bench_seconds_per_call
+
+ALPHA = 1.0   # sgemm.cu:22
+BETA = -1.5   # sgemm.cu:24,234
+
+
+def _build_callable(kernel_id: int, size: int, inject_ft: bool):
+    """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
+    name, shape, is_abft = kernel_for_id(kernel_id)
+    if kernel_id == 0:
+        return lambda a, b, c: sgemm_reference(a, b, c, ALPHA, BETA)
+    if kernel_id == 10:
+        return lambda a, b, c: abft_baseline_sgemm(a, b, c, ALPHA, BETA).c
+    if not is_abft:
+        return make_sgemm(shape, alpha=ALPHA, beta=BETA)
+    inj = (InjectionSpec.reference_like(size, shape.bk)
+           if inject_ft else InjectionSpec.none())
+    ft = make_ft_sgemm(shape, alpha=ALPHA, beta=BETA)
+    return lambda a, b, c: ft(a, b, c, inj).c
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=2)
+def _host_inputs(size: int):
+    """Host-side A/B/C for one sweep size (regenerating ~O(n^2) RNG draws
+    for each of the 14 kernel rows would dominate large sweeps)."""
+    rng = np.random.default_rng(10)
+    return (
+        generate_random_matrix(size, size, rng=rng),
+        generate_random_matrix(size, size, rng=rng),
+        generate_random_matrix(size, size, rng=rng),
+    )
+
+
+def run_verification(end_size: int, st_kernel: int, end_kernel: int,
+                     out=sys.stdout) -> bool:
+    """Pass 1: diff every selected kernel against the XLA oracle."""
+    rng = np.random.default_rng(10)  # srand(10), sgemm.cu:12
+    a = generate_random_matrix(end_size, end_size, rng=rng)
+    b = generate_random_matrix(end_size, end_size, rng=rng)
+    c = np.zeros((end_size, end_size), np.float32)  # fill_vector(C,0)
+
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    all_ok = True
+    for kernel_id in sorted(KERNEL_TABLE):
+        if kernel_id < st_kernel or kernel_id > end_kernel:
+            continue
+        name, _, _ = kernel_for_id(kernel_id)
+        fn = _build_callable(kernel_id, end_size, inject_ft=True)
+        got = np.asarray(fn(a, b, c))
+        ok, nbad, first = verify_matrix(want, got, verbose=False)
+        status = "pass" if ok else f"FAIL ({nbad} bad, first at {first})"
+        print(f"Verification of kernel {kernel_id:2d} ({name:20s}): {status}",
+              file=out)
+        all_ok &= ok
+    return all_ok
+
+
+def run_perf_table(start_size: int, end_size: int, gap_size: int,
+                   st_kernel: int, end_kernel: int,
+                   min_device_time: float = 1.0, out=sys.stdout) -> dict:
+    """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439)."""
+    sizes = list(range(start_size, end_size + 1, gap_size))
+    print("################## Performance (GFLOPS) ########################",
+          file=out)
+    print("Matrix Size         |" + "".join(f"{s:8d}|" for s in sizes),
+          file=out)
+
+    results = {}
+    for kernel_id in PERF_ROW_IDS:
+        if kernel_id < st_kernel:
+            continue
+        if kernel_id > end_kernel:
+            break
+        name, _, _ = kernel_for_id(kernel_id)
+        row = []
+        print(f"{name:<20s}|", end="", file=out, flush=True)
+        for size in sizes:
+            ah, bh, ch = _host_inputs(size)
+            a, b, c = map(jax.device_put, (ah, bh, ch))
+            fn = _build_callable(kernel_id, size, inject_ft=True)
+            sec_per_rep = bench_seconds_per_call(
+                fn, a, b, c, min_device_time=min_device_time)
+            gf = 2.0 * size**3 / 1e9 / sec_per_rep
+            row.append(gf)
+            print(f"{gf:8.0f}|", end="", file=out, flush=True)
+        print(file=out)
+        results[name] = dict(zip(sizes, row))
+    return results
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    flags = {a for a in argv[1:] if a.startswith("--")}
+    if len(args) < 5:
+        print(__doc__)
+        return 2
+    try:
+        start_size, end_size, gap_size, st_kernel, end_kernel = map(int, args[:5])
+    except ValueError:
+        print(f"ft_sgemm: arguments must be integers, got {args[:5]}",
+              file=sys.stderr)
+        print(__doc__)
+        return 2
+    min_device_time = 1.0
+    for f in flags:
+        if f.startswith("--mintime="):
+            min_device_time = float(f.split("=", 1)[1])
+
+    ok = True
+    if "--no-verify" not in flags:
+        ok = run_verification(end_size, st_kernel, end_kernel)
+    if "--no-perf" not in flags:
+        run_perf_table(start_size, end_size, gap_size, st_kernel, end_kernel,
+                       min_device_time=min_device_time)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Kernel shape configuration family.
+
+The reference drives a string-templating code generator with a 7-parameter
+tile description ``[ms, ns, ks, mw, nw, mr, nr]`` (block tile, warp tile,
+thread tile — ``code_gen/main.py:8-16``, ``code_gen/code_gen.py:5-30``) and
+instantiates 6 named shapes x {plain, fused-ABFT}.
+
+On TPU there is no warp/thread level: the MXU consumes whole 128x128 tiles
+and the unit of scheduling is the Pallas grid step. The family therefore
+collapses to a 3-parameter block tile ``(bm, bn, bk)`` per named shape,
+chosen to be legal and efficient on the MXU (f32 min tile 8x128; lane dim
+128). The reference's 7 parameters are recorded verbatim for provenance in
+``ref_params``. Where the reference shape is sub-MXU (e.g. ``small`` is a
+16x16 block) the TPU tile is the nearest MXU-friendly shape and the
+perf-characteristic (small vs large blocks, tall vs wide aspect) is kept,
+not the literal numbers — see SURVEY.md §7 "Hard parts".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelShape:
+    """A named block-tiling configuration for the SGEMM kernel family.
+
+    Attributes:
+      name: shape family name (reference ``main.py:8-16`` table key).
+      bm, bn, bk: Pallas block tile (rows of C, cols of C, K-depth per
+        grid step). All multiples of 128 so f32 tiles map onto the MXU.
+      ref_params: the reference's ``[ms, ns, ks, mw, nw, mr, nr]`` for
+        this name, for provenance/docs only.
+    """
+
+    name: str
+    bm: int
+    bn: int
+    bk: int
+    ref_params: Tuple[int, int, int, int, int, int, int]
+
+    def __post_init__(self):
+        for field in ("bm", "bn", "bk"):
+            v = getattr(self, field)
+            if v % 128 != 0 or v <= 0:
+                raise ValueError(
+                    f"KernelShape.{field}={v} must be a positive multiple of"
+                    " 128 (f32 MXU tiling)"
+                )
+
+    @property
+    def block(self) -> Tuple[int, int, int]:
+        return (self.bm, self.bn, self.bk)
+
+
+# The 6 shipped shapes (+ the reference's unused "test" shape), mirroring the
+# canonical table at reference code_gen/main.py:8-16. TPU tile choices:
+#   - "small"/"medium": minimum legal MXU tiles, differing in K depth —
+#     preserves the small-block / shallow-K character.
+#   - "large": 256x256 blocks.
+#   - "tall"/"wide": 4:1 / 1:4 aspect blocks (reference: 128x32 / 32x128).
+#   - "huge": the flagship big-block kernel (reference: 128x128x8,
+#     README.md:46 — beats cuBLAS; ours targets XLA's native dot).
+SHAPES = {
+    "small": KernelShape("small", 128, 128, 128, (16, 16, 16, 8, 16, 2, 2)),
+    "medium": KernelShape("medium", 128, 128, 256, (32, 32, 8, 16, 32, 4, 4)),
+    "large": KernelShape("large", 256, 256, 256, (64, 64, 8, 32, 64, 8, 8)),
+    "tall": KernelShape("tall", 512, 128, 256, (128, 32, 8, 64, 16, 8, 4)),
+    "wide": KernelShape("wide", 128, 512, 256, (32, 128, 8, 16, 64, 4, 8)),
+    "huge": KernelShape("huge", 512, 512, 256, (128, 128, 8, 32, 64, 8, 8)),
+    "test": KernelShape("test", 128, 128, 128, (64, 64, 8, 16, 32, 4, 4)),
+}
+
+SHAPE_ORDER = ("small", "medium", "large", "tall", "wide", "huge")
+
+# Kernel-id table, matching the driver's dispatch ladder and perf-table rows
+# (reference sgemm.cu:105-199 and sgemm.cu:235-237). Id 0 is the vendor
+# library (cuBLAS there, XLA's native dot here); ids 1-6 the plain shapes;
+# id 10 the non-fused two-pass ABFT baseline; ids 11-16 the fused-ABFT
+# shapes. Ids 7-9 are unused, as in the reference.
+KERNEL_TABLE = {
+    0: ("xla_dot", None, False),
+    1: ("kernel_sgemm_small", "small", False),
+    2: ("kernel_sgemm_medium", "medium", False),
+    3: ("kernel_sgemm_large", "large", False),
+    4: ("kernel_sgemm_tall", "tall", False),
+    5: ("kernel_sgemm_wide", "wide", False),
+    6: ("kernel_sgemm_huge", "huge", False),
+    10: ("abft_baseline", None, True),
+    11: ("abft_kernel_small", "small", True),
+    12: ("abft_kernel_medium", "medium", True),
+    13: ("abft_kernel_large", "large", True),
+    14: ("abft_kernel_tall", "tall", True),
+    15: ("abft_kernel_wide", "wide", True),
+    16: ("abft_kernel_huge", "huge", True),
+}
+
+PERF_ROW_IDS = (0, 1, 2, 3, 4, 5, 6, 10, 11, 12, 13, 14, 15, 16)
+
+
+def kernel_for_id(kernel_id: int) -> Tuple[str, Optional[KernelShape], bool]:
+    """Resolve a kernel id to (display name, shape or None, is_abft)."""
+    if kernel_id not in KERNEL_TABLE:
+        raise KeyError(f"unknown kernel id {kernel_id}")
+    name, shape_name, is_abft = KERNEL_TABLE[kernel_id]
+    shape = SHAPES[shape_name] if shape_name is not None else None
+    return name, shape, is_abft

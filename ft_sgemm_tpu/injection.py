@@ -1,0 +1,106 @@
+"""First-class fault-injection specification.
+
+The reference bakes injection into the generated kernels as compile-time
+constants: every ``K/20`` outer iterations, one rotating thread adds
+``error_inject = 10000.0`` to its first accumulator element, with detection
+threshold ``err_bound1 = 9500.0`` (``include_code_gen/ft_sgemm_huge.cuh:49-51,
+324-327``; template ``code_gen.py:333-337``). Injection cannot be turned off
+without regenerating and recompiling.
+
+Here injection is a runtime parameter: an :class:`InjectionSpec` is lowered
+into the Pallas kernel through scalar operands (SMEM), so the same compiled
+kernel can run clean, or inject any count/magnitude/placement of faults. The
+default spec reproduces the reference's schedule: ~20 faults per run, spread
+across K, magnitude 1e4, rotating target element.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# Reference constants (include_code_gen/ft_sgemm_huge.cuh:49-51).
+REFERENCE_MAGNITUDE = 10000.0
+REFERENCE_THRESHOLD = 9500.0
+REFERENCE_NUM_FAULTS = 20
+
+
+@dataclasses.dataclass(frozen=True)
+class InjectionSpec:
+    """Runtime description of accumulator-fault injection.
+
+    Faults model silent data corruption in the f32 accumulator: at K-step
+    ``k`` (a Pallas grid step along the contraction axis), if
+    ``enabled and k % every == 0``, ``magnitude`` is added to one element of
+    the accumulator tile. The element rotates with ``k // every`` (and with
+    the output-tile coordinates) so successive faults land on different
+    rows/columns, mirroring the reference's rotating ``tx`` target
+    (``include_code_gen/ft_sgemm_huge.cuh:324-327``).
+
+    ``enabled=False`` compiles to a no-op branch — the clean path the
+    reference lacks.
+    """
+
+    enabled: bool = False
+    every: int = 1  # inject at every k-step where k % every == 0
+    magnitude: float = REFERENCE_MAGNITUDE
+
+    def __post_init__(self):
+        if self.every < 1:
+            raise ValueError(f"InjectionSpec.every={self.every} must be >= 1")
+        if not np.isfinite(np.float32(self.magnitude)):
+            raise ValueError(
+                f"InjectionSpec.magnitude={self.magnitude} not finite in f32"
+            )
+
+    @staticmethod
+    def none() -> "InjectionSpec":
+        return InjectionSpec(enabled=False)
+
+    @staticmethod
+    def reference_like(K: int, bk: int, num_faults: int = REFERENCE_NUM_FAULTS,
+                       magnitude: float = REFERENCE_MAGNITUDE) -> "InjectionSpec":
+        """Schedule ~num_faults faults across the K-grid of a (K, bk) run,
+        like the reference's ``(k % (K/20)) == 0`` cadence
+        (``code_gen.py:333``)."""
+        num_k_steps = _num_k_steps(K, bk)
+        every = max(1, num_k_steps // num_faults)
+        return InjectionSpec(enabled=True, every=every, magnitude=magnitude)
+
+    def as_operand(self) -> np.ndarray:
+        """Pack into the (3,) f32 scalar operand consumed by the kernels:
+        [enabled, every, magnitude]."""
+        return np.asarray(
+            [1.0 if self.enabled else 0.0, float(self.every), float(self.magnitude)],
+            dtype=np.float32,
+        )
+
+    def expected_faults(self, K: int, bk: int) -> int:
+        """Number of faults this spec injects over a full K sweep.
+
+        Counts over the zero-padded K grid the kernels actually run
+        (K rounded up to a multiple of bk)."""
+        if not self.enabled:
+            return 0
+        num_k_steps = _num_k_steps(K, bk)
+        return len([k for k in range(num_k_steps) if k % self.every == 0])
+
+
+def _num_k_steps(K: int, bk: int) -> int:
+    """K-grid length after the kernels' zero padding: ceil(K / bk)."""
+    return max(1, -(-K // bk))
+
+
+# Threshold note: REFERENCE_THRESHOLD (9500) pairs with the reference's
+# 10000-magnitude faults (``ft_sgemm_huge.cuh:50``); inputs quantized to
+# ±{0,.1,...,.9} (``utils.cu:23-31``) keep f32 checksum noise orders of
+# magnitude below it even at K=6144.
+
+__all__ = [
+    "InjectionSpec",
+    "REFERENCE_MAGNITUDE",
+    "REFERENCE_THRESHOLD",
+    "REFERENCE_NUM_FAULTS",
+]

@@ -1,0 +1,1 @@
+"""Compute ops: reference oracle, Pallas SGEMM family, fused ABFT, two-pass baseline."""

@@ -1,0 +1,140 @@
+"""Non-fused (two-pass) ABFT baseline, built from plain XLA ops.
+
+Re-design of the reference's cuBLAS-composed baseline
+(``kernel/ft_sgemm/include/baseline_ft_sgemm.cuh:1-33``): per 256-wide
+K-panel it (1) applies the panel's partial product to C, then (2) makes a
+*second pass* over C to recompute its row/column sums and compares them with
+checksums derived from the panel inputs. The second pass over the full C is
+exactly why this loses to the fused kernels — each panel re-reads the M x N
+output from HBM (reference: 6 ``cublasSgemv`` + ``cublasSaxpy``/``Sdot``
+calls with device syncs between them, ``baseline_ft_sgemm.cuh:7-31``).
+
+Detection-only, like the reference baseline: it reports residuals and a
+detected flag, it does not correct.
+
+Fault injection is supported as a first-class parameter (the fused kernels
+and this baseline share the same :class:`InjectionSpec` surface): a fault of
+``magnitude`` is added to one rotating element of C after the panel update
+and before the checksum re-read — the silent-data-corruption window this
+scheme is built to catch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+
+PANEL_K = 256  # reference K-panel width, baseline_ft_sgemm.cuh:4
+
+
+class AbftBaselineResult(NamedTuple):
+    c: jax.Array            # (M, N) output, alpha*A@B.T + beta*C
+    max_row_residual: jax.Array  # scalar f32: max |expected-computed| row sum
+    max_col_residual: jax.Array  # scalar f32
+    detected: jax.Array     # bool: any residual above threshold
+
+
+def abft_baseline_sgemm(
+    a,
+    b,
+    c,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    *,
+    inject: InjectionSpec | None = None,
+    panel_k: int = PANEL_K,
+    threshold: float = REFERENCE_THRESHOLD,
+    precision: str = "highest",
+) -> AbftBaselineResult:
+    """Two-pass checksum-verified ``C = alpha*A@B.T + beta*C``.
+
+    Args:
+      a: (M, K) f32. b: (N, K) f32. c: (M, N) f32.
+      inject: optional fault injection between pass 1 and pass 2 of each
+        scheduled panel (``panel % every == 0``).
+      panel_k: K-panel width (reference: 256). K is padded up to a multiple.
+    """
+    inject = inject or InjectionSpec.none()
+    return _abft_baseline_jit(
+        a, b, c, alpha=alpha, beta=beta, panel_k=panel_k, threshold=threshold,
+        precision=precision, inj_enabled=inject.enabled,
+        inj_every=inject.every, inj_magnitude=inject.magnitude,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "alpha", "beta", "panel_k", "threshold", "precision",
+        "inj_enabled", "inj_every", "inj_magnitude",
+    ),
+)
+def _abft_baseline_jit(
+    a, b, c, *, alpha, beta, panel_k, threshold, precision,
+    inj_enabled, inj_every, inj_magnitude,
+) -> AbftBaselineResult:
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    c = c.astype(jnp.float32)
+    m, k = a.shape
+    n, kb = b.shape
+    assert k == kb, (a.shape, b.shape)
+    prec = jax.lax.Precision(precision)
+
+    pad = (-k) % panel_k
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad)))
+        b = jnp.pad(b, ((0, 0), (0, pad)))
+    num_panels = (k + pad) // panel_k
+
+    # (P, M, panel) / (P, N, panel) panel stacks for scan.
+    a_p = a.reshape(m, num_panels, panel_k).transpose(1, 0, 2)
+    b_p = b.reshape(n, num_panels, panel_k).transpose(1, 0, 2)
+
+    c0 = beta * c
+    # Expected running sums start at the sums of beta*C (the baseline checks
+    # full-C checksums after every panel update).
+    r_exp0 = jnp.sum(c0, axis=1)  # (M,)
+    c_exp0 = jnp.sum(c0, axis=0)  # (N,)
+
+    def body(carry, ab):
+        c_acc, r_exp, c_exp, max_r, max_c = carry
+        p, ap, bp = ab
+        # Pass 1: panel partial product applied to C.
+        c_acc = c_acc + alpha * jnp.dot(
+            ap, bp.T, preferred_element_type=jnp.float32, precision=prec
+        )
+        if inj_enabled:
+            # SDC between the GEMM pass and the checksum pass: one rotating
+            # element of C is corrupted before pass 2 re-reads it.
+            do = (p % max(1, inj_every)) == 0
+            i0 = (p * 131 + 7) % m
+            j0 = (p * 61 + 3) % n
+            rows = jax.lax.broadcasted_iota(jnp.int32, (m, n), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (m, n), 1)
+            hit = (rows == i0) & (cols == j0) & do
+            c_acc = c_acc + jnp.where(hit, jnp.float32(inj_magnitude), 0.0)
+        # Input-side checksum update (cheap matvecs; reference's
+        # cublasSgemv over colsum(A_panel)/rowsum(B_panel)).
+        r_exp = r_exp + alpha * jnp.dot(ap, jnp.sum(bp, axis=0), precision=prec)
+        c_exp = c_exp + alpha * jnp.dot(bp, jnp.sum(ap, axis=0), precision=prec)
+        # Pass 2: full re-read of C to recompute its checksums (this is the
+        # non-fused cost the fused kernels eliminate).
+        res_r = r_exp - jnp.sum(c_acc, axis=1)
+        res_c = c_exp - jnp.sum(c_acc, axis=0)
+        max_r = jnp.maximum(max_r, jnp.max(jnp.abs(res_r)))
+        max_c = jnp.maximum(max_c, jnp.max(jnp.abs(res_c)))
+        return (c_acc, r_exp, c_exp, max_r, max_c), None
+
+    (c_out, _, _, max_r, max_c), _ = jax.lax.scan(
+        body,
+        (c0, r_exp0, c_exp0, jnp.float32(0), jnp.float32(0)),
+        (jnp.arange(num_panels, dtype=jnp.int32), a_p, b_p),
+    )
+    detected = (max_r > threshold) | (max_c > threshold)
+    return AbftBaselineResult(c_out, max_r, max_c, detected)

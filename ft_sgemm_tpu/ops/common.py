@@ -1,0 +1,30 @@
+"""Shared helpers for the Pallas kernel wrappers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def should_interpret(interpret: Optional[bool]) -> bool:
+    """Pallas interpret mode: explicit wins; otherwise interpret unless a
+    real TPU backend is active (tests/CI run on CPU, SURVEY.md §4)."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def pad_to(x: jax.Array, row_mult: int, col_mult: int) -> jax.Array:
+    """Zero-pad a 2-D array up to multiples of (row_mult, col_mult).
+
+    Zero padding is exact for GEMM and for checksum math: padded rows/cols
+    contribute nothing to products or sums and are sliced off by callers.
+    """
+    r, c = x.shape
+    pr = (-r) % row_mult
+    pc = (-c) % col_mult
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)))
+    return x

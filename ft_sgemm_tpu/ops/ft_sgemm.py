@@ -1,0 +1,431 @@
+"""Fused online-ABFT SGEMM Pallas kernels — the framework's core capability.
+
+TPU-native re-design of the reference's generated FT kernels
+(``include_code_gen/ft_sgemm_{small..huge}.cuh``; template
+``code_gen/code_gen.py:198-553``; algorithm described in SURVEY.md §2.3).
+Everything happens inside one kernel — encode, accumulate, inject, detect,
+correct — no second pass over C:
+
+  1. **Input checksum encode, every K panel.** The reference sums each
+     thread's loaded A/B elements and completes the sums with
+     ``__shfl_xor_sync`` butterflies (``code_gen.py:207-226``). Here the
+     panel checksums are whole-tile VPU reductions: ``s_a = sum_m A_blk``,
+     ``s_b = sum_n B_blk`` over the (bm, bk)/(bn, bk) VMEM blocks.
+  2. **Expected-checksum accumulation.** The reference forms a running
+     per-thread expected checksum via a saxpy outer product through shared
+     memory (``code_gen.py:231-280``). Here the expected row/column sums of
+     the accumulated product are carried in VMEM scratch vectors:
+     ``r_exp += (A_blk * s_b).sum(k)`` and ``c_exp += (B_blk * s_a).sum(k)``
+     — elementwise VPU work that overlaps the MXU matmul, touching nothing
+     in the accumulator (so accumulator faults stay detectable).
+  3. **Periodic detect + correct.** The reference checks every ``K/20``
+     columns (``code_gen.py:333``): reduce the accumulator to row/col sums,
+     subtract from the expected sums, and add the row residual at
+     row-AND-column threshold intersections (``code_gen.py:372-424``). Here
+     the same residual-intersection correction is two VPU reductions of the
+     VMEM accumulator plus one masked broadcast add.
+  4. **Fault injection** is a runtime :class:`InjectionSpec` lowered through
+     SMEM scalars (the reference hardcodes it, ``ft_sgemm_huge.cuh:49-51``).
+
+Three checksum strategies mirror the reference's three preserved designs:
+
+  - ``"rowcol"`` (default): row+column checksums, residual-intersection
+    correction — the shipped generated kernels
+    (``include_code_gen/ft_sgemm_*.cuh``) and the warp-level design
+    (``include/ft_sgemm_huge_warp.cuh``).
+  - ``"global"``: one scalar checksum per output tile, detect-only — the
+    thread-local design (``include/ft_sgemm_huge_thread.cuh:106-177``).
+  - ``"weighted"``: column checksums plus index-weighted column checksums;
+    the weighted residual ratio *localizes* the faulty row for single-fault
+    correction — the weighted design (``include/ft_sgemm_huge.cuh:59,
+    280-296``, ``correct_t`` macro :13-17).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import pad_to as _pad_to
+from ft_sgemm_tpu.ops.common import should_interpret as _should_interpret
+
+STRATEGIES = ("rowcol", "global", "weighted")
+
+
+class FtSgemmResult(NamedTuple):
+    """Output of a fused-ABFT GEMM.
+
+    ``detections`` semantics differ by strategy:
+      - ``rowcol``/``weighted``: corrected fault count per C tile — one per
+        injected fault when at most one fault lands per check interval.
+      - ``global``: number of *failed checks* per tile. The strategy never
+        corrects, so a single persistent fault keeps failing every later
+        check; this counts corruption observations, not distinct faults.
+    """
+
+    c: jax.Array           # (M, N) corrected output
+    detections: jax.Array  # (grid_m, grid_n) int32 — see class docstring
+
+    @property
+    def num_detected(self):
+        return jnp.sum(self.detections)
+
+
+def _inject(acc_ref, inj_ref, k, i, j, bm, bn):
+    """Add inj.magnitude to one rotating accumulator element when scheduled.
+
+    Models SDC in the f32 accumulator (reference rotates the target thread:
+    ``if(tx == (k+8)/(K/20)) res[0] += error_inject``,
+    ``include_code_gen/ft_sgemm_huge.cuh:324-327``). The target rotates with
+    the injection ordinal and the output-tile coordinates. NOTE: like the
+    reference, intersection-based correction is only unambiguous for a
+    single fault per check interval — the wrapper clamps the check cadence
+    to the injection cadence to guarantee that for tool-injected faults
+    (see make_ft_sgemm).
+    """
+    enabled = inj_ref[0] > 0.0
+    every = jnp.maximum(inj_ref[1].astype(jnp.int32), 1)
+    magnitude = inj_ref[2]
+    do = enabled & (k % every == 0)
+
+    @pl.when(do)
+    def _():
+        ordinal = k // every + 3 * i + 5 * j
+        m0 = (ordinal * 131 + 7) % bm
+        n0 = (ordinal * 61 + 3) % bn
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
+        hit = (rows == m0) & (cols == n0)
+        acc_ref[:] += jnp.where(hit, magnitude, 0.0)
+
+
+def _ft_kernel_rowcol(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
+    acc_ref, r_exp_ref, c_exp_ref, count_ref,
+    *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
+):
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        r_exp_ref[:] = jnp.zeros_like(r_exp_ref)
+        c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
+        count_ref[0] = 0
+
+    _inject(acc_ref, inj_ref, k, i, j, bm, bn)
+
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
+
+    # MXU: main partial product.
+    acc_ref[:] += jax.lax.dot_general(
+        a_blk, b_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+
+    # VPU: panel input checksums (replaces __shfl_xor butterflies) and
+    # expected row/col sums of the accumulated product.
+    s_b = jnp.sum(b_blk, axis=0, keepdims=True)            # (1, bk)
+    s_a = jnp.sum(a_blk, axis=0, keepdims=True)            # (1, bk)
+    r_exp_ref[:] += jnp.sum(a_blk * s_b, axis=1, keepdims=True)  # (bm, 1)
+    c_exp_ref[:] += jnp.sum(b_blk * s_a, axis=1, keepdims=True)  # (bn, 1)
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect_correct():
+        rs = jnp.sum(acc_ref[:], axis=1, keepdims=True)     # (bm, 1)
+        cs = jnp.sum(acc_ref[:], axis=0, keepdims=True)     # (1, bn)
+        res_r = r_exp_ref[:] - rs                           # (bm, 1)
+        res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs       # (1, bn)
+        det_r = jnp.abs(res_r) > threshold
+        det_c = jnp.abs(res_c) > threshold
+        hit = jnp.logical_and(det_r, det_c)                 # (bm, bn)
+        # Residual source: with exactly one flagged row and several flagged
+        # columns, the faults all sit in that row and the *column* residuals
+        # carry the per-fault values (and vice versa). The reference always
+        # uses the row residual (col for the wide shape, code_gen.py:417-424)
+        # and miscorrects that case; disambiguating costs two scalar counts.
+        n_rows_flagged = jnp.sum(det_r.astype(jnp.int32))
+        n_cols_flagged = jnp.sum(det_c.astype(jnp.int32))
+        use_col = (n_rows_flagged == 1) & (n_cols_flagged > 1)
+        corr = jnp.where(use_col, jnp.broadcast_to(res_c, hit.shape),
+                         jnp.broadcast_to(res_r, hit.shape))
+        acc_ref[:] += jnp.where(hit, corr, 0.0)
+        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * acc_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+
+
+def _ft_kernel_global(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
+    acc_ref, t_exp_ref, count_ref,
+    *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
+):
+    """Scalar-checksum, detect-only variant (``ft_sgemm_huge_thread.cuh``)."""
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        t_exp_ref[0] = 0.0
+        count_ref[0] = 0
+
+    _inject(acc_ref, inj_ref, k, i, j, bm, bn)
+
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
+    acc_ref[:] += jax.lax.dot_general(
+        a_blk, b_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+    s_b = jnp.sum(b_blk, axis=0, keepdims=True)             # (1, bk)
+    # Total expected sum of this panel's product: sum_k s_a[k] * s_b[k].
+    t_exp_ref[0] += jnp.sum(jnp.sum(a_blk, axis=0, keepdims=True) * s_b)
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect():
+        res = t_exp_ref[0] - jnp.sum(acc_ref[:])
+        count_ref[0] += (jnp.abs(res) > threshold).astype(jnp.int32)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * acc_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+
+
+def _ft_kernel_weighted(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
+    acc_ref, c_exp_ref, cw_exp_ref, count_ref,
+    *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
+):
+    """Weighted-checksum variant with fault *localization*.
+
+    Two column checksums — plain and row-index-weighted — let the kernel
+    compute WHICH row of a corrupted column holds the fault:
+    ``row = round(res_weighted / res) - 1`` (the TPU analog of the
+    reference's ``correct_t`` macro, ``include/ft_sgemm_huge.cuh:13-17``,
+    with weight base {1..8} generalized to {1..bm}).
+    """
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    # tpu.iota is integer-only; cast to f32 for the weights {1..bm}.
+    w_col = jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
+        cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
+        count_ref[0] = 0
+
+    _inject(acc_ref, inj_ref, k, i, j, bm, bn)
+
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
+    acc_ref[:] += jax.lax.dot_general(
+        a_blk, b_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+    s_a = jnp.sum(a_blk, axis=0, keepdims=True)              # (1, bk)
+    s_aw = jnp.sum(a_blk * w_col, axis=0, keepdims=True)     # (1, bk)
+    c_exp_ref[:] += jnp.sum(b_blk * s_a, axis=1, keepdims=True)    # (bn, 1)
+    cw_exp_ref[:] += jnp.sum(b_blk * s_aw, axis=1, keepdims=True)  # (bn, 1)
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect_correct():
+        acc = acc_ref[:]
+        cs = jnp.sum(acc, axis=0, keepdims=True)             # (1, bn)
+        csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
+        res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs        # (1, bn)
+        res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
+        det_c = jnp.abs(res_c) > threshold
+        safe = jnp.where(det_c, res_c, 1.0)
+        loc = jnp.round(res_cw / safe).astype(jnp.int32) - 1  # (1, bn)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+        hit = det_c & (rows == loc)
+        acc_ref[:] += jnp.where(hit, res_c, 0.0)
+        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * acc_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+
+
+def _scratch_for(strategy, bm, bn):
+    acc = pltpu.VMEM((bm, bn), jnp.float32)
+    count = pltpu.SMEM((1,), jnp.int32)
+    if strategy == "rowcol":
+        return [acc, pltpu.VMEM((bm, 1), jnp.float32),
+                pltpu.VMEM((bn, 1), jnp.float32), count]
+    if strategy == "global":
+        return [acc, pltpu.SMEM((1,), jnp.float32), count]
+    if strategy == "weighted":
+        return [acc, pltpu.VMEM((bn, 1), jnp.float32),
+                pltpu.VMEM((bn, 1), jnp.float32), count]
+    raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
+
+
+_KERNELS = {
+    "rowcol": _ft_kernel_rowcol,
+    "global": _ft_kernel_global,
+    "weighted": _ft_kernel_weighted,
+}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "shape", "alpha", "beta", "precision", "threshold", "check_every",
+        "strategy", "interpret",
+    ),
+)
+def _ft_sgemm_padded(
+    a, b, c, inj,
+    *, shape: KernelShape, alpha, beta, precision, threshold, check_every,
+    strategy, interpret,
+):
+    m, k = a.shape
+    n, _ = b.shape
+    bm, bn, bk = shape.block
+    nk = k // bk
+    gm, gn = m // bm, n // bn
+    prec = jax.lax.Precision(precision)
+    check_every = max(1, check_every)
+
+    kernel = functools.partial(
+        _KERNELS[strategy],
+        alpha=alpha, beta=beta, nk=nk, prec=prec,
+        threshold=threshold, check_every=check_every, bm=bm, bn=bn,
+    )
+
+    flops = 2 * m * n * k
+    bytes_accessed = 4 * (m * k + n * k + 2 * m * n)
+
+    out, det = pl.pallas_call(
+        kernel,
+        grid=(gm, gn, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (3,)
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            # Full-array SMEM block: each (i, j) program writes its own cell
+            # (grid-blocked SMEM outputs must match the array shape).
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.float32),
+            jax.ShapeDtypeStruct((gm, gn), jnp.int32),
+        ],
+        scratch_shapes=_scratch_for(strategy, bm, bn),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, bytes_accessed=bytes_accessed, transcendentals=0
+        ),
+        interpret=interpret,
+    )(inj, a, b, c)
+    return out, det
+
+
+def make_ft_sgemm(
+    shape: KernelShape | str,
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    strategy: str = "rowcol",
+    threshold: float = REFERENCE_THRESHOLD,
+    check_every: Optional[int] = None,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+):
+    """Build the fused-ABFT SGEMM for one named shape.
+
+    Returns ``fn(a, b, c, inject=None) -> FtSgemmResult``. ``inject`` is an
+    :class:`InjectionSpec` (default: no injection — the clean path the
+    reference lacks). ``check_every`` is the detect/correct cadence in
+    K-grid steps; default scales to ~20 checks per run like the reference's
+    ``K/20``-column cadence (``code_gen.py:333``), clamped to every step for
+    short K. When injection is enabled, the cadence is further clamped to
+    the injection cadence so at most one fault lands per check interval —
+    intersection/localization correction is only unambiguous for a single
+    fault per interval (the reference has the same property and guarantees
+    it by construction: it checks exactly where it injects,
+    ``code_gen.py:333-337``).
+    """
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    if strategy not in STRATEGIES:
+        raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
+    bm, bn, bk = shape.block
+
+    def fn(a, b, c, inject: Optional[InjectionSpec] = None) -> FtSgemmResult:
+        inject = inject or InjectionSpec.none()
+        a = jnp.asarray(a, jnp.float32)
+        b = jnp.asarray(b, jnp.float32)
+        c = jnp.asarray(c, jnp.float32)
+        m, n = c.shape
+        ap = _pad_to(a, bm, bk)
+        bp = _pad_to(b, bn, bk)
+        cp = _pad_to(c, bm, bn)
+        nk = ap.shape[1] // bk
+        ce = check_every if check_every is not None else max(1, nk // 20)
+        if inject.enabled:
+            ce = min(ce, max(1, inject.every))
+        out, det = _ft_sgemm_padded(
+            ap, bp, cp, jnp.asarray(inject.as_operand()),
+            shape=shape, alpha=alpha, beta=beta, precision=precision,
+            threshold=threshold, check_every=ce, strategy=strategy,
+            interpret=_should_interpret(interpret),
+        )
+        return FtSgemmResult(out[:m, :n], det)
+
+    fn.__name__ = f"ft_sgemm_{shape.name}_{strategy}"
+    fn.shape_config = shape
+    fn.strategy = strategy
+    return fn
+
+
+def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
+             beta=-1.5, inject: Optional[InjectionSpec] = None,
+             strategy: str = "rowcol", threshold: float = REFERENCE_THRESHOLD,
+             check_every: Optional[int] = None, precision: str = "highest",
+             interpret: Optional[bool] = None) -> FtSgemmResult:
+    """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
+    return make_ft_sgemm(
+        shape, alpha=alpha, beta=beta, strategy=strategy, threshold=threshold,
+        check_every=check_every, precision=precision, interpret=interpret,
+    )(a, b, c, inject)

@@ -1,0 +1,47 @@
+"""Pure-XLA reference GEMM (the "vendor library" oracle).
+
+The reference verifies every kernel against ``cublasSgemm(OP_N, OP_T)``
+(``sgemm.cu:108,222``), i.e. ``C = alpha * A @ B.T + beta * C`` with A of
+shape (M, K) and B of shape (N, K). Here the oracle is XLA's native dot —
+the correctness reference for every Pallas kernel and the perf target for
+the bench (kernel id 0, perf-table row "xla_dot"; reference row "cublas",
+``sgemm.cu:235-237``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest"):
+    """``C = alpha * A @ B.T + beta * C`` via XLA's native dot.
+
+    Args:
+      a: (M, K) f32. b: (N, K) f32 — B is stored row-per-output-column,
+        matching the reference's OP_T operand layout. c: (M, N) f32.
+      precision: lax matmul precision; "highest" keeps true-f32 MXU passes
+        so the oracle matches f32 CUDA semantics.
+    """
+    out = jnp.dot(
+        a.astype(jnp.float32),
+        b.astype(jnp.float32).T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision(precision),
+    )
+    return alpha * out + beta * c.astype(jnp.float32)
+
+
+def cpu_gemm(alpha, beta, a, b, c):
+    """Naive O(n^3)-semantics reference on host numpy (reference
+    ``utils.cu:79-89``, row-major ``C = alpha*A@B + beta*C``). Kept as the
+    second, XLA-independent oracle for checksum-math tests."""
+    import numpy as np
+
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    c = np.asarray(c, dtype=np.float64)
+    return (alpha * (a @ b) + beta * c).astype(np.float32)

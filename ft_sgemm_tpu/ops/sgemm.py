@@ -1,0 +1,139 @@
+"""Plain (non-FT) Pallas SGEMM kernel family.
+
+TPU-native re-design of the reference's 6 generated CUDA kernels
+(``kernel/ft_sgemm/include_code_gen/sgemm_{small..huge}.cuh``). The
+reference's machinery — 2-level block/warp/thread tiling, float4 global
+loads, double-buffered shared memory, an unrolled per-thread ``mr x nr``
+outer product (SURVEY.md §2.2) — is all hand-built CUDA pipelining. On TPU
+every piece of it maps onto existing hardware/compiler structure:
+
+  block tile          -> Pallas grid step + BlockSpec (bm, bn, bk)
+  smem double buffer  -> Mosaic's automatic multi-buffered VMEM pipelining
+  warp/thread tiling  -> the 128x128 MXU systolic array
+  float4 vector loads -> VMEM lane layout (8x128 f32 tiles)
+
+so the kernel body is just: accumulate ``A_blk @ B_blk.T`` into a VMEM f32
+scratch across the K grid dimension, and apply the alpha/beta epilogue on
+the last K step. Semantics match the reference's verification target:
+``C = alpha * A @ B.T + beta * C`` with A (M, K), B (N, K)
+(``sgemm.cu:108``: ``cublasSgemm(OP_N, OP_T)``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.ops.common import pad_to as _pad_to
+from ft_sgemm_tpu.ops.common import should_interpret as _should_interpret
+
+
+def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, acc_ref, *, alpha, beta, nk, prec):
+    """One (i, j, k) grid step: acc += A_blk @ B_blk.T; epilogue at k==nk-1."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        a_ref[:],
+        b_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * acc_ref[:] + beta * c_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shape", "alpha", "beta", "precision", "interpret"),
+)
+def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interpret):
+    m, k = a.shape
+    n, _ = b.shape
+    bm, bn, bk = shape.block
+    nk = k // bk
+    grid = (m // bm, n // bn, nk)
+    prec = jax.lax.Precision(precision)
+
+    flops = 2 * m * n * k
+    bytes_accessed = 4 * (m * k + n * k + 2 * m * n)
+
+    return pl.pallas_call(
+        functools.partial(
+            _matmul_kernel, alpha=alpha, beta=beta, nk=nk, prec=prec
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, bytes_accessed=bytes_accessed, transcendentals=0
+        ),
+        interpret=interpret,
+    )(a, b, c)
+
+
+def make_sgemm(
+    shape: KernelShape | str,
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+):
+    """Build the plain SGEMM for one named shape.
+
+    Returns ``fn(a, b, c) -> C`` with ``C = alpha*A@B.T + beta*C``; inputs of
+    any (M, K)/(N, K)/(M, N) shapes — zero-padded up to the block tile, which
+    leaves results exact (padded rows/cols are sliced off).
+    """
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    bm, bn, bk = shape.block
+
+    def fn(a, b, c):
+        a = jnp.asarray(a, jnp.float32)
+        b = jnp.asarray(b, jnp.float32)
+        c = jnp.asarray(c, jnp.float32)
+        m, n = c.shape
+        ap = _pad_to(a, bm, bk)
+        bp = _pad_to(b, bn, bk)
+        cp = _pad_to(c, bm, bn)
+        out = _sgemm_padded(
+            ap, bp, cp,
+            shape=shape, alpha=alpha, beta=beta,
+            precision=precision, interpret=_should_interpret(interpret),
+        )
+        return out[:m, :n]
+
+    fn.__name__ = f"sgemm_{shape.name}"
+    fn.shape_config = shape
+    return fn
+
+
+def sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0, beta=-1.5,
+          precision="highest", interpret=None):
+    """One-shot plain SGEMM (see :func:`make_sgemm`)."""
+    return make_sgemm(
+        shape, alpha=alpha, beta=beta, precision=precision, interpret=interpret
+    )(a, b, c)

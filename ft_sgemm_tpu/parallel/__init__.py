@@ -1,0 +1,9 @@
+"""Multi-chip execution: mesh-sharded fault-tolerant GEMM over ICI."""
+
+from ft_sgemm_tpu.parallel.sharded import (
+    make_mesh,
+    sharded_ft_sgemm,
+    sharded_sgemm,
+)
+
+__all__ = ["make_mesh", "sharded_ft_sgemm", "sharded_sgemm"]

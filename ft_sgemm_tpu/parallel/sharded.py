@@ -1,0 +1,167 @@
+"""Mesh-sharded fault-tolerant GEMM via ``shard_map`` + XLA collectives.
+
+The reference is strictly single-GPU — no NCCL/MPI; its only "communication"
+is warp shuffles and shared memory inside one kernel (SURVEY.md §5). On TPU
+the natural scaling axis is a `jax.sharding.Mesh`: this module runs the
+fused-ABFT Pallas kernel per device over a 2-D ``(x, y)`` mesh and lets XLA
+place the collectives on ICI:
+
+  - **x axis — output-row parallelism (dp over M):** A and C row-sharded;
+    no communication for the product.
+  - **y axis — contraction parallelism (K sharded):** A and B column-sharded
+    along K; partial products are combined with a ``psum`` over ``y``.
+    Crucially each device runs its *local* ABFT detect/correct BEFORE the
+    psum — a corrupted partial is corrected while it is still localized to
+    one chip, instead of being smeared across the reduction.
+  - Detection counts are ``psum``-aggregated across the whole mesh, so the
+    caller sees one global fault count over ICI.
+
+Everything compiles under `jit` over the mesh; with
+``xla_force_host_platform_device_count=N`` the same code runs on N virtual
+CPU devices (the test/dry-run story — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
+    # metadata, which jax>=0.8 shard_map otherwise requires.
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+from ft_sgemm_tpu.ops.sgemm import make_sgemm
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_sizes: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Build a 2-D ``(x, y)`` mesh over the first ``n_devices`` devices.
+
+    Default factorization: the most-square split of n (e.g. 8 -> 4x2).
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if axis_sizes is None:
+        x = int(np.floor(np.sqrt(n)))
+        while n % x:
+            x -= 1
+        axis_sizes = (x, n // x)
+    x, y = axis_sizes
+    if x * y != n:
+        raise ValueError(f"axis_sizes {axis_sizes} != {n} devices")
+    return Mesh(np.asarray(devs[:n]).reshape(x, y), ("x", "y"))
+
+
+def _check_divisible(name, dim, parts):
+    if dim % parts:
+        raise ValueError(
+            f"{name} dimension {dim} must divide evenly over {parts} mesh"
+            f" shards (pad inputs before sharding)"
+        )
+
+
+def sharded_ft_sgemm(
+    a,
+    b,
+    c,
+    mesh: Mesh,
+    shape: KernelShape | str = "huge",
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    inject: Optional[InjectionSpec] = None,
+    strategy: str = "rowcol",
+    threshold: float = REFERENCE_THRESHOLD,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> FtSgemmResult:
+    """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a 2-D device mesh.
+
+    Sharding: A (M, K) -> P("x", "y"); B (N, K) -> P(None, "y");
+    C (M, N) -> P("x", None). Each device corrects its own K-partial
+    locally, then partials ``psum`` over ``y`` and detection counts ``psum``
+    over the whole mesh.
+    """
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    inject = inject or InjectionSpec.none()
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    mx, my = mesh.shape["x"], mesh.shape["y"]
+    _check_divisible("M", m, mx)
+    _check_divisible("K", k, my)
+
+    # Local kernel computes the raw K-partial (alpha/beta applied after the
+    # psum, once, by the wrapper).
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
+        precision=precision, interpret=interpret,
+    )
+
+    def step(a_loc, b_loc, c_loc):
+        zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
+        res = local_ft(a_loc, b_loc, zeros, inject)
+        partial = jax.lax.psum(res.c, "y")
+        out = alpha * partial + beta * c_loc
+        det = jax.lax.psum(jax.lax.psum(res.detections, "y"), "x")
+        return out, det
+
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("x", "y"), P(None, "y"), P("x", None)),
+        out_specs=(P("x", None), P(None, None)),
+    )
+    out, det = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det)
+
+
+def sharded_sgemm(
+    a,
+    b,
+    c,
+    mesh: Mesh,
+    shape: KernelShape | str = "huge",
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Plain (non-FT) mesh-sharded SGEMM with the same layout."""
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    c = jnp.asarray(c, jnp.float32)
+    mx, my = mesh.shape["x"], mesh.shape["y"]
+    _check_divisible("M", a.shape[0], mx)
+    _check_divisible("K", a.shape[1], my)
+
+    local = make_sgemm(shape, alpha=1.0, beta=0.0, precision=precision,
+                       interpret=interpret)
+
+    def step(a_loc, b_loc, c_loc):
+        zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
+        partial = jax.lax.psum(local(a_loc, b_loc, zeros), "y")
+        return alpha * partial + beta * c_loc
+
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("x", "y"), P(None, "y"), P("x", None)),
+        out_specs=P("x", None),
+    )
+    return jax.jit(fn)(a, b, c)

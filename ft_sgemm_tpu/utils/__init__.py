@@ -1,0 +1,27 @@
+"""Host-side utilities: matrix generation/verification and timing."""
+
+from ft_sgemm_tpu.utils.matrices import (
+    generate_random_matrix,
+    generate_random_vector,
+    fill_vector,
+    copy_matrix,
+    copy_vector,
+    verify_matrix,
+    verify_vector,
+    print_matrix,
+)
+from ft_sgemm_tpu.utils.timing import Timer, time_fn, gflops
+
+__all__ = [
+    "generate_random_matrix",
+    "generate_random_vector",
+    "fill_vector",
+    "copy_matrix",
+    "copy_vector",
+    "verify_matrix",
+    "verify_vector",
+    "print_matrix",
+    "Timer",
+    "time_fn",
+    "gflops",
+]

@@ -1,0 +1,112 @@
+"""Matrix generation / verification helpers (reference ``utils/utils.cu``).
+
+Same names and semantics as the reference host utilities, minus its known
+defects (SURVEY.md §4): ``verify_vector`` here returns a real boolean (the
+reference returns a function pointer, ``utils.cu:58``), and the copy helpers
+drop the no-op ``src + i`` pointer-truthiness guards (``utils.cu:36,42``).
+
+The value distribution matters: inputs are quantized to ±{0, 0.1, ..., 0.9}
+(``utils.cu:23-31``) so that checksum accumulation noise stays far below the
+fault-detection threshold.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_DEFAULT_SEED = 10  # reference: srand(10), sgemm.cu:12
+
+
+def generate_random_matrix(n: int, m: int | None = None, seed: int | None = None,
+                           rng: np.random.Generator | None = None) -> np.ndarray:
+    """(n, m) f32 matrix with entries uniform over ±{0, 0.1, ..., 0.9}.
+
+    Mirrors ``utils.cu:23-31``: magnitude ``(rand() % 10) * 0.1``, sign from
+    a second draw. Uses numpy's Generator rather than libc rand (the native
+    runtime offers exact-stream parity when needed).
+    """
+    m = n if m is None else m
+    if rng is None:
+        rng = np.random.default_rng(_DEFAULT_SEED if seed is None else seed)
+    mag = rng.integers(0, 10, size=(n, m)).astype(np.float32) * np.float32(0.1)
+    sign = np.where(rng.integers(0, 2, size=(n, m)) == 0, 1.0, -1.0).astype(np.float32)
+    return mag * sign
+
+
+def generate_random_vector(n: int, seed: int | None = None,
+                           rng: np.random.Generator | None = None) -> np.ndarray:
+    """(n,) f32 vector with entries ±(a*0.01 + b*0.001), a,b in {0..4}
+    (``utils.cu:15-21``)."""
+    if rng is None:
+        rng = np.random.default_rng(_DEFAULT_SEED if seed is None else seed)
+    a = rng.integers(0, 5, size=n).astype(np.float32) * np.float32(0.01)
+    b = rng.integers(0, 5, size=n).astype(np.float32) * np.float32(0.001)
+    sign = np.where(rng.integers(0, 2, size=n) == 0, 1.0, -1.0).astype(np.float32)
+    return (a + b) * sign
+
+
+def fill_vector(val: float, size: int) -> np.ndarray:
+    """Constant f32 vector (``utils.cu:2-6``)."""
+    return np.full((size,), val, dtype=np.float32)
+
+
+def copy_vector(src: np.ndarray) -> np.ndarray:
+    return np.array(src, dtype=np.float32, copy=True)
+
+
+def copy_matrix(src: np.ndarray) -> np.ndarray:
+    return np.array(src, dtype=np.float32, copy=True)
+
+
+def verify_matrix(ref: np.ndarray, out: np.ndarray, verbose: bool = True):
+    """Reference tolerance policy: an element fails iff its absolute error
+    > 0.01 AND its relative error (vs ref) > 0.01 (``utils.cu:61-77``).
+
+    Returns (ok, num_bad, first_bad_index_or_None). Vectorized instead of
+    the reference's early-exit double loop; same accept/reject set.
+    """
+    ref = np.asarray(ref, dtype=np.float64)
+    out = np.asarray(out, dtype=np.float64)
+    diff = np.abs(ref - out)
+    denom = np.abs(ref)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rel = np.where(denom > 0, diff / denom, np.inf)
+    bad = (diff > 0.01) & (rel > 0.01)
+    num_bad = int(bad.sum())
+    ok = num_bad == 0
+    first = None
+    if not ok:
+        first = tuple(int(x) for x in np.argwhere(bad)[0])
+        if verbose:
+            i = first
+            print(
+                f"error is {diff[i]:8.5f}, relative error is {rel[i]:8.5f}, "
+                f"{ref[i]:8.5f},{out[i]:8.5f}. id: {', '.join(map(str, i))}"
+            )
+    return ok, num_bad, first
+
+
+def verify_vector(ref: np.ndarray, out: np.ndarray):
+    """Vector tolerance: fail iff abs > 1e-2 AND rel > 5e-3
+    (``utils.cu:47-59``; the reference's return value is broken — it returns
+    ``cudaSetDeviceFlags`` — this one returns the actual flag)."""
+    ref = np.asarray(ref, dtype=np.float64)
+    out = np.asarray(out, dtype=np.float64)
+    diff = np.abs(ref - out)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rel = np.where(ref != 0, diff / np.abs(ref), np.inf)
+    bad = (diff > 1e-2) & (rel > 5e-3)
+    return not bool(bad.any()), int(bad.sum())
+
+
+def print_matrix(mat: np.ndarray) -> str:
+    """Pretty print (reference ``utils.cu:91`` prints its column-major
+    buffers; our arrays are row-major numpy, so this prints them as laid
+    out)."""
+    mat = np.asarray(mat)
+    lines = []
+    for i in range(mat.shape[0]):
+        lines.append("  ".join(f"{mat[i, j]:8.5f}" for j in range(mat.shape[1])))
+    text = "\n".join(lines)
+    print(text)
+    return text

@@ -1,0 +1,104 @@
+"""Timing helpers (reference: cudaEvent timing ``sgemm.cu:253-265`` and the
+unused ``saxpy_timer`` chrono class ``utils.cuh:20-41``).
+
+On TPU the device boundary is ``block_until_ready``; GFLOPS bookkeeping
+mirrors the reference protocol: ``2 * reps * M * N * K / elapsed`` with 5
+timed reps (``sgemm.cu:21-24,431-434``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+NUM_TESTS = 5  # reference num_tests, sgemm.cu:21
+
+
+class Timer:
+    """Start/elapsed wall-clock timer (reference ``saxpy_timer``)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def elapsed_ms(self) -> float:
+        return self.elapsed() * 1e3
+
+
+def time_fn(fn, *args, reps: int = NUM_TESTS, warmup: int = 1) -> float:
+    """Seconds for ``reps`` synchronous executions of ``fn(*args)``.
+
+    Mirrors the reference loop shape: sync, launch, sync per rep
+    (``sgemm.cu:258-262``). ``warmup`` runs first (compile + cache) and is
+    excluded — the reference gets this implicitly from its verification pass.
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0
+
+
+def gflops(m: int, n: int, k: int, seconds: float, reps: int = NUM_TESTS) -> float:
+    """GFLOPS under the reference's formula (``sgemm.cu:431-434``)."""
+    if seconds <= 0:
+        return float("inf")
+    return (2.0 * reps * m * n * k) / 1e9 / seconds
+
+
+def bench_seconds_per_call(fn, a, b, c, *, min_device_time: float = 1.0,
+                           max_reps: int = 1 << 16) -> float:
+    """Robust seconds-per-call of ``fn(a, b, c) -> array`` on device.
+
+    The reference brackets 5 launches with cudaEvents (``sgemm.cu:253-265``);
+    over a tunneled TPU a dispatch roundtrip costs ~50 ms, so instead the rep
+    loop runs *inside* one jitted computation with a **dynamic trip count**
+    (one compile, any rep count), chained data-dependently (C feeds back) so
+    no iteration can be elided. Reps scale until device time >=
+    ``min_device_time``; a zero-rep dispatch measures fixed overhead, which
+    is subtracted.
+    """
+    import itertools
+
+    import jax.numpy as jnp
+    import jax as _jax
+
+    @_jax.jit
+    def loop(a, b, c, reps, salt):
+        def body(i, x):
+            # Thread a negligible x-dependency into A so XLA cannot hoist
+            # the (otherwise loop-invariant) matmul out of the rep loop,
+            # and damp x so the chain stays bounded at any rep count
+            # (|x'| <= |A@B.T| + |beta|*1e-3*|x| converges; undamped,
+            # beta=-1.5 grows |x| 1.5x/rep and overflows f32 by rep ~205).
+            s = 1.0 + 1e-30 * jnp.sum(x)
+            return fn(a * s, b, x * 1e-3)
+        return jnp.sum(_jax.lax.fori_loop(0, reps, body, c + salt))
+
+    # A fresh salt per dispatch defeats any result caching of identical
+    # executions in the runtime (observed over the axon tunnel).
+    counter = itertools.count(1)
+
+    def run(reps):
+        salt = jnp.float32(next(counter) * 1e-6)
+        t0 = time.perf_counter()
+        float(loop(a, b, c, reps, salt))
+        return time.perf_counter() - t0
+
+    run(1)  # compile + warmup
+    overhead = min(run(0) for _ in range(3))
+    reps = NUM_TESTS
+    t = run(reps)
+    while t - overhead < min_device_time and reps < max_reps:
+        scale = min_device_time / max(t - overhead, 1e-4)
+        reps = min(max_reps, max(reps + 1, int(reps * min(scale, 8.0)) + 1))
+        t = run(reps)
+    best = min(t, *[run(reps) for _ in range(2)])
+    return max((best - overhead) / reps, 1e-9)

@@ -1,0 +1,35 @@
+"""Test harness config: CPU backend with a virtual 8-device mesh.
+
+Tests must run with no TPU attached (SURVEY.md §4 "TPU build test plan"):
+Pallas kernels run in interpret mode (auto-selected when the backend isn't
+TPU), sharding tests run over 8 virtual CPU devices.
+"""
+
+import os
+
+# Force CPU even when a TPU platform is configured in the environment: the
+# suite must pass with no TPU attached. TPU validation runs live separately
+# (scripts/validate_tpu.py, bench.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone is not enough when a TPU PJRT plugin (e.g. the axon
+# tunnel) is installed — pin the platform through jax.config as well.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(10)

@@ -1,0 +1,64 @@
+"""Two-pass ABFT baseline tests (reference include/baseline_ft_sgemm.cuh)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ft_sgemm_tpu import InjectionSpec, abft_baseline_sgemm, sgemm_reference
+from ft_sgemm_tpu.ops.reference import cpu_gemm
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    return a, b, c
+
+
+def test_reference_oracle_matches_cpu_gemm():
+    a, b, c = _inputs(48, 40, 56)
+    got = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    want = cpu_gemm(ALPHA, BETA, a, b.T, c)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_baseline_clean_matches_reference():
+    a, b, c = _inputs(128, 96, 512)
+    res = abft_baseline_sgemm(a, b, c, ALPHA, BETA)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} elements out of tolerance"
+    assert not bool(res.detected)
+    # Checksum noise floor is far below the detection threshold.
+    assert float(res.max_row_residual) < 1.0
+    assert float(res.max_col_residual) < 1.0
+
+
+def test_baseline_pads_odd_k():
+    a, b, c = _inputs(64, 64, 300)  # K not a multiple of the 256 panel
+    res = abft_baseline_sgemm(a, b, c, ALPHA, BETA)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} elements out of tolerance"
+
+
+def test_baseline_detects_injected_fault():
+    a, b, c = _inputs(128, 128, 512)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = abft_baseline_sgemm(a, b, c, ALPHA, BETA, inject=inj)
+    assert bool(res.detected)
+    # Residual magnitude reflects the fault (faults accumulate over panels).
+    assert float(res.max_row_residual) > 9500.0
+    assert float(res.max_col_residual) > 9500.0
+
+
+def test_baseline_small_fault_below_threshold_not_detected():
+    a, b, c = _inputs(64, 64, 256)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=100.0)
+    res = abft_baseline_sgemm(a, b, c, ALPHA, BETA, inject=inj)
+    # Residual sees the fault but stays below the reference 9500 threshold.
+    assert not bool(res.detected)
+    assert float(res.max_row_residual) > 50.0

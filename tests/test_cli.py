@@ -1,0 +1,41 @@
+"""CLI driver tests: argv contract, verification pass, perf table format."""
+
+import io
+
+import numpy as np
+
+from ft_sgemm_tpu import cli
+
+
+def test_verification_pass_small():
+    buf = io.StringIO()
+    ok = cli.run_verification(end_size=256, st_kernel=0, end_kernel=16, out=buf)
+    text = buf.getvalue()
+    assert ok, text
+    # All 14 table ids in range verify (0..6, 10..16 — 7..9 unused as in the
+    # reference, sgemm.cu:197-199).
+    assert text.count(": pass") == 14
+    assert "abft_kernel_huge" in text
+
+
+def test_perf_table_format():
+    buf = io.StringIO()
+    results = cli.run_perf_table(
+        start_size=128, end_size=256, gap_size=128,
+        st_kernel=0, end_kernel=1, min_device_time=0.02, out=buf,
+    )
+    text = buf.getvalue().splitlines()
+    assert text[0].startswith("#####")
+    assert text[1].startswith("Matrix Size         |")
+    assert "     128|     256|" in text[1]
+    assert text[2].startswith("xla_dot             |")
+    assert text[3].startswith("kernel_sgemm_small  |")
+    assert set(results) == {"xla_dot", "kernel_sgemm_small"}
+    assert all(v > 0 for row in results.values() for v in row.values())
+
+
+def test_main_argv_contract():
+    # Too few args -> usage, exit 2 (reference reads argv[1..5], sgemm.cu:13-19).
+    assert cli.main(["ft_sgemm", "1", "2"]) == 2
+    assert cli.main(["ft_sgemm", "128", "128", "128", "11", "11",
+                     "--no-perf"]) == 0

@@ -1,0 +1,168 @@
+"""Fused-ABFT kernel tests: the zero-undetected-corruption acceptance gate.
+
+The reference proves detect+correct implicitly: its FT kernels always inject
+20 faults and must still pass the cuBLAS diff (sgemm.cu:222-227,
+ft_sgemm_huge.cuh:324-327). Here injection is a parameter, so both the clean
+path and the injected path are tested explicitly, per strategy.
+"""
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import (
+    InjectionSpec,
+    SHAPES,
+    make_ft_sgemm,
+    make_sgemm,
+    sgemm_reference,
+)
+from ft_sgemm_tpu.configs import SHAPE_ORDER
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+@pytest.mark.parametrize("shape_name", SHAPE_ORDER)
+def test_clean_matches_plain_kernel(shape_name):
+    a, b, c = _inputs(256, 256, 512)
+    ft = make_ft_sgemm(shape_name, alpha=ALPHA, beta=BETA)
+    plain = make_sgemm(shape_name, alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c)
+    np.testing.assert_allclose(
+        np.asarray(res.c), np.asarray(plain(a, b, c)), rtol=1e-5, atol=1e-5
+    )
+    assert int(res.num_detected) == 0
+
+
+@pytest.mark.parametrize("shape_name", SHAPE_ORDER)
+def test_injected_faults_corrected(shape_name):
+    m = n = 512
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=5)
+    shape = SHAPES[shape_name]
+    inj = InjectionSpec.reference_like(k, shape.bk, num_faults=4)
+    ft = make_ft_sgemm(shape_name, alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{shape_name}: {nbad} corrupted elements survived correction"
+    # Every injected fault was detected: faults per tile x number of tiles.
+    mp = -(-m // shape.bm) * shape.bm
+    np_ = -(-n // shape.bn) * shape.bn
+    tiles = (mp // shape.bm) * (np_ // shape.bn)
+    expected = tiles * inj.expected_faults(k, shape.bk)
+    assert int(res.num_detected) == expected
+
+
+def test_injection_count_scales_with_cadence():
+    m = n = 512
+    k = 2048
+    a, b, c = _inputs(m, n, k, seed=6)
+    shape = SHAPES["huge"]
+    ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA)
+    nk = k // shape.bk
+    for every in (nk, nk // 2, nk // 4):
+        inj = InjectionSpec(enabled=True, every=every, magnitude=10000.0)
+        res = ft(a, b, c, inject=inj)
+        assert int(res.num_detected) == inj.expected_faults(k, shape.bk)
+        want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"every={every}: {nbad} corrupted elements survived"
+
+
+def test_weighted_strategy_corrects():
+    m = n = 512
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=8)
+    shape = SHAPES["huge"]
+    inj = InjectionSpec.reference_like(k, shape.bk, num_faults=4)
+    ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="weighted")
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"weighted: {nbad} corrupted elements survived localization"
+    assert int(res.num_detected) == inj.expected_faults(k, shape.bk)
+
+
+def test_global_strategy_detects_but_does_not_correct():
+    m = n = 512
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=9)
+    shape = SHAPES["huge"]
+    inj = InjectionSpec(enabled=True, every=k // shape.bk, magnitude=10000.0)
+    ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="global")
+    res = ft(a, b, c, inject=inj)
+    assert int(res.num_detected) >= 1
+    # Detect-only: the corruption remains in the output.
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, _, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert not ok
+
+
+def test_global_strategy_clean_is_correct():
+    a, b, c = _inputs(384, 384, 512, seed=11)
+    ft = make_ft_sgemm("large", alpha=ALPHA, beta=BETA, strategy="global")
+    res = ft(a, b, c)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok and int(res.num_detected) == 0
+
+
+def test_below_threshold_fault_not_detected():
+    # A fault below err_bound passes silently — documents the threshold
+    # semantics the reference relies on (err_bound1=9500 vs inject=10000).
+    a, b, c = _inputs(256, 256, 512, seed=12)
+    inj = InjectionSpec(enabled=True, every=100, magnitude=100.0)
+    ft = make_ft_sgemm("small", alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c, inject=inj)
+    assert int(res.num_detected) == 0
+
+
+def test_dense_injection_with_sparse_check_cadence_still_corrects():
+    # Regression: explicit check_every coarser than the injection cadence
+    # would put >1 fault per check interval and make intersection correction
+    # ambiguous; the wrapper clamps the cadence to the injection cadence.
+    m = n = 128
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=21)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm("small", alpha=ALPHA, beta=BETA, check_every=2)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived"
+    assert int(res.num_detected) == inj.expected_faults(k, SHAPES["small"].bk)
+
+
+def test_expected_faults_counts_padded_k_grid():
+    # K=520 pads to 768 with bk=256 -> 3 k-steps -> every=2 injects at k=0,2.
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    assert inj.expected_faults(520, 256) == 2
+    a, b, c = _inputs(128, 128, 520, seed=22)
+    ft = make_ft_sgemm("medium", alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c, inject=inj)
+    assert int(res.num_detected) == 2
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived"
+
+
+def test_rectangular_with_padding_and_injection():
+    a, b, c = _inputs(300, 200, 520, seed=13)
+    shape = SHAPES["medium"]
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    ft = make_ft_sgemm("medium", alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived"
+    assert int(res.num_detected) > 0

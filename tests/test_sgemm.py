@@ -1,0 +1,67 @@
+"""Differential tests: every plain Pallas kernel shape vs the XLA oracle.
+
+Mirrors the reference's only correctness check — each kernel vs
+cublasSgemm(OP_N, OP_T) under the utils.cu:61 tolerance (sgemm.cu:222) —
+plus the non-square/odd-size coverage the reference lacks.
+"""
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import SHAPES, make_sgemm, sgemm_reference
+from ft_sgemm_tpu.configs import SHAPE_ORDER
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+@pytest.mark.parametrize("shape_name", SHAPE_ORDER)
+def test_square_matches_oracle(shape_name):
+    a, b, c = _inputs(256, 256, 256)
+    fn = make_sgemm(shape_name, alpha=ALPHA, beta=BETA)
+    got = np.asarray(fn(a, b, c))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    ok, nbad, _ = verify_matrix(want, got, verbose=False)
+    assert ok, f"{shape_name}: {nbad} elements out of tolerance"
+
+
+@pytest.mark.parametrize(
+    "m,n,k",
+    [
+        (384, 256, 512),   # multiple tiles
+        (200, 136, 72),    # odd sizes -> padding on every axis
+        (512, 128, 640),   # tall
+        (128, 512, 640),   # wide
+    ],
+)
+def test_rectangular_and_padded(m, n, k):
+    a, b, c = _inputs(m, n, k, seed=7)
+    fn = make_sgemm("huge", alpha=ALPHA, beta=BETA)
+    got = np.asarray(fn(a, b, c))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_alpha_beta_variants():
+    a, b, c = _inputs(128, 128, 128)
+    for alpha, beta in [(1.0, 0.0), (2.0, -1.5), (0.5, 3.0)]:
+        fn = make_sgemm("small", alpha=alpha, beta=beta)
+        got = np.asarray(fn(a, b, c))
+        want = np.asarray(sgemm_reference(a, b, c, alpha, beta))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_shape_table_is_mxu_legal():
+    for name, shape in SHAPES.items():
+        assert shape.bm % 128 == 0 and shape.bn % 128 == 0 and shape.bk % 128 == 0
+        assert len(shape.ref_params) == 7

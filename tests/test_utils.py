@@ -1,0 +1,82 @@
+"""Host-utility parity tests (reference utils/utils.cu semantics)."""
+
+import numpy as np
+
+from ft_sgemm_tpu.utils import (
+    fill_vector,
+    generate_random_matrix,
+    generate_random_vector,
+    verify_matrix,
+    verify_vector,
+)
+
+
+def test_generate_random_matrix_quantized():
+    # Values must lie in ±{0, 0.1, ..., 0.9} (utils.cu:23-31) — this keeps
+    # checksum noise far below the detection threshold.
+    a = generate_random_matrix(64)
+    assert a.shape == (64, 64)
+    assert a.dtype == np.float32
+    scaled = np.round(np.abs(a) * 10)
+    assert np.allclose(np.abs(a) * 10, scaled, atol=1e-5)
+    assert scaled.max() <= 9
+    # Both signs appear.
+    assert (a > 0).any() and (a < 0).any()
+
+
+def test_generate_random_matrix_rectangular_and_seeded():
+    a1 = generate_random_matrix(16, 32, seed=3)
+    a2 = generate_random_matrix(16, 32, seed=3)
+    b = generate_random_matrix(16, 32, seed=4)
+    assert a1.shape == (16, 32)
+    np.testing.assert_array_equal(a1, a2)
+    assert not np.array_equal(a1, b)
+
+
+def test_generate_random_vector_range():
+    v = generate_random_vector(1000)
+    assert np.abs(v).max() <= 0.044 + 1e-6  # 4*0.01 + 4*0.001 (utils.cu:15-21)
+
+
+def test_fill_vector():
+    v = fill_vector(1.5, 7)
+    assert v.shape == (7,)
+    assert (v == np.float32(1.5)).all()
+
+
+def test_verify_matrix_accepts_within_tolerance():
+    ref = np.array([[1.0, 100.0], [0.001, -5.0]], dtype=np.float32)
+    # abs err <= 0.01 passes even at big relative error (utils.cu:70: needs
+    # BOTH abs > 0.01 AND rel > 0.01 to fail).
+    out = ref + np.float32(0.009)
+    ok, nbad, first = verify_matrix(ref, out)
+    assert ok and nbad == 0 and first is None
+
+
+def test_verify_matrix_rejects_large_error():
+    ref = np.ones((4, 4), dtype=np.float32)
+    out = ref.copy()
+    out[2, 3] = 1.5
+    ok, nbad, first = verify_matrix(ref, out, verbose=False)
+    assert not ok
+    assert nbad == 1
+    assert first == (2, 3)
+
+
+def test_verify_matrix_relative_only_error_passes():
+    # Large relative error on a large value -> abs dominates -> fails;
+    # large relative error on a tiny value with abs <= 0.01 -> passes.
+    ref = np.full((2, 2), 0.0001, dtype=np.float32)
+    out = ref * 50  # abs err ~0.0049 < 0.01
+    ok, _, _ = verify_matrix(ref, out)
+    assert ok
+
+
+def test_verify_vector():
+    ref = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    ok, nbad = verify_vector(ref, ref + 0.001)
+    assert ok and nbad == 0
+    bad = ref.copy()
+    bad[1] = 2.5
+    ok, nbad = verify_vector(ref, bad)
+    assert not ok and nbad == 1
