@@ -1,0 +1,1 @@
+"""Kernel-variant generator CLI (reference ``code_gen/`` workflow analog)."""

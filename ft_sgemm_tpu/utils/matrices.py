@@ -58,9 +58,11 @@ def copy_matrix(src: np.ndarray) -> np.ndarray:
     return np.array(src, dtype=np.float32, copy=True)
 
 
-def verify_matrix(ref: np.ndarray, out: np.ndarray, verbose: bool = True):
+def verify_matrix(ref: np.ndarray, out: np.ndarray, verbose: bool = True,
+                  abs_tol: float = 0.01, rel_tol: float = 0.01):
     """Reference tolerance policy: an element fails iff its absolute error
-    > 0.01 AND its relative error (vs ref) > 0.01 (``utils.cu:61-77``).
+    > abs_tol AND its relative error (vs ref) > rel_tol (defaults from
+    ``utils.cu:61-77``).
 
     Returns (ok, num_bad, first_bad_index_or_None). Vectorized instead of
     the reference's early-exit double loop; same accept/reject set.
@@ -71,7 +73,7 @@ def verify_matrix(ref: np.ndarray, out: np.ndarray, verbose: bool = True):
     denom = np.abs(ref)
     with np.errstate(divide="ignore", invalid="ignore"):
         rel = np.where(denom > 0, diff / denom, np.inf)
-    bad = (diff > 0.01) & (rel > 0.01)
+    bad = (diff > abs_tol) & (rel > rel_tol)
     num_bad = int(bad.sum())
     ok = num_bad == 0
     first = None

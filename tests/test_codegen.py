@@ -1,0 +1,32 @@
+"""Generator CLI tests (reference code_gen/main.py + gen.sh workflow)."""
+
+import io
+
+from ft_sgemm_tpu.codegen import gen
+
+
+def test_list_table():
+    buf = io.StringIO()
+    gen.print_table(out=buf)
+    text = buf.getvalue()
+    for name in ("small", "medium", "large", "tall", "wide", "huge", "test"):
+        assert name in text
+    # Reference provenance params present (main.py:8-16).
+    assert "[16, 16, 16, 8, 16, 2, 2]" in text
+
+
+def test_dump_single_variant(tmp_path):
+    path = gen.dump_variant("small", True, 256, 256, 256, tmp_path)
+    assert path.name == "ft_sgemm_small.txt"
+    text = path.read_text()
+    assert "jaxpr" in text and "lowered" in text
+    assert "block tile (bm,bn,bk)=(128, 128, 128)" in text
+
+
+def test_main_argv(tmp_path):
+    assert gen.main(["gen", "list"]) == 0
+    assert gen.main(["gen", "huge", "0", "256", "256", "256",
+                     f"--out={tmp_path}"]) == 0
+    assert (tmp_path / "sgemm_huge.txt").exists()
+    assert gen.main(["gen", "bogus"]) == 2
+    assert gen.main(["gen"]) == 2

@@ -1,0 +1,81 @@
+"""Native host-runtime tests (csrc/hostutils.cpp via ctypes)."""
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import runtime
+
+pytestmark = pytest.mark.skipif(
+    not runtime.available(), reason="no native toolchain (numpy fallback ok)"
+)
+
+
+def test_native_matrix_quantized_and_deterministic():
+    a1 = runtime.generate_random_matrix_native(32, 48, seed=10)
+    a2 = runtime.generate_random_matrix_native(32, 48, seed=10)
+    np.testing.assert_array_equal(a1, a2)
+    assert a1.shape == (32, 48) and a1.dtype == np.float32
+    scaled = np.abs(a1) * 10
+    np.testing.assert_allclose(scaled, np.round(scaled), atol=1e-5)
+    assert scaled.max() <= 9
+    b = runtime.generate_random_matrix_native(32, 48, seed=11)
+    assert not np.array_equal(a1, b)
+
+
+def test_driver_inputs_continue_one_stream():
+    # A then B from one srand(10) stream (sgemm.cu:12,57-58): B must differ
+    # from a fresh seed-10 A, and the pair must be reproducible.
+    a1, b1 = runtime.generate_reference_driver_inputs(16)
+    a2, b2 = runtime.generate_reference_driver_inputs(16)
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(b1, b2)
+    assert not np.array_equal(a1, b1)
+
+
+def test_native_verify_matrix_matches_python():
+    from ft_sgemm_tpu.utils.matrices import verify_matrix
+
+    rng = np.random.default_rng(0)
+    ref = rng.normal(size=(64, 64)).astype(np.float32)
+    out = ref.copy()
+    out[5, 7] += 1.0
+    out[20, 3] += 0.005  # abs below tolerance -> passes
+    ok_n, nbad_n, first_n = runtime.verify_matrix_native(ref, out)
+    ok_p, nbad_p, first_p = verify_matrix(ref, out, verbose=False)
+    assert ok_n == ok_p is False
+    assert nbad_n == nbad_p == 1
+    assert first_n == 5 * 64 + 7
+    assert first_p == (5, 7)
+
+
+def test_checksum_residual_native_oracle():
+    rng = np.random.default_rng(2)
+    c = rng.normal(size=(16, 24)).astype(np.float32)
+    er = c.astype(np.float64).sum(axis=1)
+    ec = c.astype(np.float64).sum(axis=0)
+    r, cl = runtime.checksum_residual_native(c, er, ec)
+    assert r < 1e-3 and cl < 1e-3
+    # Corrupt one element: both residuals see ~the fault magnitude.
+    c2 = c.copy()
+    c2[3, 5] += 100.0
+    r, cl = runtime.checksum_residual_native(c2, er, ec)
+    assert abs(r - 100.0) < 1e-2 and abs(cl - 100.0) < 1e-2
+
+
+def test_codegen_rejects_partial_mnk(capsys):
+    from ft_sgemm_tpu.codegen import gen
+    import pytest as _pytest
+    with _pytest.raises(SystemExit):
+        gen.main(["gen", "huge", "1", "512"])
+    assert gen.main(["gen", "--help"]) == 0
+    assert gen.main(["gen", "--bogus-flag"]) == 2
+
+
+def test_native_cpu_gemm_matches_numpy():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(17, 23)).astype(np.float32)
+    b = rng.normal(size=(23, 11)).astype(np.float32)
+    c = rng.normal(size=(17, 11)).astype(np.float32)
+    got = runtime.cpu_gemm_native(1.25, -0.5, a, b, c)
+    want = 1.25 * (a.astype(np.float64) @ b.astype(np.float64)) - 0.5 * c
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5, atol=1e-5)
