@@ -25,7 +25,11 @@ has no tunnel overhead to cancel).
 
 Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
-        [--mintime=SECONDS] [--no-verify] [--no-perf]
+        [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
+
+``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
+analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
+"Tracing"); open DIR with TensorBoard or Perfetto.
 """
 
 from __future__ import annotations
@@ -153,16 +157,24 @@ def main(argv=None) -> int:
         print(__doc__)
         return 2
     min_device_time = 1.0
+    trace_dir = None
     for f in flags:
         if f.startswith("--mintime="):
             min_device_time = float(f.split("=", 1)[1])
+        elif f.startswith("--trace="):
+            trace_dir = f.split("=", 1)[1]
 
     ok = True
     if "--no-verify" not in flags:
         ok = run_verification(end_size, st_kernel, end_kernel)
     if "--no-perf" not in flags:
-        run_perf_table(start_size, end_size, gap_size, st_kernel, end_kernel,
-                       min_device_time=min_device_time)
+        import contextlib
+
+        ctx = (jax.profiler.trace(trace_dir) if trace_dir
+               else contextlib.nullcontext())
+        with ctx:
+            run_perf_table(start_size, end_size, gap_size, st_kernel,
+                           end_kernel, min_device_time=min_device_time)
     return 0 if ok else 1
 
 

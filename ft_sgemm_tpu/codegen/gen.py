@@ -89,14 +89,21 @@ def print_table(out=sys.stdout):
               file=out)
 
 
+class _UsageError(Exception):
+    pass
+
+
 def _parse_mnk(tokens, what):
     """M N K must be given together (all three) or not at all."""
     if not tokens:
         return DEFAULT_MNK
     if len(tokens) != 3:
-        raise SystemExit(
+        raise _UsageError(
             f"{what}: M N K must be given as all three values, got {tokens}")
-    return tuple(map(int, tokens))
+    try:
+        return tuple(map(int, tokens))
+    except ValueError:
+        raise _UsageError(f"{what}: M N K must be integers, got {tokens}")
 
 
 def main(argv=None) -> int:
@@ -118,25 +125,36 @@ def main(argv=None) -> int:
     if not args:
         print(__doc__)
         return 2
-    if args[0] == "list":
-        print_table()
-        return 0
-    if args[0] == "all":
-        m, n, k = _parse_mnk(args[1:], "all")
-        for if_abft in (False, True):  # gen.sh order: plain 6, then ft 6
-            for name in SHAPE_ORDER:
-                path = dump_variant(name, if_abft, m, n, k, out_dir)
-                print(f"wrote {path}")
-        return 0
-    shape_name = args[0]
-    if shape_name not in SHAPES:
-        print(f"unknown shape {shape_name!r}; known: {sorted(SHAPES)}",
-              file=sys.stderr)
-        return 2
-    if_abft = bool(int(args[1])) if len(args) > 1 else False
-    m, n, k = _parse_mnk(args[2:5] if len(args) > 2 else [], shape_name)
-    if len(args) > 5:
-        print(f"unexpected extra arguments: {args[5:]}", file=sys.stderr)
+    try:
+        if args[0] == "list":
+            print_table()
+            return 0
+        if args[0] == "all":
+            m, n, k = _parse_mnk(args[1:], "all")
+            for if_abft in (False, True):  # gen.sh order: plain 6, then ft 6
+                for name in SHAPE_ORDER:
+                    path = dump_variant(name, if_abft, m, n, k, out_dir)
+                    print(f"wrote {path}")
+            return 0
+        shape_name = args[0]
+        if shape_name not in SHAPES:
+            print(f"unknown shape {shape_name!r}; known: {sorted(SHAPES)}",
+                  file=sys.stderr)
+            return 2
+        if len(args) > 1:
+            try:
+                if_abft = bool(int(args[1]))
+            except ValueError:
+                raise _UsageError(
+                    f"if_abft must be 0 or 1, got {args[1]!r}")
+        else:
+            if_abft = False
+        m, n, k = _parse_mnk(args[2:5] if len(args) > 2 else [], shape_name)
+        if len(args) > 5:
+            print(f"unexpected extra arguments: {args[5:]}", file=sys.stderr)
+            return 2
+    except _UsageError as e:
+        print(str(e), file=sys.stderr)
         return 2
     path = dump_variant(shape_name, if_abft, m, n, k, out_dir)
     print(f"wrote {path}")

@@ -62,13 +62,17 @@ class KernelShape:
 #   - "tall"/"wide": 4:1 / 1:4 aspect blocks (reference: 128x32 / 32x128).
 #   - "huge": the flagship big-block kernel (reference: 128x128x8,
 #     README.md:46 — beats cuBLAS; ours targets XLA's native dot).
+# large/huge K-depths picked by a live-v5e sweep (scripts/tune_tiles.py,
+# M=N=K=4096): bk=512 beats bk=256 by ~2% plain and ~5-14% fused-ABFT
+# (fewer K steps => fewer detect/correct epilogues); larger tiles exceed
+# the ~16 MB VMEM budget with double buffering and fail to compile.
 SHAPES = {
     "small": KernelShape("small", 128, 128, 128, (16, 16, 16, 8, 16, 2, 2)),
     "medium": KernelShape("medium", 128, 128, 256, (32, 32, 8, 16, 32, 4, 4)),
-    "large": KernelShape("large", 256, 256, 256, (64, 64, 8, 32, 64, 8, 8)),
+    "large": KernelShape("large", 256, 256, 512, (64, 64, 8, 32, 64, 8, 8)),
     "tall": KernelShape("tall", 512, 128, 256, (128, 32, 8, 64, 16, 8, 4)),
     "wide": KernelShape("wide", 128, 512, 256, (32, 128, 8, 16, 64, 4, 8)),
-    "huge": KernelShape("huge", 512, 512, 256, (128, 128, 8, 32, 64, 8, 8)),
+    "huge": KernelShape("huge", 512, 512, 512, (128, 128, 8, 32, 64, 8, 8)),
     "test": KernelShape("test", 128, 128, 128, (64, 64, 8, 16, 32, 4, 4)),
 }
 

@@ -421,10 +421,17 @@ def make_ft_sgemm(
             ce = nk  # single final check: localization absorbs fault backlog
         else:
             ce = max(1, nk // 20)
-        if strategy != "weighted" and inject.enabled:
-            # Intersection correction needs <= 1 fault per check interval;
-            # weighted localization doesn't (distinct columns suffice).
-            ce = min(ce, max(1, inject.every))
+        if inject.enabled:
+            if strategy == "weighted":
+                # Localization needs the interval's faults in DISTINCT
+                # columns. The rotating target advances the column ordinal
+                # by 1 per scheduled injection (gcd(61, bn) = 1), so up to
+                # bn faults per interval stay distinct; only clamp for
+                # K deep enough to wrap the column cycle.
+                ce = min(ce, bn * max(1, inject.every))
+            else:
+                # Intersection correction needs <= 1 fault per interval.
+                ce = min(ce, max(1, inject.every))
         out, det = _ft_sgemm_padded(
             ap, bp, cp, jnp.asarray(inject.as_operand()),
             shape=shape, alpha=alpha, beta=beta, precision=precision,

@@ -1,9 +1,21 @@
 """Multi-chip execution: mesh-sharded fault-tolerant GEMM over ICI."""
 
+from ft_sgemm_tpu.parallel.ring import (
+    make_ring_mesh,
+    ring_ft_sgemm,
+    ring_sgemm,
+)
 from ft_sgemm_tpu.parallel.sharded import (
     make_mesh,
     sharded_ft_sgemm,
     sharded_sgemm,
 )
 
-__all__ = ["make_mesh", "sharded_ft_sgemm", "sharded_sgemm"]
+__all__ = [
+    "make_mesh",
+    "make_ring_mesh",
+    "ring_ft_sgemm",
+    "ring_sgemm",
+    "sharded_ft_sgemm",
+    "sharded_sgemm",
+]

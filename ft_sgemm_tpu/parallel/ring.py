@@ -1,0 +1,186 @@
+"""Ring collective-matmul FT-SGEMM: ``ppermute`` pipeline over a 1-D mesh.
+
+The 2-D mesh path (``parallel/sharded.py``) K-shards the contraction and
+combines partials with one ``psum``. This module is the other canonical TPU
+distribution: a **ring collective matmul**. Every device keeps only its own
+row shard of A and one visiting shard of B at a time; B shards rotate around
+the ICI ring with ``jax.lax.ppermute`` while each hop's partial product is
+computed locally. Nothing ever materializes the full B per device, so the
+per-device working set stays O((M + N)/D * K) — the long-"context" scaling
+pattern (this is exactly the dataflow of ring attention, applied to the
+GEMM that is this framework's domain; SURVEY.md §5 notes the reference has
+no distributed backend at all).
+
+Fault tolerance composes per hop: each visiting shard's partial C columns
+are produced by the fused-ABFT kernel and corrected locally BEFORE the
+shard moves on, so a corrupted accumulator never propagates around the
+ring. Detection counts ``psum`` over the ring at the end.
+
+Layout (D = ring size):
+  A  (M, K)  -> P("x", None): row shards, stationary.
+  B  (N, K)  -> P("x", None): row shards (= column blocks of C), rotating.
+  C  (M, N)  -> P("x", None): each device owns full-width rows; at hop t a
+               device writes the column block belonging to the shard it is
+               visiting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+from ft_sgemm_tpu.ops.sgemm import make_sgemm
+from ft_sgemm_tpu.parallel.sharded import shard_map
+
+
+def make_ring_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D ring mesh over the first n devices (ICI ring on real pods)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    import numpy as np
+
+    return Mesh(np.asarray(devs[:n]), ("x",))
+
+
+def _check_divisible(name, dim, parts):
+    if dim % parts:
+        raise ValueError(
+            f"{name} dimension {dim} must divide evenly over the {parts}-"
+            f"device ring (pad inputs first)"
+        )
+
+
+def ring_ft_sgemm(
+    a,
+    b,
+    c,
+    mesh: Mesh,
+    shape: KernelShape | str = "huge",
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    inject: Optional[InjectionSpec] = None,
+    strategy: str = "rowcol",
+    threshold: float = REFERENCE_THRESHOLD,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> FtSgemmResult:
+    """Fused-ABFT ``C = alpha*A@B.T + beta*C`` as a ring collective matmul.
+
+    Detections are aggregated over all hops and devices; the returned
+    ``detections`` array is the global scalar count reshaped to (1, 1)
+    (per-tile attribution is not preserved across hops).
+    """
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    inject = inject or InjectionSpec.none()
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    d = mesh.shape["x"]
+    _check_divisible("M", m, d)
+    _check_divisible("N", n, d)
+    nb = n // d  # visiting-shard width = one C column block
+
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
+        precision=precision, interpret=interpret,
+    )
+    perm = [(i, (i + 1) % d) for i in range(d)]  # shift shards up the ring
+
+    def step_fn(a_loc, b_loc, c_loc):
+        my = jax.lax.axis_index("x")
+        zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
+
+        def hop(t, carry):
+            out, b_vis, det = carry
+            res = local_ft(a_loc, b_vis, zeros, inject)
+            # perm shifts shards UP the ring, so after t rotations a device
+            # holds the shard that started at position my - t => that
+            # shard's C columns start at its owner's offset.
+            col0 = jnp.mod(my - t, d) * nb
+            out = jax.lax.dynamic_update_slice(out, res.c, (0, col0))
+            det = det + jnp.sum(res.detections)
+            # Rotate AFTER computing so hop t uses the t-shifted shard; the
+            # final rotation returns shards to their owners.
+            b_vis = jax.lax.ppermute(b_vis, "x", perm)
+            return out, b_vis, det
+
+        out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
+        out, _, det = jax.lax.fori_loop(
+            0, d, hop, (out0, b_loc, jnp.int32(0)))
+        out = alpha * out + beta * c_loc
+        det = jax.lax.psum(det, "x")
+        return out, det.reshape(1, 1)
+
+    fn = shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(P("x", None), P("x", None), P("x", None)),
+        out_specs=(P("x", None), P(None, None)),
+    )
+    out, det = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det)
+
+
+def ring_sgemm(
+    a,
+    b,
+    c,
+    mesh: Mesh,
+    shape: KernelShape | str = "huge",
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Plain (non-FT) ring collective matmul with the same layout."""
+    if isinstance(shape, str):
+        shape = SHAPES[shape]
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    d = mesh.shape["x"]
+    _check_divisible("M", m, d)
+    _check_divisible("N", n, d)
+    nb = n // d
+
+    local = make_sgemm(shape, alpha=1.0, beta=0.0, precision=precision,
+                       interpret=interpret)
+    perm = [(i, (i + 1) % d) for i in range(d)]
+
+    def step_fn(a_loc, b_loc, c_loc):
+        my = jax.lax.axis_index("x")
+        zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
+
+        def hop(t, carry):
+            out, b_vis = carry
+            part = local(a_loc, b_vis, zeros)
+            col0 = jnp.mod(my - t, d) * nb
+            out = jax.lax.dynamic_update_slice(out, part, (0, col0))
+            b_vis = jax.lax.ppermute(b_vis, "x", perm)
+            return out, b_vis
+
+        out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
+        out, _ = jax.lax.fori_loop(0, d, hop, (out0, b_loc))
+        return alpha * out + beta * c_loc
+
+    fn = shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(P("x", None), P("x", None), P("x", None)),
+        out_specs=P("x", None),
+    )
+    return jax.jit(fn)(a, b, c)
+
+
+__all__ = ["make_ring_mesh", "ring_ft_sgemm", "ring_sgemm"]

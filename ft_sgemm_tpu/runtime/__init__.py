@@ -48,7 +48,10 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    path = _SO if _SO.exists() else _compile()
+    src = _CSRC / "hostutils.cpp"
+    stale = (_SO.exists() and src.exists()
+             and _SO.stat().st_mtime < src.stat().st_mtime)
+    path = _SO if _SO.exists() and not stale else _compile()
     if path is None:
         return None
     try:
@@ -135,6 +138,9 @@ def verify_matrix_native(ref: np.ndarray, out: np.ndarray,
     lib = load()
     ref = np.ascontiguousarray(ref, dtype=np.float32)
     out = np.ascontiguousarray(out, dtype=np.float32)
+    if ref.shape != out.shape or ref.ndim != 2:
+        raise ValueError(
+            f"verify_matrix_native: shape mismatch {ref.shape} vs {out.shape}")
     if lib is None:
         from ft_sgemm_tpu.utils.matrices import verify_matrix
         ok, nbad, first = verify_matrix(ref, out, verbose=False,
@@ -156,11 +162,15 @@ def cpu_gemm_native(alpha: float, beta: float, a: np.ndarray, b: np.ndarray,
     a = np.ascontiguousarray(a, dtype=np.float32)
     b = np.ascontiguousarray(b, dtype=np.float32)
     out = np.array(c, dtype=np.float32, copy=True)
+    m, k = a.shape
+    kb, n = b.shape
+    if k != kb or out.shape != (m, n):
+        raise ValueError(
+            f"cpu_gemm_native: incompatible shapes A{a.shape} B{b.shape}"
+            f" C{out.shape}")
     if lib is None:
         from ft_sgemm_tpu.ops.reference import cpu_gemm
         return cpu_gemm(alpha, beta, a, b, out)
-    m, k = a.shape
-    _, n = b.shape
     lib.ftsg_cpu_gemm(alpha, beta, _f32p(a), _f32p(b), _f32p(out), m, n, k)
     return out
 

@@ -1,0 +1,80 @@
+"""Tile-size sweep on real TPU: find the fastest (bm, bn, bk) per kernel.
+
+Sweeps the plain and fused-ABFT (weighted + rowcol) kernels at M=N=K=4096
+and prints GFLOPS per candidate block tile, sorted. Used to pick the
+shipped SHAPES; not part of the package surface.
+
+Usage: python scripts/tune_tiles.py [size] [--ft] [--rowcol]
+"""
+
+import sys
+
+import numpy as np
+import jax
+
+sys.path.insert(0, ".")
+
+from ft_sgemm_tpu.configs import KernelShape  # noqa: E402
+from ft_sgemm_tpu.injection import InjectionSpec  # noqa: E402
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm  # noqa: E402
+from ft_sgemm_tpu.ops.sgemm import make_sgemm  # noqa: E402
+from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
+from ft_sgemm_tpu.utils.timing import bench_seconds_per_call  # noqa: E402
+
+SIZE = 4096
+CANDIDATES = [
+    (512, 512, 256),
+    (512, 512, 512),
+    (512, 1024, 256),
+    (512, 1024, 512),
+    (512, 768, 256),
+    (768, 512, 256),
+    (512, 1536, 256),
+    (384, 1024, 256),
+    (256, 1024, 512),
+    (512, 768, 512),
+    (768, 512, 512),
+    (384, 512, 512),
+]
+
+
+def main():
+    size = SIZE
+    for tok in sys.argv[1:]:
+        if tok.isdigit():
+            size = int(tok)
+    do_ft = "--ft" in sys.argv
+    do_rowcol = "--rowcol" in sys.argv
+
+    rng = np.random.default_rng(10)
+    a = jax.device_put(generate_random_matrix(size, size, rng=rng))
+    b = jax.device_put(generate_random_matrix(size, size, rng=rng))
+    c = jax.device_put(generate_random_matrix(size, size, rng=rng))
+    flop = 2.0 * size**3
+
+    results = []
+    for bm, bn, bk in CANDIDATES:
+        shape = KernelShape(f"t{bm}x{bn}x{bk}", bm, bn, bk, (0,) * 7)
+        try:
+            if do_ft or do_rowcol:
+                strat = "rowcol" if do_rowcol else "weighted"
+                inj = InjectionSpec.reference_like(size, bk)
+                ft = make_ft_sgemm(shape, alpha=1.0, beta=-1.5, strategy=strat)
+                fn = lambda a, b, x: ft(a, b, x, inj).c  # noqa: E731
+            else:
+                fn = make_sgemm(shape, alpha=1.0, beta=-1.5)
+            sec = bench_seconds_per_call(fn, a, b, c, min_device_time=1.0)
+            gf = flop / 1e9 / sec
+        except Exception as e:  # noqa: BLE001 - sweep must survive bad tiles
+            print(f"{shape.name:18s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+            continue
+        results.append((gf, shape.name))
+        print(f"{shape.name:18s} {gf:9.1f} GFLOPS", flush=True)
+
+    print("\nbest first:")
+    for gf, name in sorted(results, reverse=True):
+        print(f"  {name:18s} {gf:9.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,3 +39,13 @@ def test_main_argv_contract():
     assert cli.main(["ft_sgemm", "1", "2"]) == 2
     assert cli.main(["ft_sgemm", "128", "128", "128", "11", "11",
                      "--no-perf"]) == 0
+
+
+def test_trace_flag_writes_profile(tmp_path):
+    trace_dir = tmp_path / "trace"
+    rc = cli.main(["ft_sgemm", "128", "128", "128", "0", "0", "--no-verify",
+                   f"--trace={trace_dir}", "--mintime=0.01"])
+    assert rc == 0
+    # jax.profiler.trace writes a plugins/profile/<ts>/ tree under the dir.
+    files = list(trace_dir.rglob("*"))
+    assert any(f.is_file() for f in files), files

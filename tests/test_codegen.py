@@ -2,6 +2,8 @@
 
 import io
 
+import pytest
+
 from ft_sgemm_tpu.codegen import gen
 
 
@@ -30,3 +32,14 @@ def test_main_argv(tmp_path):
     assert (tmp_path / "sgemm_huge.txt").exists()
     assert gen.main(["gen", "bogus"]) == 2
     assert gen.main(["gen"]) == 2
+
+
+def test_cli_rejects_partial_mnk_and_bad_flags():
+    # Lives here (not test_runtime.py) so it runs even without a native
+    # toolchain: it only exercises argv parsing. Bad numeric input follows
+    # the same message-and-exit-2 contract as every other argv error.
+    assert gen.main(["gen", "huge", "1", "512"]) == 2
+    assert gen.main(["gen", "huge", "yes"]) == 2
+    assert gen.main(["gen", "huge", "1", "512", "512", "big"]) == 2
+    assert gen.main(["gen", "--help"]) == 0
+    assert gen.main(["gen", "--bogus-flag"]) == 2

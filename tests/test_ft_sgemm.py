@@ -156,6 +156,27 @@ def test_expected_faults_counts_padded_k_grid():
     assert ok, f"{nbad} corrupted elements survived"
 
 
+def test_weighted_deep_k_wraps_column_cycle():
+    # Regression: with nk > bn, a single deferred check would see two
+    # faults in the SAME column (the rotating target wraps mod bn) and
+    # the weighted ratio would localize a wrong row. The wrapper clamps
+    # the cadence to bn*every so each check's faults stay in distinct
+    # columns.
+    m = n = 128
+    k = 128 * 130  # nk = 130 > bn = 128 for the "small" shape (bk=128)
+    rng = np.random.default_rng(23)
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm("small", alpha=ALPHA, beta=BETA, strategy="weighted")
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the wrapped column cycle"
+    assert int(res.num_detected) == 130
+
+
 def test_rectangular_with_padding_and_injection():
     a, b, c = _inputs(300, 200, 520, seed=13)
     shape = SHAPES["medium"]

@@ -62,15 +62,6 @@ def test_checksum_residual_native_oracle():
     assert abs(r - 100.0) < 1e-2 and abs(cl - 100.0) < 1e-2
 
 
-def test_codegen_rejects_partial_mnk(capsys):
-    from ft_sgemm_tpu.codegen import gen
-    import pytest as _pytest
-    with _pytest.raises(SystemExit):
-        gen.main(["gen", "huge", "1", "512"])
-    assert gen.main(["gen", "--help"]) == 0
-    assert gen.main(["gen", "--bogus-flag"]) == 2
-
-
 def test_native_cpu_gemm_matches_numpy():
     rng = np.random.default_rng(1)
     a = rng.normal(size=(17, 23)).astype(np.float32)
