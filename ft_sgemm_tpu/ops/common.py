@@ -16,6 +16,37 @@ def should_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
+def resolve_in_dtype(in_dtype, precision: str):
+    """Validate an ``in_dtype`` and resolve the dot precision to use with it.
+
+    Returns ``(dtype, precision)``. bf16 operands force ``"default"``
+    precision: Mosaic rejects fp32 contract precision on bf16 vectors ("Bad
+    lhs type"), and bf16 inputs are single-pass on the MXU anyway.
+    """
+    dt = jnp.dtype(in_dtype)
+    if dt not in (jnp.float32, jnp.bfloat16):
+        raise ValueError(f"in_dtype must be float32 or bfloat16, got {dt}")
+    return dt, ("default" if dt == jnp.bfloat16 else precision)
+
+
+def dtype_suffix(in_dtype) -> str:
+    """Kernel-name suffix for a non-default input dtype ('' for f32)."""
+    dt = jnp.dtype(in_dtype)
+    return "" if dt == jnp.float32 else f"_{dt.name}"
+
+
+def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int):
+    """FLOPs / bytes for one ``C = alpha*A@B.T + beta*C`` pass: A and B at
+    their input width, C read+written in f32."""
+    import jax.experimental.pallas as pl
+
+    return pl.CostEstimate(
+        flops=2 * m * n * k,
+        bytes_accessed=in_itemsize * (m * k + n * k) + 4 * 2 * m * n,
+        transcendentals=0,
+    )
+
+
 def pad_to(x: jax.Array, row_mult: int, col_mult: int) -> jax.Array:
     """Zero-pad a 2-D array up to multiples of (row_mult, col_mult).
 

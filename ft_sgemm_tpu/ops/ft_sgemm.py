@@ -57,8 +57,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu.configs import SHAPES, KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
-from ft_sgemm_tpu.ops.common import pad_to as _pad_to
-from ft_sgemm_tpu.ops.common import should_interpret as _should_interpret
+from ft_sgemm_tpu.ops.common import (
+    dtype_suffix as _dtype_suffix,
+    gemm_cost_estimate as _gemm_cost_estimate,
+    pad_to as _pad_to,
+    resolve_in_dtype as _resolve_in_dtype,
+    should_interpret as _should_interpret,
+)
 
 STRATEGIES = ("rowcol", "global", "weighted")
 
@@ -149,11 +154,16 @@ def _ft_kernel_rowcol(
     )
 
     # VPU: panel input checksums (replaces __shfl_xor butterflies) and
-    # expected row/col sums of the accumulated product.
-    s_b = jnp.sum(b_blk, axis=0, keepdims=True)            # (1, bk)
-    s_a = jnp.sum(a_blk, axis=0, keepdims=True)            # (1, bk)
-    r_exp_ref[:] += jnp.sum(a_blk * s_b, axis=1, keepdims=True)  # (bm, 1)
-    c_exp_ref[:] += jnp.sum(b_blk * s_a, axis=1, keepdims=True)  # (bn, 1)
+    # expected row/col sums of the accumulated product. Always f32: for bf16
+    # inputs the checksums are computed on the same rounded values the MXU
+    # consumes, so input rounding cancels out of the residual and only f32
+    # accumulation-order noise remains (same class as the f32 path).
+    af = a_blk.astype(jnp.float32)
+    bf = b_blk.astype(jnp.float32)
+    s_b = jnp.sum(bf, axis=0, keepdims=True)               # (1, bk)
+    s_a = jnp.sum(af, axis=0, keepdims=True)               # (1, bk)
+    r_exp_ref[:] += jnp.sum(af * s_b, axis=1, keepdims=True)     # (bm, 1)
+    c_exp_ref[:] += jnp.sum(bf * s_a, axis=1, keepdims=True)     # (bn, 1)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -211,9 +221,10 @@ def _ft_kernel_global(
         preferred_element_type=jnp.float32,
         precision=prec,
     )
-    s_b = jnp.sum(b_blk, axis=0, keepdims=True)             # (1, bk)
+    s_b = jnp.sum(b_blk.astype(jnp.float32), axis=0, keepdims=True)  # (1, bk)
     # Total expected sum of this panel's product: sum_k s_a[k] * s_b[k].
-    t_exp_ref[0] += jnp.sum(jnp.sum(a_blk, axis=0, keepdims=True) * s_b)
+    t_exp_ref[0] += jnp.sum(
+        jnp.sum(a_blk.astype(jnp.float32), axis=0, keepdims=True) * s_b)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -265,10 +276,12 @@ def _ft_kernel_weighted(
         preferred_element_type=jnp.float32,
         precision=prec,
     )
-    s_a = jnp.sum(a_blk, axis=0, keepdims=True)              # (1, bk)
-    s_aw = jnp.sum(a_blk * w_col, axis=0, keepdims=True)     # (1, bk)
-    c_exp_ref[:] += jnp.sum(b_blk * s_a, axis=1, keepdims=True)    # (bn, 1)
-    cw_exp_ref[:] += jnp.sum(b_blk * s_aw, axis=1, keepdims=True)  # (bn, 1)
+    af = a_blk.astype(jnp.float32)
+    bf = b_blk.astype(jnp.float32)
+    s_a = jnp.sum(af, axis=0, keepdims=True)                 # (1, bk)
+    s_aw = jnp.sum(af * w_col, axis=0, keepdims=True)        # (1, bk)
+    c_exp_ref[:] += jnp.sum(bf * s_a, axis=1, keepdims=True)       # (bn, 1)
+    cw_exp_ref[:] += jnp.sum(bf * s_aw, axis=1, keepdims=True)     # (bn, 1)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -340,9 +353,6 @@ def _ft_sgemm_padded(
         threshold=threshold, check_every=check_every, bm=bm, bn=bn,
     )
 
-    flops = 2 * m * n * k
-    bytes_accessed = 4 * (m * k + n * k + 2 * m * n)
-
     out, det = pl.pallas_call(
         kernel,
         grid=(gm, gn, nk),
@@ -366,9 +376,7 @@ def _ft_sgemm_padded(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=flops, bytes_accessed=bytes_accessed, transcendentals=0
-        ),
+        cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
     )(inj, a, b, c)
     return out, det
@@ -383,6 +391,7 @@ def make_ft_sgemm(
     threshold: float = REFERENCE_THRESHOLD,
     check_every: Optional[int] = None,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ):
     """Build the fused-ABFT SGEMM for one named shape.
@@ -398,17 +407,24 @@ def make_ft_sgemm(
     fault per interval (the reference has the same property and guarantees
     it by construction: it checks exactly where it injects,
     ``code_gen.py:333-337``).
+
+    ``in_dtype="bfloat16"`` feeds A/B to the MXU at its full-rate bf16 input
+    format; the accumulator, checksums, and detect/correct math all stay
+    f32. Checksums are computed on the bf16-rounded values the MXU actually
+    consumes, so the residual noise floor is unchanged from the f32 path and
+    the same thresholds apply.
     """
     if isinstance(shape, str):
         shape = SHAPES[shape]
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
     bm, bn, bk = shape.block
+    in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
 
     def fn(a, b, c, inject: Optional[InjectionSpec] = None) -> FtSgemmResult:
         inject = inject or InjectionSpec.none()
-        a = jnp.asarray(a, jnp.float32)
-        b = jnp.asarray(b, jnp.float32)
+        a = jnp.asarray(a, in_dtype)
+        b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
         ap = _pad_to(a, bm, bk)
@@ -440,9 +456,10 @@ def make_ft_sgemm(
         )
         return FtSgemmResult(out[:m, :n], det)
 
-    fn.__name__ = f"ft_sgemm_{shape.name}_{strategy}"
+    fn.__name__ = f"ft_sgemm_{shape.name}_{strategy}" + _dtype_suffix(in_dtype)
     fn.shape_config = shape
     fn.strategy = strategy
+    fn.in_dtype = in_dtype
     return fn
 
 
@@ -450,9 +467,11 @@ def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              beta=-1.5, inject: Optional[InjectionSpec] = None,
              strategy: str = "rowcol", threshold: float = REFERENCE_THRESHOLD,
              check_every: Optional[int] = None, precision: str = "highest",
+             in_dtype: str = "float32",
              interpret: Optional[bool] = None) -> FtSgemmResult:
     """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
     return make_ft_sgemm(
         shape, alpha=alpha, beta=beta, strategy=strategy, threshold=threshold,
-        check_every=check_every, precision=precision, interpret=interpret,
+        check_every=check_every, precision=precision, in_dtype=in_dtype,
+        interpret=interpret,
     )(a, b, c, inject)

@@ -17,6 +17,14 @@ scratch across the K grid dimension, and apply the alpha/beta epilogue on
 the last K step. Semantics match the reference's verification target:
 ``C = alpha * A @ B.T + beta * C`` with A (M, K), B (N, K)
 (``sgemm.cu:108``: ``cublasSgemm(OP_N, OP_T)``).
+
+Beyond reference parity, the family carries an ``in_dtype`` axis the CUDA
+reference has no analog for: with ``in_dtype="bfloat16"`` the A/B tiles are
+fed to the MXU in its native bf16 input format (accumulation stays f32) —
+the systolic array's full-rate path. A bf16 x bf16 product is exact in f32
+(8-bit mantissas => 16-bit product), so the only accuracy loss vs SGEMM is
+the one-time input rounding; accumulation error is identical to the f32
+path.
 """
 
 from __future__ import annotations
@@ -30,8 +38,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu.configs import SHAPES, KernelShape
-from ft_sgemm_tpu.ops.common import pad_to as _pad_to
-from ft_sgemm_tpu.ops.common import should_interpret as _should_interpret
+from ft_sgemm_tpu.ops.common import (
+    dtype_suffix as _dtype_suffix,
+    gemm_cost_estimate as _gemm_cost_estimate,
+    pad_to as _pad_to,
+    resolve_in_dtype as _resolve_in_dtype,
+    should_interpret as _should_interpret,
+)
 
 
 def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, acc_ref, *, alpha, beta, nk, prec):
@@ -67,9 +80,6 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
     grid = (m // bm, n // bn, nk)
     prec = jax.lax.Precision(precision)
 
-    flops = 2 * m * n * k
-    bytes_accessed = 4 * (m * k + n * k + 2 * m * n)
-
     return pl.pallas_call(
         functools.partial(
             _matmul_kernel, alpha=alpha, beta=beta, nk=nk, prec=prec
@@ -86,9 +96,7 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=flops, bytes_accessed=bytes_accessed, transcendentals=0
-        ),
+        cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
     )(a, b, c)
 
@@ -99,6 +107,7 @@ def make_sgemm(
     alpha: float = 1.0,
     beta: float = -1.5,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ):
     """Build the plain SGEMM for one named shape.
@@ -106,14 +115,20 @@ def make_sgemm(
     Returns ``fn(a, b, c) -> C`` with ``C = alpha*A@B.T + beta*C``; inputs of
     any (M, K)/(N, K)/(M, N) shapes — zero-padded up to the block tile, which
     leaves results exact (padded rows/cols are sliced off).
+
+    ``in_dtype="bfloat16"`` feeds A/B to the MXU in bf16 (full-rate path);
+    C and the accumulator stay f32. ``precision`` only applies to f32 inputs
+    (XLA splits f32 operands into bf16 passes per the precision level; bf16
+    operands are already single-pass).
     """
     if isinstance(shape, str):
         shape = SHAPES[shape]
     bm, bn, bk = shape.block
+    in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
 
     def fn(a, b, c):
-        a = jnp.asarray(a, jnp.float32)
-        b = jnp.asarray(b, jnp.float32)
+        a = jnp.asarray(a, in_dtype)
+        b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
         ap = _pad_to(a, bm, bk)
@@ -126,14 +141,16 @@ def make_sgemm(
         )
         return out[:m, :n]
 
-    fn.__name__ = f"sgemm_{shape.name}"
+    fn.__name__ = f"sgemm_{shape.name}" + _dtype_suffix(in_dtype)
     fn.shape_config = shape
+    fn.in_dtype = in_dtype
     return fn
 
 
 def sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0, beta=-1.5,
-          precision="highest", interpret=None):
+          precision="highest", in_dtype="float32", interpret=None):
     """One-shot plain SGEMM (see :func:`make_sgemm`)."""
     return make_sgemm(
-        shape, alpha=alpha, beta=beta, precision=precision, interpret=interpret
+        shape, alpha=alpha, beta=beta, precision=precision, in_dtype=in_dtype,
+        interpret=interpret
     )(a, b, c)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ft_sgemm_tpu.configs import SHAPES, KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
 from ft_sgemm_tpu.parallel.sharded import shard_map
@@ -69,6 +70,7 @@ def ring_ft_sgemm(
     strategy: str = "rowcol",
     threshold: float = REFERENCE_THRESHOLD,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` as a ring collective matmul.
@@ -80,8 +82,12 @@ def ring_ft_sgemm(
     if isinstance(shape, str):
         shape = SHAPES[shape]
     inject = inject or InjectionSpec.none()
-    a = jnp.asarray(a, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+    # Cast once before sharding: a bf16 B shard crosses the ICI ring at half
+    # the bytes per ppermute hop, and the stationary A shard is not re-cast
+    # on every one of the d hops.
+    cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
     c = jnp.asarray(c, jnp.float32)
     (m, k), (n, _) = a.shape, b.shape
     d = mesh.shape["x"]
@@ -91,7 +97,7 @@ def ring_ft_sgemm(
 
     local_ft = make_ft_sgemm(
         shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
-        precision=precision, interpret=interpret,
+        precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
     perm = [(i, (i + 1) % d) for i in range(d)]  # shift shards up the ring
 
@@ -140,13 +146,15 @@ def ring_sgemm(
     alpha: float = 1.0,
     beta: float = -1.5,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Plain (non-FT) ring collective matmul with the same layout."""
     if isinstance(shape, str):
         shape = SHAPES[shape]
-    a = jnp.asarray(a, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+    cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
     c = jnp.asarray(c, jnp.float32)
     (m, k), (n, _) = a.shape, b.shape
     d = mesh.shape["x"]
@@ -155,7 +163,7 @@ def ring_sgemm(
     nb = n // d
 
     local = make_sgemm(shape, alpha=1.0, beta=0.0, precision=precision,
-                       interpret=interpret)
+                       in_dtype=in_dtype, interpret=interpret)
     perm = [(i, (i + 1) % d) for i in range(d)]
 
     def step_fn(a_loc, b_loc, c_loc):

@@ -39,6 +39,7 @@ def shard_map(f, *, mesh, in_specs, out_specs):
 
 from ft_sgemm_tpu.configs import SHAPES, KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
 
@@ -83,6 +84,8 @@ def sharded_ft_sgemm(
     strategy: str = "rowcol",
     threshold: float = REFERENCE_THRESHOLD,
     precision: str = "highest",
+    in_dtype: str = "float32",
+    scatter_output: bool = False,
     interpret: Optional[bool] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a 2-D device mesh.
@@ -91,38 +94,57 @@ def sharded_ft_sgemm(
     C (M, N) -> P("x", None). Each device corrects its own K-partial
     locally, then partials ``psum`` over ``y`` and detection counts ``psum``
     over the whole mesh.
+
+    ``scatter_output=True`` replaces the ``psum`` with a ``psum_scatter``
+    over ``y`` (a reduce-scatter on the ICI ring): the output lands sharded
+    P("x", "y") — N split over ``y`` — so no device ever materializes full C
+    rows and the per-device output working set drops by the ``y`` factor.
+    This is the memory-scaling layout for outputs that feed further sharded
+    computation; the returned array is still the assembled global C (XLA
+    keeps it sharded until the caller forces it).
     """
     if isinstance(shape, str):
         shape = SHAPES[shape]
     inject = inject or InjectionSpec.none()
-    a = jnp.asarray(a, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+    # Cast A/B once BEFORE sharding: bf16 shards then move over ICI at half
+    # the bytes and the per-device kernels skip a per-call (ring: per-hop)
+    # re-cast.
+    cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
     c = jnp.asarray(c, jnp.float32)
     (m, k), (n, _) = a.shape, b.shape
     mx, my = mesh.shape["x"], mesh.shape["y"]
     _check_divisible("M", m, mx)
     _check_divisible("K", k, my)
+    if scatter_output:
+        _check_divisible("N", n, my)
 
     # Local kernel computes the raw K-partial (alpha/beta applied after the
     # psum, once, by the wrapper).
     local_ft = make_ft_sgemm(
         shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
-        precision=precision, interpret=interpret,
+        precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
 
     def step(a_loc, b_loc, c_loc):
         zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
         res = local_ft(a_loc, b_loc, zeros, inject)
-        partial = jax.lax.psum(res.c, "y")
+        if scatter_output:
+            partial = jax.lax.psum_scatter(
+                res.c, "y", scatter_dimension=1, tiled=True)
+        else:
+            partial = jax.lax.psum(res.c, "y")
         out = alpha * partial + beta * c_loc
         det = jax.lax.psum(jax.lax.psum(res.detections, "y"), "x")
         return out, det
 
+    c_spec = P("x", "y") if scatter_output else P("x", None)
     fn = shard_map(
         step,
         mesh=mesh,
-        in_specs=(P("x", "y"), P(None, "y"), P("x", None)),
-        out_specs=(P("x", None), P(None, None)),
+        in_specs=(P("x", "y"), P(None, "y"), c_spec),
+        out_specs=(c_spec, P(None, None)),
     )
     out, det = jax.jit(fn)(a, b, c)
     return FtSgemmResult(out, det)
@@ -138,20 +160,22 @@ def sharded_sgemm(
     alpha: float = 1.0,
     beta: float = -1.5,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Plain (non-FT) mesh-sharded SGEMM with the same layout."""
     if isinstance(shape, str):
         shape = SHAPES[shape]
-    a = jnp.asarray(a, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+    cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
     c = jnp.asarray(c, jnp.float32)
     mx, my = mesh.shape["x"], mesh.shape["y"]
     _check_divisible("M", a.shape[0], mx)
     _check_divisible("K", a.shape[1], my)
 
     local = make_sgemm(shape, alpha=1.0, beta=0.0, precision=precision,
-                       interpret=interpret)
+                       in_dtype=in_dtype, interpret=interpret)
 
     def step(a_loc, b_loc, c_loc):
         zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)

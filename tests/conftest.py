@@ -33,3 +33,17 @@ assert len(jax.devices()) == 8, jax.devices()
 @pytest.fixture
 def rng():
     return np.random.default_rng(10)
+
+
+def bf16_rounded_oracle(a, b, c, alpha=1.0, beta=-1.5):
+    """f32 XLA-dot reference over bf16-rounded A/B — the exact semantics of
+    the ``in_dtype="bfloat16"`` kernel path (a bf16 x bf16 product is exact
+    in f32, so rounding the inputs once captures the entire precision
+    difference; what remains is accumulation-order noise)."""
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu.ops.reference import sgemm_reference
+
+    ar = np.asarray(jnp.asarray(a, jnp.bfloat16).astype(jnp.float32))
+    br = np.asarray(jnp.asarray(b, jnp.bfloat16).astype(jnp.float32))
+    return np.asarray(sgemm_reference(ar, br, c, alpha, beta))

@@ -1,0 +1,115 @@
+"""bf16 mixed-precision kernel family tests.
+
+The reference is f32-only (SGEMM); the TPU build adds an ``in_dtype`` axis:
+A/B feed the MXU in its native bf16 input format while the accumulator,
+checksums, and detect/correct math stay f32. The correctness oracle for the
+bf16 path is the f32 XLA dot over the *bf16-rounded* inputs — a bf16xbf16
+product is exact in f32, so rounding the inputs once captures the entire
+precision difference and the remaining error is accumulation-order noise.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import bf16_rounded_oracle
+
+from ft_sgemm_tpu import (
+    InjectionSpec,
+    SHAPES,
+    make_ft_sgemm,
+    make_sgemm,
+    sgemm_reference,
+)
+from ft_sgemm_tpu.ops.ft_sgemm import STRATEGIES
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+def _rounded_oracle(a, b, c):
+    return bf16_rounded_oracle(a, b, c, ALPHA, BETA)
+
+
+def test_bf16_plain_matches_rounded_oracle():
+    a, b, c = _inputs(256, 256, 512)
+    fn = make_sgemm("test", alpha=ALPHA, beta=BETA, in_dtype="bfloat16")
+    got = np.asarray(fn(a, b, c))
+    np.testing.assert_allclose(got, _rounded_oracle(a, b, c),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_bf16_plain_close_to_f32_reference():
+    # Input rounding dominates the bf16-vs-f32 gap; with the quantized
+    # +-{0,...,0.9} inputs it grows ~sqrt(K) and measures ~0.06 max-abs at
+    # K=512 — this pins the scale so regressions (e.g. accidental bf16
+    # accumulation, which would be ~100x worse) are caught.
+    a, b, c = _inputs(256, 256, 512, seed=3)
+    fn = make_sgemm("test", alpha=ALPHA, beta=BETA, in_dtype="bfloat16")
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(fn(a, b, c)), verbose=False,
+                                abs_tol=0.1, rel_tol=0.02)
+    assert ok, f"{nbad} elements outside the bf16 tolerance"
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_bf16_ft_clean_matches_bf16_plain(strategy):
+    a, b, c = _inputs(256, 256, 512, seed=4)
+    ft = make_ft_sgemm("test", alpha=ALPHA, beta=BETA, strategy=strategy,
+                       in_dtype="bfloat16")
+    plain = make_sgemm("test", alpha=ALPHA, beta=BETA, in_dtype="bfloat16")
+    res = ft(a, b, c)
+    np.testing.assert_allclose(np.asarray(res.c), np.asarray(plain(a, b, c)),
+                               rtol=1e-5, atol=1e-4)
+    assert int(res.num_detected) == 0
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "weighted"])
+def test_bf16_ft_corrects_injected_faults(strategy):
+    m = n = 256
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=5)
+    shape = SHAPES["test"]
+    inj = InjectionSpec.reference_like(k, shape.bk, num_faults=4)
+    ft = make_ft_sgemm("test", alpha=ALPHA, beta=BETA, strategy=strategy,
+                       in_dtype="bfloat16")
+    res = ft(a, b, c, inject=inj)
+    # Same threshold as f32: checksums see the rounded inputs, so the
+    # noise floor is unchanged and reference-magnitude faults are caught.
+    want = _rounded_oracle(a, b, c)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{strategy}/bf16: {nbad} corrupted elements survived"
+    tiles = (m // shape.bm) * (n // shape.bn)
+    assert int(res.num_detected) == tiles * inj.expected_faults(k, shape.bk)
+
+
+def test_bf16_ft_global_detects():
+    m = n = 256
+    k = 512
+    a, b, c = _inputs(m, n, k, seed=6)
+    inj = InjectionSpec(enabled=True, every=k // SHAPES["test"].bk,
+                        magnitude=10000.0)
+    ft = make_ft_sgemm("test", alpha=ALPHA, beta=BETA, strategy="global",
+                       in_dtype="bfloat16")
+    res = ft(a, b, c, inject=inj)
+    assert int(res.num_detected) >= 1
+
+
+def test_in_dtype_validation():
+    with pytest.raises(ValueError, match="in_dtype"):
+        make_sgemm("test", in_dtype="float16")
+    with pytest.raises(ValueError, match="in_dtype"):
+        make_ft_sgemm("test", in_dtype="int8")
+
+
+def test_kernel_names_carry_dtype():
+    assert make_sgemm("test", in_dtype="bfloat16").__name__.endswith("bfloat16")
+    assert make_ft_sgemm("test").__name__ == "ft_sgemm_test_rowcol"

@@ -63,6 +63,43 @@ def test_sharded_ft_corrects_injected_faults_before_psum():
     assert int(res.num_detected) == 8
 
 
+def test_sharded_ft_scatter_output_matches_psum_path():
+    # reduce-scatter layout: same math, output lands sharded P("x", "y").
+    mesh = make_mesh(8)  # 2 x 4
+    m, n, k = 256, 512, 512  # N/4 = 128 per device along y
+    a, b, c = _inputs(m, n, k, seed=7)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    scat = sharded_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
+                            inject=inj, scatter_output=True)
+    full = sharded_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
+                            inject=inj)
+    np.testing.assert_allclose(np.asarray(scat.c), np.asarray(full.c),
+                               rtol=1e-5, atol=1e-5)
+    assert int(scat.num_detected) == int(full.num_detected) > 0
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(scat.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the reduce-scatter"
+
+
+def test_sharded_scatter_rejects_indivisible_n():
+    mesh = make_mesh(8)  # y = 4
+    a, b, c = _inputs(256, 130, 512)  # 130 % 4 != 0
+    with pytest.raises(ValueError, match="divide evenly"):
+        sharded_ft_sgemm(a, b, c, mesh, TILE, scatter_output=True)
+
+
+def test_sharded_bf16_matches_rounded_oracle():
+    from conftest import bf16_rounded_oracle
+
+    mesh = make_mesh(8)
+    m, n, k = 256, 128, 512
+    a, b, c = _inputs(m, n, k, seed=8)
+    got = np.asarray(sharded_sgemm(a, b, c, mesh, TILE, alpha=ALPHA,
+                                   beta=BETA, in_dtype="bfloat16"))
+    want = bf16_rounded_oracle(a, b, c, ALPHA, BETA)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 def test_sharded_rejects_indivisible():
     mesh = make_mesh(8)
     a, b, c = _inputs(301, 128, 512)  # 301 % mesh_x(2) != 0
