@@ -26,6 +26,12 @@ has no tunnel overhead to cancel).
 Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
+        [--dtype=bfloat16]
+
+``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
+two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
+full-rate path, an axis the CUDA reference has no analog for. Verification
+then diffs against the XLA dot over the same bf16-rounded inputs.
 
 ``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
 analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
@@ -55,18 +61,21 @@ ALPHA = 1.0   # sgemm.cu:22
 BETA = -1.5   # sgemm.cu:24,234
 
 
-def _build_callable(kernel_id: int, size: int, inject_ft: bool):
+def _build_callable(kernel_id: int, size: int, inject_ft: bool,
+                    in_dtype: str = "float32"):
     """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
     name, shape, is_abft = kernel_for_id(kernel_id)
     if kernel_id == 0:
-        return lambda a, b, c: sgemm_reference(a, b, c, ALPHA, BETA)
+        return lambda a, b, c: sgemm_reference(a, b, c, ALPHA, BETA,
+                                               in_dtype=in_dtype)
     if kernel_id == 10:
-        return lambda a, b, c: abft_baseline_sgemm(a, b, c, ALPHA, BETA).c
+        return lambda a, b, c: abft_baseline_sgemm(a, b, c, ALPHA, BETA,
+                                                   in_dtype=in_dtype).c
     if not is_abft:
-        return make_sgemm(shape, alpha=ALPHA, beta=BETA)
+        return make_sgemm(shape, alpha=ALPHA, beta=BETA, in_dtype=in_dtype)
     inj = (InjectionSpec.reference_like(size, shape.bk)
            if inject_ft else InjectionSpec.none())
-    ft = make_ft_sgemm(shape, alpha=ALPHA, beta=BETA)
+    ft = make_ft_sgemm(shape, alpha=ALPHA, beta=BETA, in_dtype=in_dtype)
     return lambda a, b, c: ft(a, b, c, inj).c
 
 
@@ -86,20 +95,22 @@ def _host_inputs(size: int):
 
 
 def run_verification(end_size: int, st_kernel: int, end_kernel: int,
-                     out=sys.stdout) -> bool:
-    """Pass 1: diff every selected kernel against the XLA oracle."""
+                     out=sys.stdout, in_dtype: str = "float32") -> bool:
+    """Pass 1: diff every selected kernel against the XLA oracle (for bf16
+    mode: the XLA dot over the same bf16-rounded inputs)."""
     rng = np.random.default_rng(10)  # srand(10), sgemm.cu:12
     a = generate_random_matrix(end_size, end_size, rng=rng)
     b = generate_random_matrix(end_size, end_size, rng=rng)
     c = np.zeros((end_size, end_size), np.float32)  # fill_vector(C,0)
 
-    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA, in_dtype=in_dtype))
     all_ok = True
     for kernel_id in sorted(KERNEL_TABLE):
         if kernel_id < st_kernel or kernel_id > end_kernel:
             continue
         name, _, _ = kernel_for_id(kernel_id)
-        fn = _build_callable(kernel_id, end_size, inject_ft=True)
+        fn = _build_callable(kernel_id, end_size, inject_ft=True,
+                             in_dtype=in_dtype)
         got = np.asarray(fn(a, b, c))
         ok, nbad, first = verify_matrix(want, got, verbose=False)
         status = "pass" if ok else f"FAIL ({nbad} bad, first at {first})"
@@ -111,7 +122,8 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
 
 def run_perf_table(start_size: int, end_size: int, gap_size: int,
                    st_kernel: int, end_kernel: int,
-                   min_device_time: float = 1.0, out=sys.stdout) -> dict:
+                   min_device_time: float = 1.0, out=sys.stdout,
+                   in_dtype: str = "float32") -> dict:
     """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439)."""
     sizes = list(range(start_size, end_size + 1, gap_size))
     print("################## Performance (GFLOPS) ########################",
@@ -131,7 +143,8 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
         for size in sizes:
             ah, bh, ch = _host_inputs(size)
             a, b, c = map(jax.device_put, (ah, bh, ch))
-            fn = _build_callable(kernel_id, size, inject_ft=True)
+            fn = _build_callable(kernel_id, size, inject_ft=True,
+                                 in_dtype=in_dtype)
             sec_per_rep = bench_seconds_per_call(
                 fn, a, b, c, min_device_time=min_device_time)
             gf = 2.0 * size**3 / 1e9 / sec_per_rep
@@ -158,15 +171,23 @@ def main(argv=None) -> int:
         return 2
     min_device_time = 1.0
     trace_dir = None
+    in_dtype = "float32"
     for f in flags:
         if f.startswith("--mintime="):
             min_device_time = float(f.split("=", 1)[1])
         elif f.startswith("--trace="):
             trace_dir = f.split("=", 1)[1]
+        elif f.startswith("--dtype="):
+            in_dtype = f.split("=", 1)[1]
+            if in_dtype not in ("float32", "bfloat16"):
+                print(f"--dtype must be float32 or bfloat16, got {in_dtype!r}",
+                      file=sys.stderr)
+                return 2
 
     ok = True
     if "--no-verify" not in flags:
-        ok = run_verification(end_size, st_kernel, end_kernel)
+        ok = run_verification(end_size, st_kernel, end_kernel,
+                              in_dtype=in_dtype)
     if "--no-perf" not in flags:
         import contextlib
 
@@ -174,7 +195,8 @@ def main(argv=None) -> int:
                else contextlib.nullcontext())
         with ctx:
             run_perf_table(start_size, end_size, gap_size, st_kernel,
-                           end_kernel, min_device_time=min_device_time)
+                           end_kernel, min_device_time=min_device_time,
+                           in_dtype=in_dtype)
     return 0 if ok else 1
 
 

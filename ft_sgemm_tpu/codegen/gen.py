@@ -18,6 +18,9 @@ Usage (mirrors main.py / gen.sh):
     python -m ft_sgemm_tpu.codegen.gen <shape> <if_abft> [M N K] [--out=DIR]
     python -m ft_sgemm_tpu.codegen.gen all            # the gen.sh loop
     python -m ft_sgemm_tpu.codegen.gen list           # the param table
+
+``--dtype=bfloat16`` lowers the bf16 input variants (suffix ``_bfloat16``
+in the artifact name) — an axis the CUDA generator has no analog for.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ft_sgemm_tpu.configs import SHAPES, SHAPE_ORDER
+from ft_sgemm_tpu.ops.common import dtype_suffix
 from ft_sgemm_tpu.injection import InjectionSpec
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
@@ -37,17 +41,20 @@ DEFAULT_OUT = pathlib.Path("generated")
 DEFAULT_MNK = (1024, 1024, 1024)
 
 
-def variant_name(shape_name: str, if_abft: bool) -> str:
-    return f"{'ft_' if if_abft else ''}sgemm_{shape_name}"
+def variant_name(shape_name: str, if_abft: bool,
+                 in_dtype: str = "float32") -> str:
+    return f"{'ft_' if if_abft else ''}sgemm_{shape_name}{dtype_suffix(in_dtype)}"
 
 
-def lower_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int):
+def lower_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
+                  in_dtype: str = "float32"):
     """Build + lower one kernel variant; returns (jaxpr text, lowered text)."""
     if if_abft:
-        kfn = make_ft_sgemm(shape_name)
+        kfn = make_ft_sgemm(shape_name, in_dtype=in_dtype)
         fn = lambda a, b, c: kfn(a, b, c, InjectionSpec.none()).c  # noqa: E731
     else:
-        fn = make_sgemm(shape_name)
+        fn = make_sgemm(shape_name, in_dtype=in_dtype)
+    # a/b enter as f32 and are cast inside fn — matches the CLI/user path.
     args = (
         jax.ShapeDtypeStruct((m, k), jnp.float32),
         jax.ShapeDtypeStruct((n, k), jnp.float32),
@@ -59,9 +66,10 @@ def lower_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int):
 
 
 def dump_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
-                 out_dir: pathlib.Path) -> pathlib.Path:
-    name = variant_name(shape_name, if_abft)
-    jaxpr, lowered = lower_variant(shape_name, if_abft, m, n, k)
+                 out_dir: pathlib.Path,
+                 in_dtype: str = "float32") -> pathlib.Path:
+    name = variant_name(shape_name, if_abft, in_dtype)
+    jaxpr, lowered = lower_variant(shape_name, if_abft, m, n, k, in_dtype)
     out_dir.mkdir(parents=True, exist_ok=True)
     path = out_dir / f"{name}.txt"
     shape = SHAPES[shape_name]
@@ -69,7 +77,7 @@ def dump_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
         f"// {name}: Pallas TPU kernel variant (M,N,K)=({m},{n},{k})\n"
         f"// block tile (bm,bn,bk)={shape.block}"
         f"  reference params {shape.ref_params}\n"
-        f"// backend={jax.default_backend()}\n"
+        f"// in_dtype={in_dtype}  backend={jax.default_backend()}\n"
     )
     path.write_text(
         header
@@ -113,11 +121,18 @@ def main(argv=None) -> int:
         return 0
     args = []
     out_dir = DEFAULT_OUT
+    in_dtype = "float32"
     for tok in argv[1:]:
         if tok.startswith("--out="):
             out_dir = pathlib.Path(tok.split("=", 1)[1])
+        elif tok.startswith("--dtype="):
+            in_dtype = tok.split("=", 1)[1]
+            if in_dtype not in ("float32", "bfloat16"):
+                print(f"--dtype must be float32 or bfloat16, got {in_dtype!r}",
+                      file=sys.stderr)
+                return 2
         elif tok.startswith("--"):
-            print(f"unknown flag {tok!r} (flags use --out=DIR form)",
+            print(f"unknown flag {tok!r} (--out=DIR, --dtype=DTYPE)",
                   file=sys.stderr)
             return 2
         else:
@@ -133,7 +148,8 @@ def main(argv=None) -> int:
             m, n, k = _parse_mnk(args[1:], "all")
             for if_abft in (False, True):  # gen.sh order: plain 6, then ft 6
                 for name in SHAPE_ORDER:
-                    path = dump_variant(name, if_abft, m, n, k, out_dir)
+                    path = dump_variant(name, if_abft, m, n, k, out_dir,
+                                        in_dtype)
                     print(f"wrote {path}")
             return 0
         shape_name = args[0]
@@ -156,7 +172,7 @@ def main(argv=None) -> int:
     except _UsageError as e:
         print(str(e), file=sys.stderr)
         return 2
-    path = dump_variant(shape_name, if_abft, m, n, k, out_dir)
+    path = dump_variant(shape_name, if_abft, m, n, k, out_dir, in_dtype)
     print(f"wrote {path}")
     return 0
 

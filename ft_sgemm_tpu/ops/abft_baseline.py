@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
 
 PANEL_K = 256  # reference K-panel width, baseline_ft_sgemm.cuh:4
 
@@ -50,6 +51,7 @@ def abft_baseline_sgemm(
     panel_k: int = PANEL_K,
     threshold: float = REFERENCE_THRESHOLD,
     precision: str = "highest",
+    in_dtype: str = "float32",
 ) -> AbftBaselineResult:
     """Two-pass checksum-verified ``C = alpha*A@B.T + beta*C``.
 
@@ -58,11 +60,15 @@ def abft_baseline_sgemm(
       inject: optional fault injection between pass 1 and pass 2 of each
         scheduled panel (``panel % every == 0``).
       panel_k: K-panel width (reference: 256). K is padded up to a multiple.
+      in_dtype: "bfloat16" runs the panel dots on bf16-rounded A/B (f32
+        accumulation); checksums are computed in f32 on the rounded values,
+        so the residual noise class is unchanged — same as the fused family.
     """
     inject = inject or InjectionSpec.none()
+    dt, precision = resolve_in_dtype(in_dtype, precision)
     return _abft_baseline_jit(
         a, b, c, alpha=alpha, beta=beta, panel_k=panel_k, threshold=threshold,
-        precision=precision, inj_enabled=inject.enabled,
+        precision=precision, in_dtype=dt.name, inj_enabled=inject.enabled,
         inj_every=inject.every, inj_magnitude=inject.magnitude,
     )
 
@@ -70,16 +76,16 @@ def abft_baseline_sgemm(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "alpha", "beta", "panel_k", "threshold", "precision",
+        "alpha", "beta", "panel_k", "threshold", "precision", "in_dtype",
         "inj_enabled", "inj_every", "inj_magnitude",
     ),
 )
 def _abft_baseline_jit(
-    a, b, c, *, alpha, beta, panel_k, threshold, precision,
+    a, b, c, *, alpha, beta, panel_k, threshold, precision, in_dtype,
     inj_enabled, inj_every, inj_magnitude,
 ) -> AbftBaselineResult:
-    a = a.astype(jnp.float32)
-    b = b.astype(jnp.float32)
+    a = a.astype(jnp.dtype(in_dtype))
+    b = b.astype(jnp.dtype(in_dtype))
     c = c.astype(jnp.float32)
     m, k = a.shape
     n, kb = b.shape
@@ -120,9 +126,13 @@ def _abft_baseline_jit(
             hit = (rows == i0) & (cols == j0) & do
             c_acc = c_acc + jnp.where(hit, jnp.float32(inj_magnitude), 0.0)
         # Input-side checksum update (cheap matvecs; reference's
-        # cublasSgemv over colsum(A_panel)/rowsum(B_panel)).
-        r_exp = r_exp + alpha * jnp.dot(ap, jnp.sum(bp, axis=0), precision=prec)
-        c_exp = c_exp + alpha * jnp.dot(bp, jnp.sum(ap, axis=0), precision=prec)
+        # cublasSgemv over colsum(A_panel)/rowsum(B_panel)). f32 over the
+        # (possibly bf16-rounded) panel values so residual noise stays in
+        # the f32 accumulation class.
+        apf = ap.astype(jnp.float32)
+        bpf = bp.astype(jnp.float32)
+        r_exp = r_exp + alpha * jnp.dot(apf, jnp.sum(bpf, axis=0), precision=prec)
+        c_exp = c_exp + alpha * jnp.dot(bpf, jnp.sum(apf, axis=0), precision=prec)
         # Pass 2: full re-read of C to recompute its checksums (this is the
         # non-fused cost the fused kernels eliminate).
         res_r = r_exp - jnp.sum(c_acc, axis=1)

@@ -15,9 +15,22 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
 
-@functools.partial(jax.jit, static_argnames=("precision",))
-def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest"):
+
+@functools.partial(jax.jit, static_argnames=("precision", "in_dtype"))
+def _sgemm_reference_jit(a, b, c, alpha, beta, *, precision, in_dtype):
+    out = jnp.dot(
+        a.astype(jnp.dtype(in_dtype)),
+        b.astype(jnp.dtype(in_dtype)).T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision(precision),
+    )
+    return alpha * out + beta * c.astype(jnp.float32)
+
+
+def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest",
+                    in_dtype="float32"):
     """``C = alpha * A @ B.T + beta * C`` via XLA's native dot.
 
     Args:
@@ -25,14 +38,12 @@ def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest"):
         matching the reference's OP_T operand layout. c: (M, N) f32.
       precision: lax matmul precision; "highest" keeps true-f32 MXU passes
         so the oracle matches f32 CUDA semantics.
+      in_dtype: "bfloat16" rounds A/B to bf16 before the dot (accumulation
+        stays f32) — the oracle for the kernels' bf16 input mode.
     """
-    out = jnp.dot(
-        a.astype(jnp.float32),
-        b.astype(jnp.float32).T,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision(precision),
-    )
-    return alpha * out + beta * c.astype(jnp.float32)
+    dt, precision = resolve_in_dtype(in_dtype, precision)
+    return _sgemm_reference_jit(a, b, c, alpha, beta, precision=precision,
+                                in_dtype=dt.name)
 
 
 def cpu_gemm(alpha, beta, a, b, c):

@@ -97,11 +97,12 @@ def sharded_ft_sgemm(
 
     ``scatter_output=True`` replaces the ``psum`` with a ``psum_scatter``
     over ``y`` (a reduce-scatter on the ICI ring): the output lands sharded
-    P("x", "y") — N split over ``y`` — so no device ever materializes full C
-    rows and the per-device output working set drops by the ``y`` factor.
-    This is the memory-scaling layout for outputs that feed further sharded
-    computation; the returned array is still the assembled global C (XLA
-    keeps it sharded until the caller forces it).
+    P("x", "y") — N split over ``y`` — so the post-reduction C buffer (and
+    the beta*C input) shrinks by the ``y`` factor per device. (The local
+    pre-reduction partial is still (M/x, N) — it feeds the reduce-scatter.)
+    This is the layout for outputs that feed further sharded computation;
+    the returned array is still the assembled global C (XLA keeps it
+    sharded until the caller forces it).
     """
     if isinstance(shape, str):
         shape = SHAPES[shape]

@@ -62,3 +62,19 @@ def test_baseline_small_fault_below_threshold_not_detected():
     # Residual sees the fault but stays below the reference 9500 threshold.
     assert not bool(res.detected)
     assert float(res.max_row_residual) > 50.0
+
+
+def test_baseline_bf16_clean_and_detects():
+    from conftest import bf16_rounded_oracle
+
+    a, b, c = _inputs(128, 96, 512, seed=7)
+    res = abft_baseline_sgemm(a, b, c, ALPHA, BETA, in_dtype="bfloat16")
+    want = bf16_rounded_oracle(a, b, c, ALPHA, BETA)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok and not bool(res.detected), f"{nbad} bad"
+    # Residual noise stays in the f32 accumulation class (checksums are
+    # computed on the rounded inputs), so the reference threshold still works.
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res2 = abft_baseline_sgemm(a, b, c, ALPHA, BETA, in_dtype="bfloat16",
+                               inject=inj)
+    assert bool(res2.detected)

@@ -41,6 +41,21 @@ def test_main_argv_contract():
                      "--no-perf"]) == 0
 
 
+def test_verification_pass_bf16_mode():
+    # --dtype=bfloat16: every row (vendor dot, plain, baseline, fused FT with
+    # injection on) verifies against the bf16-rounded oracle.
+    buf = io.StringIO()
+    ok = cli.run_verification(end_size=256, st_kernel=0, end_kernel=16,
+                              out=buf, in_dtype="bfloat16")
+    assert ok, buf.getvalue()
+    assert buf.getvalue().count(": pass") == 14
+
+
+def test_main_rejects_bad_dtype():
+    assert cli.main(["ft_sgemm", "128", "128", "128", "0", "0",
+                     "--dtype=float16"]) == 2
+
+
 def test_trace_flag_writes_profile(tmp_path):
     trace_dir = tmp_path / "trace"
     rc = cli.main(["ft_sgemm", "128", "128", "128", "0", "0", "--no-verify",

@@ -34,6 +34,17 @@ def test_main_argv(tmp_path):
     assert gen.main(["gen"]) == 2
 
 
+def test_dump_bf16_variant(tmp_path):
+    assert gen.main(["gen", "medium", "1", "256", "256", "256",
+                     "--dtype=bfloat16", f"--out={tmp_path}"]) == 0
+    path = tmp_path / "ft_sgemm_medium_bfloat16.txt"
+    assert path.exists()
+    text = path.read_text()
+    assert "in_dtype=bfloat16" in text
+    assert "bf16" in text  # the lowered StableHLO carries bf16 operand types
+    assert gen.main(["gen", "medium", "1", "--dtype=float16"]) == 2
+
+
 def test_cli_rejects_partial_mnk_and_bad_flags():
     # Lives here (not test_runtime.py) so it runs even without a native
     # toolchain: it only exercises argv parsing. Bad numeric input follows

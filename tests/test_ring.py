@@ -73,3 +73,18 @@ def test_ring_rejects_indivisible_shapes():
     a, b, c = _inputs(100, 100, 128)
     with pytest.raises(ValueError, match="divide evenly"):
         ring_sgemm(a, b, c, mesh, TILE)
+
+
+def test_ring_bf16_corrects_and_matches_rounded_oracle():
+    from conftest import bf16_rounded_oracle
+
+    mesh = make_ring_mesh(8)
+    m, n, k = 256, 512, 256
+    a, b, c = _inputs(m, n, k, seed=9)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = ring_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
+                        inject=inj, in_dtype="bfloat16")
+    want = bf16_rounded_oracle(a, b, c, ALPHA, BETA)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the bf16 ring"
+    assert int(res.num_detected) > 0
