@@ -39,7 +39,8 @@ from ft_sgemm_tpu.utils.matrices import verify_matrix
 
 
 def measure_noise_floor(a, b, c, *, alpha: float = 1.0, beta: float = -1.5,
-                        panel_k: int = 256, precision: str = "highest") -> float:
+                        panel_k: int = 256, precision: str = "highest",
+                        in_dtype: str = "float32") -> float:
     """Max |checksum residual| of a clean run on the given inputs.
 
     Uses the two-pass baseline (its residuals are observable outputs;
@@ -50,7 +51,7 @@ def measure_noise_floor(a, b, c, *, alpha: float = 1.0, beta: float = -1.5,
     """
     res = abft_baseline_sgemm(
         a, b, c, alpha, beta, panel_k=panel_k, precision=precision,
-        threshold=np.inf,
+        in_dtype=in_dtype, threshold=np.inf,
     )
     return float(max(res.max_row_residual, res.max_col_residual))
 
@@ -73,8 +74,8 @@ class ThresholdCalibration:
 
 
 def calibrate_threshold(a, b, c, *, alpha: float = 1.0, beta: float = -1.5,
-                        margin: float = 8.0, precision: str = "highest"
-                        ) -> ThresholdCalibration:
+                        margin: float = 8.0, precision: str = "highest",
+                        in_dtype: str = "float32") -> ThresholdCalibration:
     """Pick the smallest safe threshold for the given inputs.
 
     ``threshold = noise_floor * margin`` guards against run-to-run reduction
@@ -87,7 +88,7 @@ def calibrate_threshold(a, b, c, *, alpha: float = 1.0, beta: float = -1.5,
     K=6144 is O(1) while err_bound1=9500 (margin ~1e3).
     """
     floor = measure_noise_floor(a, b, c, alpha=alpha, beta=beta,
-                                precision=precision)
+                                precision=precision, in_dtype=in_dtype)
     thr = float(max(floor, np.finfo(np.float32).tiny) * margin)
     return ThresholdCalibration(
         noise_floor=floor, threshold=thr, min_detectable=2.0 * thr,
@@ -117,6 +118,7 @@ def detection_rate_sweep(
     beta: float = -1.5,
     num_faults: int = 4,
     precision: str = "highest",
+    in_dtype: str = "float32",
     interpret: Optional[bool] = None,
 ) -> list[DetectionPoint]:
     """Detection/correction behavior as fault magnitude sweeps the threshold.
@@ -133,17 +135,25 @@ def detection_rate_sweep(
     b = np.asarray(b, np.float32)
     c = np.asarray(c, np.float32)
     k = a.shape[1]
-    want = np.asarray(sgemm_reference(a, b, c, alpha, beta))
+    # Oracle matches the kernel's input mode (bf16-rounded for bf16).
+    want = np.asarray(sgemm_reference(a, b, c, alpha, beta,
+                                      in_dtype=in_dtype))
     ft = make_ft_sgemm(shape, alpha=alpha, beta=beta, strategy=strategy,
                        threshold=threshold, precision=precision,
-                       interpret=interpret)
+                       in_dtype=in_dtype, interpret=interpret)
+    # Fault accounting must follow the tile the kernel ACTUALLY runs: named
+    # shapes may swap to a dtype-tuned tile (configs.BF16_TILE_OVERRIDES)
+    # and oversized blocks shrink to the problem (ops.common.shrink_block).
+    from ft_sgemm_tpu.ops.common import shrink_block
+
+    eff = shrink_block(ft.shape_config, a.shape[0], b.shape[0], k)
     points = []
     for mag in magnitudes:
-        inj = InjectionSpec.reference_like(k, shape.bk, num_faults=num_faults,
+        inj = InjectionSpec.reference_like(k, eff.bk, num_faults=num_faults,
                                            magnitude=float(mag))
-        per_tile = inj.expected_faults(k, shape.bk)
-        grid_m = -(-a.shape[0] // shape.bm)
-        grid_n = -(-b.shape[0] // shape.bn)
+        per_tile = inj.expected_faults(k, eff.bk)
+        grid_m = -(-a.shape[0] // eff.bm)
+        grid_n = -(-b.shape[0] // eff.bn)
         expected = per_tile * grid_m * grid_n
         res = ft(a, b, c, inj)
         detected = int(res.num_detected)

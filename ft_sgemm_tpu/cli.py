@@ -71,11 +71,15 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     if kernel_id == 10:
         return lambda a, b, c: abft_baseline_sgemm(a, b, c, ALPHA, BETA,
                                                    in_dtype=in_dtype).c
+    # Pass the NAME (not the KernelShape object) so per-dtype tile
+    # overrides (configs.BF16_TILE_OVERRIDES) apply.
     if not is_abft:
-        return make_sgemm(shape, alpha=ALPHA, beta=BETA, in_dtype=in_dtype)
-    inj = (InjectionSpec.reference_like(size, shape.bk)
+        return make_sgemm(shape.name, alpha=ALPHA, beta=BETA,
+                          in_dtype=in_dtype)
+    ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA, in_dtype=in_dtype)
+    # Injection cadence follows the tile the kernel actually runs.
+    inj = (InjectionSpec.reference_like(size, ft.shape_config.bk)
            if inject_ft else InjectionSpec.none())
-    ft = make_ft_sgemm(shape, alpha=ALPHA, beta=BETA, in_dtype=in_dtype)
     return lambda a, b, c: ft(a, b, c, inj).c
 
 

@@ -78,6 +78,34 @@ SHAPES = {
 
 SHAPE_ORDER = ("small", "medium", "large", "tall", "wide", "huge")
 
+# bf16 input mode re-tunes the flagship tile (live-v5e sweep,
+# scripts/tune_tiles.py --bf16 [--ft], M=N=K=4096): halved A/B tile bytes
+# let the plain kernel go K-deep (512x512x2048, ~138 TFLOPS vs ~124 at the
+# f32 tile), while the fused-ABFT kernel prefers a wide tile
+# (512x1024x256, ~110 TFLOPS vs ~101) — wider bn amortizes the per-check
+# detect/correct reductions over more columns. Applied automatically when a
+# *named* shape is used with in_dtype="bfloat16"; explicit KernelShape
+# objects are always respected. Keyed by (shape name, is_ft).
+BF16_TILE_OVERRIDES = {
+    ("huge", False): (512, 512, 2048),
+    ("huge", True): (512, 1024, 256),
+}
+
+
+def shape_for_dtype(shape: KernelShape, is_ft: bool,
+                    in_dtype) -> KernelShape:
+    """Swap in the bf16-tuned tile for a named shape, when one exists."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    if jnp.dtype(in_dtype) != jnp.bfloat16:
+        return shape
+    tile = BF16_TILE_OVERRIDES.get((shape.name, is_ft))
+    if tile is None:
+        return shape
+    return dataclasses.replace(shape, bm=tile[0], bn=tile[1], bk=tile[2])
+
 # Kernel-id table, matching the driver's dispatch ladder and perf-table rows
 # (reference sgemm.cu:105-199 and sgemm.cu:235-237). Id 0 is the vendor
 # library (cuBLAS there, XLA's native dot here); ids 1-6 the plain shapes;

@@ -131,8 +131,12 @@ def _abft_baseline_jit(
         # the f32 accumulation class.
         apf = ap.astype(jnp.float32)
         bpf = bp.astype(jnp.float32)
-        r_exp = r_exp + alpha * jnp.dot(apf, jnp.sum(bpf, axis=0), precision=prec)
-        c_exp = c_exp + alpha * jnp.dot(bpf, jnp.sum(apf, axis=0), precision=prec)
+        # HIGHEST regardless of the panel-dot precision: these operands are
+        # f32 sums (not bf16-exact); DEFAULT would truncate them to bf16 on
+        # TPU and inflate the residual noise floor out of the f32 class.
+        hi = jax.lax.Precision("highest")
+        r_exp = r_exp + alpha * jnp.dot(apf, jnp.sum(bpf, axis=0), precision=hi)
+        c_exp = c_exp + alpha * jnp.dot(bpf, jnp.sum(apf, axis=0), precision=hi)
         # Pass 2: full re-read of C to recompute its checksums (this is the
         # non-fused cost the fused kernels eliminate).
         res_r = r_exp - jnp.sum(c_acc, axis=1)

@@ -47,6 +47,30 @@ def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int):
     )
 
 
+def shrink_block(shape, m: int, n: int, k: int):
+    """Halve oversized block dims for small problems.
+
+    Big tuned tiles (e.g. the bf16 flagship's bk=2048) would force heavy
+    zero-padding on smaller inputs — padded FLOPs are real FLOPs. Halve each
+    block dim while (a) the padding waste on its axis is at least one tile
+    granule (128 rows/cols, 256 K-depth) and (b) the halved value stays a
+    legal multiple of 128. Leaves well-fitting shapes untouched, so tuned
+    behavior at the target sizes is unchanged.
+    """
+    import dataclasses
+
+    bm, bn, bk = shape.bm, shape.bn, shape.bk
+    while bm > 128 and (-m) % bm >= 128 and (bm // 2) % 128 == 0:
+        bm //= 2
+    while bn > 128 and (-n) % bn >= 128 and (bn // 2) % 128 == 0:
+        bn //= 2
+    while bk > 256 and (-k) % bk >= 256 and (bk // 2) % 128 == 0:
+        bk //= 2
+    if (bm, bn, bk) == shape.block:
+        return shape
+    return dataclasses.replace(shape, bm=bm, bn=bn, bk=bk)
+
+
 def pad_to(x: jax.Array, row_mult: int, col_mult: int) -> jax.Array:
     """Zero-pad a 2-D array up to multiples of (row_mult, col_mult).
 

@@ -55,7 +55,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
     dtype_suffix as _dtype_suffix,
@@ -63,6 +63,7 @@ from ft_sgemm_tpu.ops.common import (
     pad_to as _pad_to,
     resolve_in_dtype as _resolve_in_dtype,
     should_interpret as _should_interpret,
+    shrink_block as _shrink_block,
 )
 
 STRATEGIES = ("rowcol", "global", "weighted")
@@ -414,12 +415,13 @@ def make_ft_sgemm(
     consumes, so the residual noise floor is unchanged from the f32 path and
     the same thresholds apply.
     """
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
-    bm, bn, bk = shape.block
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
+    if isinstance(shape, str):
+        # Named shapes pick up the dtype-tuned tile; explicit KernelShape
+        # objects are always respected as-is.
+        shape = shape_for_dtype(SHAPES[shape], True, in_dtype)
 
     def fn(a, b, c, inject: Optional[InjectionSpec] = None) -> FtSgemmResult:
         inject = inject or InjectionSpec.none()
@@ -427,6 +429,8 @@ def make_ft_sgemm(
         b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
+        eff = _shrink_block(shape, m, n, a.shape[1])
+        bm, bn, bk = eff.block
         ap = _pad_to(a, bm, bk)
         bp = _pad_to(b, bn, bk)
         cp = _pad_to(c, bm, bn)
@@ -450,7 +454,7 @@ def make_ft_sgemm(
                 ce = min(ce, max(1, inject.every))
         out, det = _ft_sgemm_padded(
             ap, bp, cp, jnp.asarray(inject.as_operand()),
-            shape=shape, alpha=alpha, beta=beta, precision=precision,
+            shape=eff, alpha=alpha, beta=beta, precision=precision,
             threshold=threshold, check_every=ce, strategy=strategy,
             interpret=_should_interpret(interpret),
         )

@@ -37,13 +37,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
 from ft_sgemm_tpu.ops.common import (
     dtype_suffix as _dtype_suffix,
     gemm_cost_estimate as _gemm_cost_estimate,
     pad_to as _pad_to,
     resolve_in_dtype as _resolve_in_dtype,
     should_interpret as _should_interpret,
+    shrink_block as _shrink_block,
 )
 
 
@@ -121,22 +122,24 @@ def make_sgemm(
     (XLA splits f32 operands into bf16 passes per the precision level; bf16
     operands are already single-pass).
     """
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
-    bm, bn, bk = shape.block
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
+    if isinstance(shape, str):
+        # Named shapes pick up the dtype-tuned tile; explicit KernelShape
+        # objects are always respected as-is.
+        shape = shape_for_dtype(SHAPES[shape], False, in_dtype)
 
     def fn(a, b, c):
         a = jnp.asarray(a, in_dtype)
         b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
-        ap = _pad_to(a, bm, bk)
-        bp = _pad_to(b, bn, bk)
-        cp = _pad_to(c, bm, bn)
+        eff = _shrink_block(shape, m, n, a.shape[1])
+        ap = _pad_to(a, eff.bm, eff.bk)
+        bp = _pad_to(b, eff.bn, eff.bk)
+        cp = _pad_to(c, eff.bm, eff.bn)
         out = _sgemm_padded(
             ap, bp, cp,
-            shape=shape, alpha=alpha, beta=beta,
+            shape=eff, alpha=alpha, beta=beta,
             precision=precision, interpret=_should_interpret(interpret),
         )
         return out[:m, :n]

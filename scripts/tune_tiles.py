@@ -4,7 +4,7 @@ Sweeps the plain and fused-ABFT (weighted + rowcol) kernels at M=N=K=4096
 and prints GFLOPS per candidate block tile, sorted. Used to pick the
 shipped SHAPES; not part of the package surface.
 
-Usage: python scripts/tune_tiles.py [size] [--ft] [--rowcol]
+Usage: python scripts/tune_tiles.py [size] [--ft] [--rowcol] [--bf16]
 """
 
 import sys
@@ -38,6 +38,17 @@ CANDIDATES = [
 ]
 
 
+BF16_EXTRA = [
+    # bf16 halves the A/B tile bytes; deeper/wider tiles fit VMEM.
+    (512, 512, 1024),
+    (512, 1024, 1024),
+    (1024, 512, 512),
+    (512, 2048, 256),
+    (1024, 1024, 512),
+    (512, 512, 2048),
+]
+
+
 def main():
     size = SIZE
     for tok in sys.argv[1:]:
@@ -45,6 +56,8 @@ def main():
             size = int(tok)
     do_ft = "--ft" in sys.argv
     do_rowcol = "--rowcol" in sys.argv
+    in_dtype = "bfloat16" if "--bf16" in sys.argv else "float32"
+    candidates = CANDIDATES + (BF16_EXTRA if in_dtype == "bfloat16" else [])
 
     rng = np.random.default_rng(10)
     a = jax.device_put(generate_random_matrix(size, size, rng=rng))
@@ -53,16 +66,17 @@ def main():
     flop = 2.0 * size**3
 
     results = []
-    for bm, bn, bk in CANDIDATES:
+    for bm, bn, bk in candidates:
         shape = KernelShape(f"t{bm}x{bn}x{bk}", bm, bn, bk, (0,) * 7)
         try:
             if do_ft or do_rowcol:
                 strat = "rowcol" if do_rowcol else "weighted"
                 inj = InjectionSpec.reference_like(size, bk)
-                ft = make_ft_sgemm(shape, alpha=1.0, beta=-1.5, strategy=strat)
+                ft = make_ft_sgemm(shape, alpha=1.0, beta=-1.5, strategy=strat,
+                                   in_dtype=in_dtype)
                 fn = lambda a, b, x: ft(a, b, x, inj).c  # noqa: E731
             else:
-                fn = make_sgemm(shape, alpha=1.0, beta=-1.5)
+                fn = make_sgemm(shape, alpha=1.0, beta=-1.5, in_dtype=in_dtype)
             sec = bench_seconds_per_call(fn, a, b, c, min_device_time=1.0)
             gf = flop / 1e9 / sec
         except Exception as e:  # noqa: BLE001 - sweep must survive bad tiles

@@ -4,7 +4,10 @@ Run directly on a machine with a TPU attached (uses whatever platform the
 environment provides). The pytest suite never requires a TPU; this script is
 the hardware gate.
 
-Usage: python scripts/validate_tpu.py [size] [--full]
+Usage: python scripts/validate_tpu.py [size] [--full] [--bf16]
+
+``--bf16`` additionally validates the bf16 input mode against the XLA dot
+over the same bf16-rounded inputs (full-rate MXU path).
 """
 
 import sys
@@ -24,7 +27,18 @@ from ft_sgemm_tpu import (  # noqa: E402
 )
 from ft_sgemm_tpu.configs import SHAPE_ORDER  # noqa: E402
 from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix  # noqa: E402
-from ft_sgemm_tpu.utils.timing import gflops, time_fn  # noqa: E402
+from ft_sgemm_tpu.utils.timing import bench_seconds_per_call, gflops  # noqa: E402
+
+
+def _gf(fn, a, b, c, size):
+    # Chained-rep timing (rep loop inside jit): through the axon tunnel a
+    # single dispatch is dominated by ~50ms roundtrip latency and under-
+    # reports GFLOPS by ~15x; bench_seconds_per_call cancels it. reps=1:
+    # it returns seconds per single call (gflops' default reps=5 pairs with
+    # time_fn's 5-rep loop, not with this timer).
+    return gflops(size, size, size,
+                  bench_seconds_per_call(fn, a, b, c, min_device_time=1.0),
+                  reps=1)
 
 ALPHA, BETA = 1.0, -1.5
 
@@ -43,8 +57,8 @@ def main():
     c = jax.device_put(generate_random_matrix(size, size, rng=rng))
 
     want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
-    t = time_fn(lambda: sgemm_reference(a, b, c, ALPHA, BETA))
-    xla_gf = gflops(size, size, size, t)
+    xla_gf = _gf(lambda a, b, x: sgemm_reference(a, b, x, ALPHA, BETA),
+                 a, b, c, size)
     print(f"{'xla_dot':28s} {xla_gf:9.1f} GFLOPS")
 
     shapes = SHAPE_ORDER if full else ("huge",)
@@ -52,8 +66,7 @@ def main():
         fn = make_sgemm(name, alpha=ALPHA, beta=BETA)
         got = np.asarray(fn(a, b, c))
         ok, nbad, _ = verify_matrix(want, got, verbose=False)
-        t = time_fn(lambda: fn(a, b, c))
-        gf = gflops(size, size, size, t)
+        gf = _gf(fn, a, b, c, size)
         print(f"{'sgemm_' + name:28s} {gf:9.1f} GFLOPS  "
               f"verify={'OK' if ok else f'FAIL({nbad})'}  "
               f"({gf / xla_gf * 100:5.1f}% of XLA)")
@@ -71,10 +84,43 @@ def main():
             else:
                 ok_str = (f"verify={'OK' if ok else f'FAIL({nbad})'} "
                           f"det={int(res.num_detected)}")
-            t = time_fn(lambda: fn(a, b, c, inject=inj))
-            gf = gflops(size, size, size, t)
+            gf = _gf(lambda a, b, x: fn(a, b, x, inject=inj).c, a, b, c, size)
             print(f"{'ft_sgemm_' + name + ':' + strategy:28s} {gf:9.1f} GFLOPS  "
                   f"{ok_str}  ({gf / xla_gf * 100:5.1f}% of XLA)")
+
+    if "--bf16" in sys.argv:
+        want16 = np.asarray(
+            sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+        xla16_gf = _gf(
+            lambda a, b, x: sgemm_reference(a, b, x, ALPHA, BETA,
+                                            in_dtype="bfloat16"),
+            a, b, c, size)
+        print(f"{'xla_dot_bf16':28s} {xla16_gf:9.1f} GFLOPS")
+        for name in shapes:
+            fn = make_sgemm(name, alpha=ALPHA, beta=BETA, in_dtype="bfloat16")
+            ok, nbad, _ = verify_matrix(want16, np.asarray(fn(a, b, c)),
+                                        verbose=False)
+            gf = _gf(fn, a, b, c, size)
+            print(f"{'sgemm_' + name + ':bf16':28s} {gf:9.1f} GFLOPS  "
+                  f"verify={'OK' if ok else f'FAIL({nbad})'}  "
+                  f"({gf / xla16_gf * 100:5.1f}% of XLA bf16)")
+        for strategy in (("rowcol", "weighted") if full else ("weighted",)):
+            for name in shapes:
+                fn = make_ft_sgemm(name, alpha=ALPHA, beta=BETA,
+                                   strategy=strategy, in_dtype="bfloat16")
+                # Cadence from the tile the kernel actually runs (bf16
+                # overrides change bk), keeping rows comparable to f32.
+                inj = InjectionSpec.reference_like(size, fn.shape_config.bk)
+                res = fn(a, b, c, inject=inj)
+                ok, nbad, _ = verify_matrix(want16, np.asarray(res.c),
+                                            verbose=False)
+                gf = _gf(lambda a, b, x: fn(a, b, x, inject=inj).c,
+                         a, b, c, size)
+                print(f"{'ft_' + name + ':' + strategy + ':bf16':28s} "
+                      f"{gf:9.1f} GFLOPS  "
+                      f"verify={'OK' if ok else f'FAIL({nbad})'} "
+                      f"det={int(res.num_detected)}  "
+                      f"({gf / xla16_gf * 100:5.1f}% of XLA bf16)")
 
 
 if __name__ == "__main__":

@@ -81,3 +81,35 @@ def test_calibrated_threshold_catches_calibrated_magnitude():
     )
     assert pt.detection_rate == pytest.approx(1.0)
     assert pt.output_correct
+
+
+def test_detection_sweep_bf16_catches_reference_magnitude():
+    a, b, c = _inputs(256, 256, 512, seed=17)
+    pts = detection_rate_sweep(
+        a, b, c, magnitudes=[1e5], shape="test", strategy="rowcol",
+        num_faults=2, in_dtype="bfloat16")
+    assert pts[0].detection_rate == 1.0 and pts[0].output_correct
+
+
+def test_calibrate_threshold_bf16_noise_floor_stays_f32_class():
+    # Checksums see the rounded inputs, so the bf16 noise floor must stay
+    # within a small factor of the f32 floor (not the ~100x an fp16-style
+    # rounding mismatch would produce).
+    a, b, c = _inputs(256, 256, 512, seed=18)
+    cal32 = calibrate_threshold(a, b, c)
+    cal16 = calibrate_threshold(a, b, c, in_dtype="bfloat16")
+    assert cal16.noise_floor < max(cal32.noise_floor, 1e-3) * 50
+
+
+def test_detection_sweep_accounts_for_shrunk_tiles():
+    # Regression: "huge" (512^3) on a 640x640x1024 problem shrinks at run
+    # time; expected-fault accounting must follow the effective tile or the
+    # rate mis-reports.
+    # Reference operating-point magnitude (1e4): far above the threshold yet
+    # small enough that the f32 correction residual (~mag * 2^-24) stays
+    # inside the verify tolerance.
+    a, b, c = _inputs(640, 640, 1024, seed=19)
+    pts = detection_rate_sweep(
+        a, b, c, magnitudes=[1e4], shape="huge", strategy="rowcol",
+        num_faults=2)
+    assert pts[0].detection_rate == 1.0 and pts[0].output_correct

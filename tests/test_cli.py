@@ -64,3 +64,10 @@ def test_trace_flag_writes_profile(tmp_path):
     # jax.profiler.trace writes a plugins/profile/<ts>/ tree under the dir.
     files = list(trace_dir.rglob("*"))
     assert any(f.is_file() for f in files), files
+
+
+def test_cli_bf16_uses_override_tile():
+    # --dtype=bfloat16 must pick up the tuned tile for named shapes
+    # (regression: passing KernelShape objects bypassed the override).
+    fn = cli._build_callable(6, 4096, inject_ft=False, in_dtype="bfloat16")
+    assert fn.shape_config.block == (512, 512, 2048)

@@ -113,3 +113,51 @@ def test_in_dtype_validation():
 def test_kernel_names_carry_dtype():
     assert make_sgemm("test", in_dtype="bfloat16").__name__.endswith("bfloat16")
     assert make_ft_sgemm("test").__name__ == "ft_sgemm_test_rowcol"
+
+
+def test_bf16_named_shape_picks_tuned_tile():
+    from ft_sgemm_tpu.configs import SHAPES, shape_for_dtype
+
+    assert make_sgemm("huge", in_dtype="bfloat16").shape_config.block == \
+        (512, 512, 2048)
+    assert make_ft_sgemm("huge", in_dtype="bfloat16").shape_config.block == \
+        (512, 1024, 256)
+    # f32 named shapes and explicit KernelShape objects are untouched.
+    assert make_sgemm("huge").shape_config.block == (512, 512, 512)
+    explicit = SHAPES["huge"]
+    assert shape_for_dtype(explicit, False, "float32") is explicit
+    assert make_sgemm(explicit, in_dtype="bfloat16").shape_config is explicit
+
+
+def test_shrink_block_limits_padding_waste():
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.ops.common import shrink_block
+
+    big = KernelShape("b", 512, 512, 2048, (0,) * 7)
+    # Small K: bk halves down until padding waste is below one granule.
+    assert shrink_block(big, 4096, 4096, 1024).block == (512, 512, 1024)
+    assert shrink_block(big, 256, 1536, 512).block == (256, 512, 512)
+    # Exact fits stay put.
+    assert shrink_block(big, 4096, 4096, 4096) is big
+    # Never shrinks to an illegal (non-128-multiple) value.
+    odd = KernelShape("o", 384, 384, 384, (0,) * 7)
+    assert shrink_block(odd, 128, 128, 128).block == (384, 384, 384)
+
+
+def test_bf16_tuned_tiles_stay_correct_with_injection():
+    # End-to-end over the real override tiles (shrunk to the test size):
+    # wide-bn FT tile and deep-bk plain tile both verify.
+    m = n = 256
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=31)
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    ft = make_ft_sgemm("huge", in_dtype="bfloat16", alpha=ALPHA, beta=BETA)
+    res = ft(a, b, c, inject=inj)
+    want = _rounded_oracle(a, b, c)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived on the bf16 FT tile"
+    assert int(res.num_detected) > 0
+    plain = make_sgemm("huge", in_dtype="bfloat16", alpha=ALPHA, beta=BETA)
+    ok, nbad, _ = verify_matrix(want, np.asarray(plain(a, b, c)),
+                                verbose=False)
+    assert ok, f"{nbad} bad on the bf16 plain tile"
