@@ -1,5 +1,10 @@
 """Multi-chip execution: mesh-sharded fault-tolerant GEMM over ICI."""
 
+from ft_sgemm_tpu.parallel.multihost import (
+    initialize,
+    make_multihost_mesh,
+    multihost_ft_sgemm,
+)
 from ft_sgemm_tpu.parallel.ring import (
     make_ring_mesh,
     ring_ft_sgemm,
@@ -12,7 +17,10 @@ from ft_sgemm_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "initialize",
     "make_mesh",
+    "make_multihost_mesh",
+    "multihost_ft_sgemm",
     "make_ring_mesh",
     "ring_ft_sgemm",
     "ring_sgemm",

@@ -1,0 +1,172 @@
+"""Multi-host (DCN + ICI) fault-tolerant GEMM.
+
+The reference is a single-GPU study — no multi-process anything (SURVEY.md
+§5 "Distributed communication backend: none"). This module supplies the
+scaling story a TPU-native framework needs beyond one host: a 3-axis
+hierarchical mesh and a sharding layout chosen so that **every heavy
+collective rides ICI and only scalar detection counts cross DCN**.
+
+Mesh axes, outermost first:
+
+  - ``host`` — one slot per process/slice, connected over DCN. Used ONLY
+    for output-row (data) parallelism: no tensor communication crosses it
+    for the product itself.
+  - ``x``    — ICI output-row parallelism within a slice.
+  - ``y``    — ICI contraction (K) parallelism; K-partials combine with a
+    ``psum`` (or ``psum_scatter``) scoped to ``y`` alone, so the reduction
+    stays on the intra-slice ICI torus.
+
+Layout: A (M, K) -> P(("host", "x"), "y"); B (N, K) -> P(None, "y");
+C (M, N) -> P(("host", "x"), None). Each device runs the fused-ABFT kernel
+on its local shard and corrects faults BEFORE any collective, exactly as in
+``parallel/sharded.py``; the global fault count is the single value psummed
+across all three axes (a few bytes over DCN per step).
+
+On real multi-host deployments call :func:`initialize` first (a thin
+wrapper over ``jax.distributed.initialize``) and build the mesh with
+:func:`make_multihost_mesh`; every host then executes the same program on
+global arrays. Single-process with N local (or virtual CPU) devices works
+identically — ``host`` simply becomes another local axis, which is how the
+tests and the driver dry-run exercise this module without a pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
+from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+from ft_sgemm_tpu.parallel.sharded import make_ft_step, shard_map
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the JAX distributed runtime (no-op if already initialized).
+
+    Thin wrapper over ``jax.distributed.initialize`` so callers depend on
+    this module's surface, not on JAX internals. With no arguments, JAX
+    auto-detects TPU pod topology from the environment.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Double-init is a no-op. jax's message is version-dependent:
+        # "distributed.initialize should only be called once." (jax 0.9)
+        # or "already initialized" in other versions.
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+
+
+def make_multihost_mesh(
+    hosts: Optional[int] = None,
+    ici_axes: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """3-axis ("host", "x", "y") mesh over all addressable devices.
+
+    ``hosts`` defaults to ``jax.process_count()``; the per-host device
+    count is factored into the most-square ``(x, y)`` split unless
+    ``ici_axes`` pins it. Device order follows ``jax.devices()``, which
+    groups devices by process — so the outermost ``host`` axis really maps
+    one slot per process and inter-slot traffic is DCN.
+    """
+    devs = jax.devices()
+    h = hosts or max(jax.process_count(), 1)
+    if len(devs) % h:
+        raise ValueError(f"{len(devs)} devices do not split over {h} hosts")
+    per_host = len(devs) // h
+    if ici_axes is None:
+        x = int(np.floor(np.sqrt(per_host)))
+        while per_host % x:
+            x -= 1
+        ici_axes = (x, per_host // x)
+    x, y = ici_axes
+    if x * y != per_host:
+        raise ValueError(
+            f"ici_axes {ici_axes} != {per_host} devices per host")
+    arr = np.asarray(devs).reshape(h, x, y)
+    return Mesh(arr, ("host", "x", "y"))
+
+
+def _check_divisible(name, dim, parts, axis):
+    if dim % parts:
+        raise ValueError(
+            f"{name} dimension {dim} must divide evenly over the {parts}"
+            f" shards of mesh axis {axis!r} (pad inputs before sharding)"
+        )
+
+
+def multihost_ft_sgemm(
+    a,
+    b,
+    c,
+    mesh: Mesh,
+    shape: KernelShape | str = "huge",
+    *,
+    alpha: float = 1.0,
+    beta: float = -1.5,
+    inject: Optional[InjectionSpec] = None,
+    strategy: str = "rowcol",
+    threshold: float = REFERENCE_THRESHOLD,
+    precision: str = "highest",
+    in_dtype: str = "float32",
+    scatter_output: bool = False,
+    interpret: Optional[bool] = None,
+) -> FtSgemmResult:
+    """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a ("host", "x", "y") mesh.
+
+    M rows are sharded over host x ICI-x (pure data parallelism — zero
+    tensor traffic over DCN); K over ICI-y (psum stays on ICI). Faults are
+    corrected per device before the psum; only the int32 detection count
+    crosses DCN. ``scatter_output=True`` reduce-scatters the K-partials so
+    C lands additionally N-sharded over ``y``.
+    """
+    # Keep string shapes as names: make_ft_sgemm resolves them through the
+    # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
+    inject = inject or InjectionSpec.none()
+    cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    h, mx, my = (mesh.shape["host"], mesh.shape["x"], mesh.shape["y"])
+    _check_divisible("M", m, h * mx, "host*x")
+    _check_divisible("K", k, my, "y")
+    if scatter_output:
+        _check_divisible("N", n, my, "y")
+
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
+        precision=precision, in_dtype=in_dtype, interpret=interpret,
+    )
+    # K-partials psum over "y" (ICI only); the int32 detection count is the
+    # one value that crosses "host" (DCN).
+    step = make_ft_step(local_ft, alpha, beta, inject, scatter_output,
+                        det_axes=("y", "x", "host"))
+
+    rows = P(("host", "x"), "y")
+    c_spec = (P(("host", "x"), "y") if scatter_output
+              else P(("host", "x"), None))
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(rows, P(None, "y"), c_spec),
+        out_specs=(c_spec, P(None, None)),
+    )
+    out, det = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det)
+
+
+__all__ = ["initialize", "make_multihost_mesh", "multihost_ft_sgemm"]

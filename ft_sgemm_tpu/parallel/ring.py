@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
@@ -79,8 +79,8 @@ def ring_ft_sgemm(
     ``detections`` array is the global scalar count reshaped to (1, 1)
     (per-tile attribution is not preserved across hops).
     """
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
+    # String shapes stay names: make_ft_sgemm resolves them through the
+    # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
     inject = inject or InjectionSpec.none()
     # Cast once before sharding: a bf16 B shard crosses the ICI ring at half
     # the bytes per ppermute hop, and the stationary A shard is not re-cast
@@ -150,8 +150,6 @@ def ring_sgemm(
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Plain (non-FT) ring collective matmul with the same layout."""
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
     cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
     a = jnp.asarray(a, cast_dtype)
     b = jnp.asarray(b, cast_dtype)

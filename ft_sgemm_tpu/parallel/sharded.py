@@ -37,7 +37,7 @@ def shard_map(f, *, mesh, in_specs, out_specs):
     return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape
+from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
@@ -69,6 +69,30 @@ def _check_divisible(name, dim, parts):
             f"{name} dimension {dim} must divide evenly over {parts} mesh"
             f" shards (pad inputs before sharding)"
         )
+
+
+def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes):
+    """Per-device FT-GEMM step shared by the 2-D and multi-host meshes.
+
+    Runs the local fused-ABFT kernel on the device's shard (corrects BEFORE
+    any collective), combines K-partials over mesh axis "y" with psum or
+    psum_scatter, applies alpha/beta once, and psums detection counts over
+    ``det_axes``.
+    """
+
+    def step(a_loc, b_loc, c_loc):
+        zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
+        res = local_ft(a_loc, b_loc, zeros, inject)
+        if scatter_output:
+            partial = jax.lax.psum_scatter(
+                res.c, "y", scatter_dimension=1, tiled=True)
+        else:
+            partial = jax.lax.psum(res.c, "y")
+        out = alpha * partial + beta * c_loc
+        det = jax.lax.psum(res.detections, det_axes)
+        return out, det
+
+    return step
 
 
 def sharded_ft_sgemm(
@@ -104,8 +128,8 @@ def sharded_ft_sgemm(
     the returned array is still the assembled global C (XLA keeps it
     sharded until the caller forces it).
     """
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
+    # String shapes stay names: make_ft_sgemm resolves them through the
+    # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
     inject = inject or InjectionSpec.none()
     # Cast A/B once BEFORE sharding: bf16 shards then move over ICI at half
     # the bytes and the per-device kernels skip a per-call (ring: per-hop)
@@ -127,18 +151,8 @@ def sharded_ft_sgemm(
         shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
         precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
-
-    def step(a_loc, b_loc, c_loc):
-        zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
-        res = local_ft(a_loc, b_loc, zeros, inject)
-        if scatter_output:
-            partial = jax.lax.psum_scatter(
-                res.c, "y", scatter_dimension=1, tiled=True)
-        else:
-            partial = jax.lax.psum(res.c, "y")
-        out = alpha * partial + beta * c_loc
-        det = jax.lax.psum(jax.lax.psum(res.detections, "y"), "x")
-        return out, det
+    step = make_ft_step(local_ft, alpha, beta, inject, scatter_output,
+                        det_axes=("y", "x"))
 
     c_spec = P("x", "y") if scatter_output else P("x", None)
     fn = shard_map(
@@ -165,8 +179,6 @@ def sharded_sgemm(
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Plain (non-FT) mesh-sharded SGEMM with the same layout."""
-    if isinstance(shape, str):
-        shape = SHAPES[shape]
     cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
     a = jnp.asarray(a, cast_dtype)
     b = jnp.asarray(b, cast_dtype)

@@ -60,10 +60,25 @@ def bench_seconds_per_call(fn, a, b, c, *, min_device_time: float = 1.0,
     The reference brackets 5 launches with cudaEvents (``sgemm.cu:253-265``);
     over a tunneled TPU a dispatch roundtrip costs ~50 ms, so instead the rep
     loop runs *inside* one jitted computation with a **dynamic trip count**
-    (one compile, any rep count), chained data-dependently (C feeds back) so
-    no iteration can be elided. Reps scale until device time >=
+    (one compile, any rep count). Reps scale until device time >=
     ``min_device_time``; a zero-rep dispatch measures fixed overhead, which
     is subtracted.
+
+    Iteration chaining uses ``optimization_barrier`` + a scalar carry — NOT
+    elementwise work on the operands. An earlier version chained by damping
+    the full C feedback (``x * 1e-3``) and salting A (``a * s``): ~190 MB of
+    per-rep HBM traffic that XLA fuses into its own dot's epilogue but can
+    NEVER fuse into an opaque Pallas custom call, silently penalizing every
+    Pallas row ~5 % (f32) to ~20 % (bf16) against the ``xla_dot`` row. The
+    barrier fakes the loop-carried dependence at zero data movement, so both
+    kernel families are timed bare. The carry consumes one output element at
+    a RUNTIME-DEPENDENT index (derived from the carry itself), so XLA's
+    algebraic simplifier cannot statically rewrite slice-of-dot into a
+    cheap dot-of-slices for the pure-XLA rows — the full product stays
+    load-bearing every iteration.
+
+    For bf16 kernels pass pre-cast bf16 ``a``/``b``: the wrappers' casts
+    then trace to no-ops instead of per-rep device work.
     """
     import itertools
 
@@ -72,22 +87,25 @@ def bench_seconds_per_call(fn, a, b, c, *, min_device_time: float = 1.0,
 
     @_jax.jit
     def loop(a, b, c, reps, salt):
-        def body(i, x):
-            # Thread a negligible x-dependency into A so XLA cannot hoist
-            # the (otherwise loop-invariant) matmul out of the rep loop,
-            # and damp x so the chain stays bounded at any rep count
-            # (|x'| <= |A@B.T| + |beta|*1e-3*|x| converges; undamped,
-            # beta=-1.5 grows |x| 1.5x/rep and overflows f32 by rep ~205).
-            s = 1.0 + 1e-30 * jnp.sum(x)
-            return fn(a * s, b, x * 1e-3)
-        return jnp.sum(_jax.lax.fori_loop(0, reps, body, c + salt))
+        def body(i, t):
+            # The barrier makes a/c "depend" on the carry so XLA cannot
+            # hoist the (otherwise loop-invariant) call out of the loop.
+            a2, c2, t2 = _jax.lax.optimization_barrier((a, c, t + salt))
+            y = fn(a2, b, c2)
+            # Dynamic (value-dependent, always-0-but-unprovable) index:
+            # defeats static slice-of-dot simplification.
+            idx = jnp.remainder(t2.astype(jnp.int32), y.shape[0])
+            row = _jax.lax.dynamic_index_in_dim(y, idx, axis=0,
+                                                keepdims=False)
+            return t2 + 1e-30 * row[0].astype(jnp.float32)
+        return _jax.lax.fori_loop(0, reps, body, jnp.float32(0))
 
     # A fresh salt per dispatch defeats any result caching of identical
     # executions in the runtime (observed over the axon tunnel).
     counter = itertools.count(1)
 
     def run(reps):
-        salt = jnp.float32(next(counter) * 1e-6)
+        salt = jnp.float32(next(counter) * 1e-7)
         t0 = time.perf_counter()
         float(loop(a, b, c, reps, salt))
         return time.perf_counter() - t0

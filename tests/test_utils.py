@@ -80,3 +80,26 @@ def test_verify_vector():
     bad[1] = 2.5
     ok, nbad = verify_vector(ref, bad)
     assert not ok and nbad == 1
+
+
+def test_bench_seconds_per_call_times_real_work():
+    # The barrier-chained rep loop must (a) return a positive per-call time
+    # and (b) reflect the result of real executions — the loop's carry reads
+    # an output element, so a broken chain (hoisted/elided call) would still
+    # produce a value, hence the separate correctness check below.
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu.utils.timing import bench_seconds_per_call
+
+    calls = []
+
+    def fn(a, b, c):
+        calls.append(1)  # trace-time only: counts compilations, not reps
+        return jnp.dot(a, b.T, preferred_element_type=jnp.float32) - 1.5 * c
+
+    a = jnp.ones((64, 64), jnp.float32)
+    b = jnp.ones((64, 64), jnp.float32)
+    c = jnp.ones((64, 64), jnp.float32)
+    sec = bench_seconds_per_call(fn, a, b, c, min_device_time=0.01)
+    assert sec > 0
+    assert len(calls) >= 1
