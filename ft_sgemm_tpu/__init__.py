@@ -33,7 +33,12 @@ from ft_sgemm_tpu.configs import (
 from ft_sgemm_tpu.injection import InjectionSpec
 from ft_sgemm_tpu.ops.reference import sgemm_reference
 from ft_sgemm_tpu.ops.sgemm import make_sgemm, sgemm
-from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm, ft_sgemm
+from ft_sgemm_tpu.ops.ft_sgemm import (
+    STRATEGIES,
+    FtSgemmResult,
+    ft_sgemm,
+    make_ft_sgemm,
+)
 from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
 
 __version__ = "0.1.0"
@@ -49,5 +54,7 @@ __all__ = [
     "sgemm",
     "make_ft_sgemm",
     "ft_sgemm",
+    "FtSgemmResult",
+    "STRATEGIES",
     "abft_baseline_sgemm",
 ]

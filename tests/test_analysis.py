@@ -113,3 +113,24 @@ def test_detection_sweep_accounts_for_shrunk_tiles():
         a, b, c, magnitudes=[1e4], shape="huge", strategy="rowcol",
         num_faults=2)
     assert pts[0].detection_rate == 1.0 and pts[0].output_correct
+
+
+def test_estimate_noise_floor_bounds_measurement():
+    from ft_sgemm_tpu.analysis import estimate_noise_floor
+
+    a, b, c = _inputs(256, 256, 1024, seed=20)
+    est = estimate_noise_floor(a, b, c)
+    measured = measure_noise_floor(a, b, c)
+    # The closed-form bound must dominate the measured floor while staying
+    # far below the reference operating threshold.
+    assert measured <= est < REFERENCE_THRESHOLD / 10
+    # The beta*C term matters on its own: tiny A/B against a huge C.
+    big_c = c * 1e6
+    est_big = estimate_noise_floor(a * 1e-3, b * 1e-3, big_c)
+    meas_big = measure_noise_floor(a * 1e-3, b * 1e-3, big_c)
+    assert meas_big <= est_big
+    # And omitting C with beta != 0 is an error, not a silent undershoot.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="beta"):
+        estimate_noise_floor(a, b)

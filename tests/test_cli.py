@@ -71,3 +71,17 @@ def test_cli_bf16_uses_override_tile():
     # (regression: passing KernelShape objects bypassed the override).
     fn = cli._build_callable(6, 4096, inject_ft=False, in_dtype="bfloat16")
     assert fn.shape_config.block == (512, 512, 2048)
+
+
+def test_cli_strategy_flag():
+    buf = io.StringIO()
+    ok = cli.run_verification(end_size=256, st_kernel=11, end_kernel=11,
+                              out=buf, strategy="weighted")
+    assert ok and ": pass" in buf.getvalue()
+    # global is detect-only: its FT rows are skipped, not failed.
+    buf = io.StringIO()
+    ok = cli.run_verification(end_size=256, st_kernel=11, end_kernel=11,
+                              out=buf, strategy="global")
+    assert ok and "skip (global" in buf.getvalue()
+    assert cli.main(["ft_sgemm", "128", "128", "128", "0", "0",
+                     "--strategy=bogus"]) == 2

@@ -82,3 +82,20 @@ def test_multihost_rejects_indivisible():
     a, b, c = _inputs(302, 128, 256)  # 302 % (host*x = 4) != 0
     with pytest.raises(ValueError, match="divide evenly"):
         multihost_ft_sgemm(a, b, c, mesh, TILE)
+
+
+def test_initialize_swallows_double_init_only(monkeypatch):
+    import ft_sgemm_tpu.parallel.multihost as mh
+
+    def once(**kw):
+        raise RuntimeError("distributed.initialize should only be called once.")
+
+    monkeypatch.setattr(mh.jax.distributed, "initialize", once)
+    mh.initialize()  # treated as already-initialized: no raise
+
+    def other(**kw):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(mh.jax.distributed, "initialize", other)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        mh.initialize()
