@@ -32,7 +32,17 @@ Three checksum strategies mirror the reference's three preserved designs:
   - ``"rowcol"`` (default): row+column checksums, residual-intersection
     correction — the shipped generated kernels
     (``include_code_gen/ft_sgemm_*.cuh``) and the warp-level design
-    (``include/ft_sgemm_huge_warp.cuh``).
+    (``include/ft_sgemm_huge_warp.cuh``). Unlike the reference (which can
+    only correct ONE fault per check interval and guarantees that by
+    checking exactly where it injects, ``code_gen.py:333-337``), this
+    kernel also carries a row-index-weighted column checksum in its
+    multi-fault mode: when more than one row AND more than one column flag
+    — the case where bare row/col residual intersection is provably
+    ambiguous (equal-magnitude faults at (r1,c1),(r2,c2) admit the wrong
+    pairing (r1,c2),(r2,c1) with identical row/col sums) — each flagged
+    column's fault row is localized by the weighted-residual ratio and
+    corrected independently. Any number of faults per interval is
+    corrected as long as each corrupted *column* holds at most one fault.
   - ``"global"``: one scalar checksum per output tile, detect-only — the
     thread-local design (``include/ft_sgemm_huge_thread.cuh:106-177``).
   - ``"weighted"``: column checksums plus index-weighted column checksums;
@@ -72,12 +82,16 @@ STRATEGIES = ("rowcol", "global", "weighted")
 class FtSgemmResult(NamedTuple):
     """Output of a fused-ABFT GEMM.
 
-    ``detections`` semantics differ by strategy:
-      - ``rowcol``/``weighted``: corrected fault count per C tile — one per
-        injected fault when at most one fault lands per check interval.
-      - ``global``: number of *failed checks* per tile. The strategy never
-        corrects, so a single persistent fault keeps failing every later
-        check; this counts corruption observations, not distinct faults.
+    ``detections`` counts distinct fault events per C tile, uniformly
+    across strategies:
+      - ``rowcol``/``weighted``: number of corrected accumulator elements —
+        one per injected fault whenever each corrupted column holds at most
+        one fault per check interval (guaranteed for the rotating injector).
+      - ``global``: number of check intervals in which NEW corruption
+        appeared (the residual moved by more than the threshold since the
+        previous check). The strategy never corrects, so this equals the
+        injected fault count when at most one fault lands per interval;
+        multiple same-interval faults collapse into one event.
     """
 
     c: jax.Array           # (M, N) corrected output
@@ -94,11 +108,10 @@ def _inject(acc_ref, inj_ref, k, i, j, bm, bn):
     Models SDC in the f32 accumulator (reference rotates the target thread:
     ``if(tx == (k+8)/(K/20)) res[0] += error_inject``,
     ``include_code_gen/ft_sgemm_huge.cuh:324-327``). The target rotates with
-    the injection ordinal and the output-tile coordinates. NOTE: like the
-    reference, intersection-based correction is only unambiguous for a
-    single fault per check interval — the wrapper clamps the check cadence
-    to the injection cadence to guarantee that for tool-injected faults
-    (see make_ft_sgemm).
+    the injection ordinal and the output-tile coordinates; the column
+    stride (61) is coprime to every legal bn, so consecutive faults land in
+    distinct columns for up to bn injections — the property multi-fault
+    correction relies on (see make_ft_sgemm).
     """
     enabled = inj_ref[0] > 0.0
     every = jnp.maximum(inj_ref[1].astype(jnp.int32), 1)
@@ -127,9 +140,13 @@ def _inject(acc_ref, inj_ref, k, i, j, bm, bn):
 
 def _ft_kernel_rowcol(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
-    acc_ref, r_exp_ref, c_exp_ref, count_ref,
-    *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
+    acc_ref, r_exp_ref, c_exp_ref, *rest,
+    alpha, beta, nk, prec, threshold, check_every, bm, bn, multifault,
 ):
+    if multifault:
+        cw_exp_ref, count_ref = rest
+    else:
+        (count_ref,) = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -139,6 +156,8 @@ def _ft_kernel_rowcol(
         acc_ref[:] = jnp.zeros_like(acc_ref)
         r_exp_ref[:] = jnp.zeros_like(r_exp_ref)
         c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
+        if multifault:
+            cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
         count_ref[0] = 0
 
     _inject(acc_ref, inj_ref, k, i, j, bm, bn)
@@ -165,13 +184,23 @@ def _ft_kernel_rowcol(
     s_a = jnp.sum(af, axis=0, keepdims=True)               # (1, bk)
     r_exp_ref[:] += jnp.sum(af * s_b, axis=1, keepdims=True)     # (bm, 1)
     c_exp_ref[:] += jnp.sum(bf * s_a, axis=1, keepdims=True)     # (bn, 1)
+    if multifault:
+        # Row-index-weighted A column sums -> weighted expected column
+        # checksum (the weighted design's localization vector,
+        # include/ft_sgemm_huge.cuh:59, folded into rowcol so coarse check
+        # cadences stay safe under multiple faults per interval).
+        w_col = jax.lax.broadcasted_iota(
+            jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+        s_aw = jnp.sum(af * w_col, axis=0, keepdims=True)  # (1, bk)
+        cw_exp_ref[:] += jnp.sum(bf * s_aw, axis=1, keepdims=True)  # (bn, 1)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
     @pl.when(do_check)
     def _detect_correct():
-        rs = jnp.sum(acc_ref[:], axis=1, keepdims=True)     # (bm, 1)
-        cs = jnp.sum(acc_ref[:], axis=0, keepdims=True)     # (1, bn)
+        acc = acc_ref[:]
+        rs = jnp.sum(acc, axis=1, keepdims=True)            # (bm, 1)
+        cs = jnp.sum(acc, axis=0, keepdims=True)            # (1, bn)
         res_r = r_exp_ref[:] - rs                           # (bm, 1)
         res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs       # (1, bn)
         det_r = jnp.abs(res_r) > threshold
@@ -187,6 +216,25 @@ def _ft_kernel_rowcol(
         use_col = (n_rows_flagged == 1) & (n_cols_flagged > 1)
         corr = jnp.where(use_col, jnp.broadcast_to(res_c, hit.shape),
                          jnp.broadcast_to(res_r, hit.shape))
+        if multifault:
+            # >1 row AND >1 col flagged: intersection is ambiguous (the
+            # wrong fault pairing has identical row/col sums). Localize
+            # each flagged column's fault row by the weighted-residual
+            # ratio instead — exact while each corrupted column holds at
+            # most one fault (the rotating injector guarantees distinct
+            # columns for up to bn faults per interval).
+            w_col = jax.lax.broadcasted_iota(
+                jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+            csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
+            res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
+            safe = jnp.where(det_c, res_c, 1.0)
+            loc = jnp.round(res_cw / safe).astype(jnp.int32) - 1  # (1, bn)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+            hit_w = det_c & (rows == loc)
+            ambiguous = (n_rows_flagged > 1) & (n_cols_flagged > 1)
+            hit = jnp.where(ambiguous, hit_w, hit)
+            corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
+                             corr)
         acc_ref[:] += jnp.where(hit, corr, 0.0)
         count_ref[0] += jnp.sum(hit.astype(jnp.int32))
 
@@ -198,7 +246,7 @@ def _ft_kernel_rowcol(
 
 def _ft_kernel_global(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
-    acc_ref, t_exp_ref, count_ref,
+    acc_ref, t_exp_ref, prev_ref, count_ref,
     *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
 ):
     """Scalar-checksum, detect-only variant (``ft_sgemm_huge_thread.cuh``)."""
@@ -210,6 +258,7 @@ def _ft_kernel_global(
     def _zero():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         t_exp_ref[0] = 0.0
+        prev_ref[0] = 0.0
         count_ref[0] = 0
 
     _inject(acc_ref, inj_ref, k, i, j, bm, bn)
@@ -231,8 +280,15 @@ def _ft_kernel_global(
 
     @pl.when(do_check)
     def _detect():
+        # Count fault EVENTS, not failed checks: an uncorrected fault keeps
+        # the residual high forever, so compare against the previous check's
+        # residual — only NEW corruption (residual moved by > threshold)
+        # increments the count. Makes num_detected comparable across
+        # strategies (FtSgemmResult docstring).
         res = t_exp_ref[0] - jnp.sum(acc_ref[:])
-        count_ref[0] += (jnp.abs(res) > threshold).astype(jnp.int32)
+        count_ref[0] += (jnp.abs(res - prev_ref[0]) > threshold).astype(
+            jnp.int32)
+        prev_ref[0] = res
 
     @pl.when(k == nk - 1)
     def _epilogue():
@@ -307,14 +363,18 @@ def _ft_kernel_weighted(
         det_ref[i, j] = count_ref[0]
 
 
-def _scratch_for(strategy, bm, bn):
+def _scratch_for(strategy, bm, bn, multifault):
     acc = pltpu.VMEM((bm, bn), jnp.float32)
     count = pltpu.SMEM((1,), jnp.int32)
     if strategy == "rowcol":
-        return [acc, pltpu.VMEM((bm, 1), jnp.float32),
-                pltpu.VMEM((bn, 1), jnp.float32), count]
+        vecs = [pltpu.VMEM((bm, 1), jnp.float32),
+                pltpu.VMEM((bn, 1), jnp.float32)]
+        if multifault:
+            vecs.append(pltpu.VMEM((bn, 1), jnp.float32))  # cw_exp
+        return [acc, *vecs, count]
     if strategy == "global":
-        return [acc, pltpu.SMEM((1,), jnp.float32), count]
+        return [acc, pltpu.SMEM((1,), jnp.float32),
+                pltpu.SMEM((1,), jnp.float32), count]
     if strategy == "weighted":
         return [acc, pltpu.VMEM((bn, 1), jnp.float32),
                 pltpu.VMEM((bn, 1), jnp.float32), count]
@@ -332,13 +392,13 @@ _KERNELS = {
     jax.jit,
     static_argnames=(
         "shape", "alpha", "beta", "precision", "threshold", "check_every",
-        "strategy", "interpret",
+        "strategy", "interpret", "multifault",
     ),
 )
 def _ft_sgemm_padded(
     a, b, c, inj,
     *, shape: KernelShape, alpha, beta, precision, threshold, check_every,
-    strategy, interpret,
+    strategy, interpret, multifault=False,
 ):
     m, k = a.shape
     n, _ = b.shape
@@ -348,10 +408,11 @@ def _ft_sgemm_padded(
     prec = jax.lax.Precision(precision)
     check_every = max(1, check_every)
 
+    extra = {"multifault": multifault} if strategy == "rowcol" else {}
     kernel = functools.partial(
         _KERNELS[strategy],
         alpha=alpha, beta=beta, nk=nk, prec=prec,
-        threshold=threshold, check_every=check_every, bm=bm, bn=bn,
+        threshold=threshold, check_every=check_every, bm=bm, bn=bn, **extra,
     )
 
     out, det = pl.pallas_call(
@@ -373,7 +434,7 @@ def _ft_sgemm_padded(
             jax.ShapeDtypeStruct((m, n), jnp.float32),
             jax.ShapeDtypeStruct((gm, gn), jnp.int32),
         ],
-        scratch_shapes=_scratch_for(strategy, bm, bn),
+        scratch_shapes=_scratch_for(strategy, bm, bn, multifault),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -393,6 +454,7 @@ def make_ft_sgemm(
     check_every: Optional[int] = None,
     precision: str = "highest",
     in_dtype: str = "float32",
+    multifault: Optional[bool] = None,
     interpret: Optional[bool] = None,
 ):
     """Build the fused-ABFT SGEMM for one named shape.
@@ -402,12 +464,20 @@ def make_ft_sgemm(
     reference lacks). ``check_every`` is the detect/correct cadence in
     K-grid steps; default scales to ~20 checks per run like the reference's
     ``K/20``-column cadence (``code_gen.py:333``), clamped to every step for
-    short K. When injection is enabled, the cadence is further clamped to
-    the injection cadence so at most one fault lands per check interval —
-    intersection/localization correction is only unambiguous for a single
-    fault per interval (the reference has the same property and guarantees
-    it by construction: it checks exactly where it injects,
-    ``code_gen.py:333-337``).
+    short K.
+
+    ``multifault`` (``rowcol`` only) selects the multi-fault-safe variant
+    that carries an extra weighted column checksum so ANY check cadence
+    corrects any number of per-interval faults (one per corrupted column).
+    Default ``None`` auto-selects: skipped only when the injection spec
+    itself proves at most one fault lands per check interval (cadence <=
+    injection period), where the plain intersection is already exact —
+    matching the reference's by-construction guarantee
+    (``code_gen.py:333-337``) at zero extra encode cost; enabled otherwise
+    (including clean runs, where real SDC counts are unknown). For
+    ``rowcol``/``weighted``, the cadence is clamped to ``bn *
+    inject.every`` so the rotating injector cannot wrap two faults into
+    the same column of one interval.
 
     ``in_dtype="bfloat16"`` feeds A/B to the MXU at its full-rate bf16 input
     format; the accumulator, checksums, and detect/correct math all stay
@@ -418,9 +488,12 @@ def make_ft_sgemm(
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
-    if isinstance(shape, str):
+    named = isinstance(shape, str)
+    if named:
         # Named shapes pick up the dtype-tuned tile; explicit KernelShape
-        # objects are always respected as-is.
+        # objects are always respected as-is — including no auto-shrinking,
+        # so a tile sweep (scripts/tune_tiles.py) measures exactly the tile
+        # its row label claims.
         shape = shape_for_dtype(SHAPES[shape], True, in_dtype)
 
     def fn(a, b, c, inject: Optional[InjectionSpec] = None) -> FtSgemmResult:
@@ -429,7 +502,7 @@ def make_ft_sgemm(
         b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
-        eff = _shrink_block(shape, m, n, a.shape[1])
+        eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
         bm, bn, bk = eff.block
         ap = _pad_to(a, bm, bk)
         bp = _pad_to(b, bn, bk)
@@ -441,22 +514,26 @@ def make_ft_sgemm(
             ce = nk  # single final check: localization absorbs fault backlog
         else:
             ce = max(1, nk // 20)
-        if inject.enabled:
-            if strategy == "weighted":
-                # Localization needs the interval's faults in DISTINCT
-                # columns. The rotating target advances the column ordinal
-                # by 1 per scheduled injection (gcd(61, bn) = 1), so up to
-                # bn faults per interval stay distinct; only clamp for
-                # K deep enough to wrap the column cycle.
-                ce = min(ce, bn * max(1, inject.every))
-            else:
-                # Intersection correction needs <= 1 fault per interval.
-                ce = min(ce, max(1, inject.every))
+        if inject.enabled and strategy in ("rowcol", "weighted"):
+            # Column-localized correction needs the interval's faults in
+            # DISTINCT columns. The rotating target advances the column
+            # ordinal by 1 per scheduled injection (gcd(61, bn) = 1), so up
+            # to bn faults per interval stay distinct; only clamp for K
+            # deep enough to wrap the column cycle.
+            ce = min(ce, bn * max(1, inject.every))
+        if strategy != "rowcol":
+            mf = False  # only rowcol reads the flag; keep jit keys stable
+        elif multifault is None:
+            # Auto: the weighted checksum is dead weight iff the injection
+            # schedule guarantees <= 1 fault per check interval.
+            mf = not (inject.enabled and ce <= max(1, inject.every))
+        else:
+            mf = multifault
         out, det = _ft_sgemm_padded(
             ap, bp, cp, jnp.asarray(inject.as_operand()),
             shape=eff, alpha=alpha, beta=beta, precision=precision,
             threshold=threshold, check_every=ce, strategy=strategy,
-            interpret=_should_interpret(interpret),
+            multifault=mf, interpret=_should_interpret(interpret),
         )
         return FtSgemmResult(out[:m, :n], det)
 
@@ -471,11 +548,11 @@ def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              beta=-1.5, inject: Optional[InjectionSpec] = None,
              strategy: str = "rowcol", threshold: float = REFERENCE_THRESHOLD,
              check_every: Optional[int] = None, precision: str = "highest",
-             in_dtype: str = "float32",
+             in_dtype: str = "float32", multifault: Optional[bool] = None,
              interpret: Optional[bool] = None) -> FtSgemmResult:
     """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
     return make_ft_sgemm(
         shape, alpha=alpha, beta=beta, strategy=strategy, threshold=threshold,
         check_every=check_every, precision=precision, in_dtype=in_dtype,
-        interpret=interpret,
+        multifault=multifault, interpret=interpret,
     )(a, b, c, inject)

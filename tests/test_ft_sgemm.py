@@ -128,9 +128,10 @@ def test_below_threshold_fault_not_detected():
 
 
 def test_dense_injection_with_sparse_check_cadence_still_corrects():
-    # Regression: explicit check_every coarser than the injection cadence
-    # would put >1 fault per check interval and make intersection correction
-    # ambiguous; the wrapper clamps the cadence to the injection cadence.
+    # check_every coarser than the injection cadence puts >1 fault per check
+    # interval; bare intersection correction would be ambiguous, so the
+    # multi-fault rowcol variant localizes each flagged column's fault row
+    # via the weighted checksum (no cadence clamp).
     m = n = 128
     k = 1024
     a, b, c = _inputs(m, n, k, seed=21)
@@ -141,6 +142,79 @@ def test_dense_injection_with_sparse_check_cadence_still_corrects():
     ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
     assert ok, f"{nbad} corrupted elements survived"
     assert int(res.num_detected) == inj.expected_faults(k, SHAPES["small"].bk)
+
+
+def test_rowcol_single_final_check_corrects_fault_backlog():
+    # The hardest multi-fault case: ONE deferred check sees every injected
+    # fault at once (>1 row and >1 col flagged — bare row/col intersection
+    # is provably ambiguous for equal magnitudes). The weighted column
+    # checksum localizes each fault.
+    m = n = 128
+    k = 1024  # nk = 8 with bk=128 -> 8 faults in one check interval
+    a, b, c = _inputs(m, n, k, seed=31)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm("small", alpha=ALPHA, beta=BETA, check_every=8)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the deferred check"
+    assert int(res.num_detected) == 8
+
+
+def test_rowcol_coarse_cadence_corrects_multifault_backlog():
+    # Coarse (but not single) cadence with injection denser than the checks
+    # must still fully correct — the exact scenario the removed
+    # ce=min(ce, inject.every) clamp used to forbid.
+    m = n = 256
+    k = 256 * 30  # nk = 30 for the "medium" shape (bk=256)
+    a, b, c = _inputs(m, n, k, seed=32)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm("medium", alpha=ALPHA, beta=BETA, check_every=5)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived"
+    tiles = (m // 128) * (n // 128)  # injection is per output tile
+    assert int(res.num_detected) == tiles * inj.expected_faults(
+        k, SHAPES["medium"].bk)
+
+
+def test_rowcol_deep_k_wraps_column_cycle():
+    # nk > bn with a dense injector would wrap two faults into the same
+    # column of one interval; the wrapper clamps the cadence to bn*every
+    # (column-distinctness window), mirroring the weighted strategy.
+    m = n = 128
+    k = 128 * 130  # nk = 130 > bn = 128 for the "small" shape (bk=128)
+    rng = np.random.default_rng(33)
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm("small", alpha=ALPHA, beta=BETA, check_every=130)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the wrapped column cycle"
+    assert int(res.num_detected) == 130
+
+
+def test_global_counts_distinct_fault_events():
+    # Unified num_detected semantics: a persistent uncorrected fault is ONE
+    # event, not one per later check (the residual only moves when new
+    # corruption lands).
+    m = n = 256
+    k = 2048
+    a, b, c = _inputs(m, n, k, seed=34)
+    shape = SHAPES["huge"]
+    nk = -(-k // shape.bk)
+    for faults in (1, 2):
+        inj = InjectionSpec(enabled=True, every=nk // faults,
+                            magnitude=10000.0)
+        ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="global",
+                           check_every=1)
+        res = ft(a, b, c, inject=inj)
+        assert int(res.num_detected) == inj.expected_faults(k, shape.bk), (
+            f"faults={faults}")
 
 
 def test_expected_faults_counts_padded_k_grid():
