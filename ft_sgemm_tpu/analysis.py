@@ -188,10 +188,12 @@ def detection_rate_sweep(
                        in_dtype=in_dtype, interpret=interpret)
     # Fault accounting must follow the tile the kernel ACTUALLY runs: named
     # shapes may swap to a dtype-tuned tile (configs.BF16_TILE_OVERRIDES)
-    # and oversized blocks shrink to the problem (ops.common.shrink_block).
+    # and their oversized blocks shrink to the problem
+    # (ops.common.shrink_block); explicit KernelShape objects run as-is.
     from ft_sgemm_tpu.ops.common import shrink_block
 
-    eff = shrink_block(ft.shape_config, a.shape[0], b.shape[0], k)
+    eff = (shrink_block(ft.shape_config, a.shape[0], b.shape[0], k)
+           if isinstance(shape, str) else ft.shape_config)
     points = []
     for mag in magnitudes:
         inj = InjectionSpec.reference_like(k, eff.bk, num_faults=num_faults,

@@ -72,7 +72,14 @@ def dump_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
     jaxpr, lowered = lower_variant(shape_name, if_abft, m, n, k, in_dtype)
     out_dir.mkdir(parents=True, exist_ok=True)
     path = out_dir / f"{name}.txt"
-    shape = SHAPES[shape_name]
+    # Record the tile the variant actually lowers with: bf16 named shapes
+    # resolve through configs.BF16_TILE_OVERRIDES, and named shapes
+    # auto-shrink oversized blocks to the problem size.
+    from ft_sgemm_tpu.configs import shape_for_dtype
+    from ft_sgemm_tpu.ops.common import shrink_block
+
+    shape = shrink_block(
+        shape_for_dtype(SHAPES[shape_name], if_abft, in_dtype), m, n, k)
     header = (
         f"// {name}: Pallas TPU kernel variant (M,N,K)=({m},{n},{k})\n"
         f"// block tile (bm,bn,bk)={shape.block}"

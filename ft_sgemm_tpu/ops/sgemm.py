@@ -123,9 +123,12 @@ def make_sgemm(
     operands are already single-pass).
     """
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
-    if isinstance(shape, str):
+    named = isinstance(shape, str)
+    if named:
         # Named shapes pick up the dtype-tuned tile; explicit KernelShape
-        # objects are always respected as-is.
+        # objects are always respected as-is — including no auto-shrinking,
+        # so a tile sweep (scripts/tune_tiles.py) measures exactly the tile
+        # its row label claims.
         shape = shape_for_dtype(SHAPES[shape], False, in_dtype)
 
     def fn(a, b, c):
@@ -133,7 +136,7 @@ def make_sgemm(
         b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
-        eff = _shrink_block(shape, m, n, a.shape[1])
+        eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
         ap = _pad_to(a, eff.bm, eff.bk)
         bp = _pad_to(b, eff.bn, eff.bk)
         cp = _pad_to(c, eff.bm, eff.bn)

@@ -55,19 +55,15 @@ def initialize(coordinator_address: Optional[str] = None,
     this module's surface, not on JAX internals. With no arguments, JAX
     auto-detects TPU pod topology from the environment.
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        # Double-init is a no-op. jax's message is version-dependent:
-        # "distributed.initialize should only be called once." (jax 0.9)
-        # or "already initialized" in other versions.
-        msg = str(e).lower()
-        if "already" not in msg and "once" not in msg:
-            raise
+    # Ask the runtime directly instead of string-matching the double-init
+    # RuntimeError (whose wording varies across JAX versions).
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def make_multihost_mesh(
@@ -78,9 +74,16 @@ def make_multihost_mesh(
 
     ``hosts`` defaults to ``jax.process_count()``; the per-host device
     count is factored into the most-square ``(x, y)`` split unless
-    ``ici_axes`` pins it. Device order follows ``jax.devices()``, which
-    groups devices by process — so the outermost ``host`` axis really maps
-    one slot per process and inter-slot traffic is DCN.
+    ``ici_axes`` pins it.
+
+    The module's core guarantee — heavy collectives (the ``y``-axis psum)
+    stay on ICI, only scalars cross DCN — requires each ``host`` slot to
+    hold exactly one process's devices. ``jax.devices()`` ordering is not
+    contractually process-contiguous on every topology, so devices are
+    explicitly grouped by ``process_index`` here, and slot purity is
+    asserted whenever the job really spans processes. (Single-process
+    meshes — tests, the driver dry-run — can split their local devices
+    into any number of "host" slots; there is no DCN to protect.)
     """
     devs = jax.devices()
     h = hosts or max(jax.process_count(), 1)
@@ -96,7 +99,17 @@ def make_multihost_mesh(
     if x * y != per_host:
         raise ValueError(
             f"ici_axes {ici_axes} != {per_host} devices per host")
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     arr = np.asarray(devs).reshape(h, x, y)
+    if jax.process_count() > 1:
+        for slot in range(h):
+            procs = {d.process_index for d in arr[slot].flat}
+            if len(procs) > 1:
+                raise ValueError(
+                    f"host slot {slot} mixes devices from processes"
+                    f" {sorted(procs)}: the y-axis psum would cross DCN."
+                    f" Use hosts=jax.process_count() (or a multiple of it)"
+                    f" so every slot stays within one process.")
     return Mesh(arr, ("host", "x", "y"))
 
 
