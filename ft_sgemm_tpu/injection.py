@@ -64,9 +64,12 @@ class InjectionSpec:
                        magnitude: float = REFERENCE_MAGNITUDE) -> "InjectionSpec":
         """Schedule ~num_faults faults across the K-grid of a (K, bk) run,
         like the reference's ``(k % (K/20)) == 0`` cadence
-        (``code_gen.py:333``)."""
+        (``code_gen.py:333``). The period rounds to nearest so the realized
+        count lands as close to ``num_faults`` as the grid allows (floor
+        would nearly double it when nk/num_faults is just above 1, e.g.
+        nk=32 -> 32 faults instead of ~20 with the reference's ~16)."""
         num_k_steps = _num_k_steps(K, bk)
-        every = max(1, num_k_steps // num_faults)
+        every = max(1, round(num_k_steps / num_faults))
         return InjectionSpec(enabled=True, every=every, magnitude=magnitude)
 
     def as_operand(self) -> np.ndarray:

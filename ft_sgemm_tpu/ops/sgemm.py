@@ -48,15 +48,22 @@ from ft_sgemm_tpu.ops.common import (
 )
 
 
-def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, acc_ref, *, alpha, beta, nk, prec):
-    """One (i, j, k) grid step: acc += A_blk @ B_blk.T; epilogue at k==nk-1."""
+def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec):
+    """One (i, j, k) grid step: acc += A_blk @ B_blk.T; epilogue at k==nk-1.
+
+    The accumulator IS the f32 output block: Mosaic keeps the (i, j) output
+    window resident in VMEM across the whole K sweep (the block index does
+    not depend on k) and writes it back to HBM once, so accumulating in
+    place is free — and saves a bm*bn*4-byte scratch buffer, VMEM that
+    instead buys larger tiles (the bf16 flagship's limiting resource).
+    """
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _zero():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        out_ref[:] = jnp.zeros_like(out_ref)
 
-    acc_ref[:] += jax.lax.dot_general(
+    out_ref[:] += jax.lax.dot_general(
         a_ref[:],
         b_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -66,7 +73,7 @@ def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, acc_ref, *, alpha, beta, nk, pr
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * acc_ref[:] + beta * c_ref[:]
+        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
 
 
 @functools.partial(
@@ -93,7 +100,6 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),

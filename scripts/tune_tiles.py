@@ -35,17 +35,31 @@ CANDIDATES = [
     (512, 768, 512),
     (768, 512, 512),
     (384, 512, 512),
+    # Enabled by accumulate-in-out_ref (no bm*bn*4 acc scratch): bigger
+    # square output tiles amortize the FT checksum VPU work (encode cost
+    # per FLOP ~ 1/bm + 1/bn).
+    (512, 512, 1024),
+    (768, 768, 512),
+    (1024, 512, 256),
+    (1024, 1024, 256),
 ]
 
 
 BF16_EXTRA = [
     # bf16 halves the A/B tile bytes; deeper/wider tiles fit VMEM.
-    (512, 512, 1024),
+    # ((512, 512, 1024) moved into the shared CANDIDATES list.)
     (512, 1024, 1024),
     (1024, 512, 512),
     (512, 2048, 256),
     (1024, 1024, 512),
     (512, 512, 2048),
+    # Square-tile family freed up by dropping the acc scratch.
+    (1024, 1024, 1024),
+    (768, 768, 768),
+    (1024, 768, 512),
+    (768, 1024, 512),
+    (1536, 512, 512),
+    (512, 1536, 512),
 ]
 
 

@@ -88,6 +88,27 @@ def main():
             print(f"{'ft_sgemm_' + name + ':' + strategy:28s} {gf:9.1f} GFLOPS  "
                   f"{ok_str}  ({gf / xla_gf * 100:5.1f}% of XLA)")
 
+    # Parallel paths on the live chip (1x1 mesh, d=1 ring): Pallas-under-
+    # shard_map must Mosaic-compile at least once per round — the pytest
+    # suite only ever runs these interpreted on CPU, which cannot catch
+    # Mosaic-only lowering failures.
+    from ft_sgemm_tpu.parallel import (  # noqa: E402
+        make_mesh, make_ring_mesh, ring_ft_sgemm, sharded_ft_sgemm)
+
+    inj = InjectionSpec.reference_like(size, SHAPES["huge"].bk)
+    res = sharded_ft_sgemm(a, b, c, make_mesh(1), "huge",
+                           alpha=ALPHA, beta=BETA, inject=inj)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    print(f"{'sharded_ft_sgemm (1x1 mesh)':28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(res.num_detected)}")
+    res = ring_ft_sgemm(a, b, c, make_ring_mesh(1), "huge",
+                        alpha=ALPHA, beta=BETA, inject=inj)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    print(f"{'ring_ft_sgemm (d=1 ring)':28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(res.num_detected)}")
+
     if "--bf16" in sys.argv:
         import jax.numpy as jnp
 
