@@ -45,6 +45,32 @@ def test_dump_bf16_variant(tmp_path):
     assert gen.main(["gen", "medium", "1", "--dtype=float16"]) == 2
 
 
+def test_committed_artifacts_cover_all_variants():
+    """`generated/` is committed like the reference's include_code_gen/
+    (main.py:17-19): 6 shapes x {plain, ft} at f32, plus the bf16
+    flagship pair that has tuned tile overrides."""
+    import pathlib
+
+    from ft_sgemm_tpu.configs import BF16_TILE_OVERRIDES, SHAPE_ORDER
+
+    gen_dir = pathlib.Path(__file__).resolve().parent.parent / "generated"
+    expected = {
+        gen.variant_name(name, if_abft)
+        for name in SHAPE_ORDER for if_abft in (False, True)
+    } | {
+        gen.variant_name(name, if_abft, "bfloat16")
+        for (name, if_abft) in BF16_TILE_OVERRIDES
+    }
+    have = {p.stem for p in gen_dir.glob("*.txt")}
+    assert have == expected, (
+        f"generated/ out of sync: missing {sorted(expected - have)}, "
+        f"stray {sorted(have - expected)} — regenerate with "
+        "`python -m ft_sgemm_tpu.codegen.gen all` (+ the bf16 flagship pair)")
+    for p in gen_dir.glob("*.txt"):
+        text = p.read_text()
+        assert "jaxpr" in text and "lowered" in text, p.name
+
+
 def test_cli_rejects_partial_mnk_and_bad_flags():
     # Lives here (not test_runtime.py) so it runs even without a native
     # toolchain: it only exercises argv parsing. Bad numeric input follows
