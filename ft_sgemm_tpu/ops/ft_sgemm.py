@@ -138,6 +138,24 @@ def _inject(out_ref, inj_ref, k, i, j, bm, bn):
             hit, magnitude, 0.0)
 
 
+def _weighted_localize(res_c, res_cw, det_c, bm, bn):
+    """Per-column fault-row localization by the weighted-residual ratio.
+
+    For each flagged column (``det_c``), the fault row is
+    ``round(res_cw / res_c) - 1`` — the TPU analog of the reference's
+    ``correct_t`` macro (``include/ft_sgemm_huge.cuh:13-17``) with weight
+    base {1..8} generalized to {1..bm}. Returns the (bm, bn) boolean mask
+    of elements to correct; exact while each flagged column holds at most
+    one fault. Shared by the weighted, weighted-precomp, and
+    rowcol-multifault kernels so their correction behavior stays in
+    lockstep.
+    """
+    safe = jnp.where(det_c, res_c, 1.0)
+    loc = jnp.round(res_cw / safe).astype(jnp.int32) - 1     # (1, bn)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
+    return det_c & (rows == loc)
+
+
 def _ft_kernel_rowcol(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
     r_exp_ref, c_exp_ref, *rest,
@@ -227,10 +245,7 @@ def _ft_kernel_rowcol(
                 jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
             csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
             res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
-            safe = jnp.where(det_c, res_c, 1.0)
-            loc = jnp.round(res_cw / safe).astype(jnp.int32) - 1  # (1, bn)
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
-            hit_w = det_c & (rows == loc)
+            hit_w = _weighted_localize(res_c, res_cw, det_c, bm, bn)
             ambiguous = (n_rows_flagged > 1) & (n_cols_flagged > 1)
             hit = jnp.where(ambiguous, hit_w, hit)
             corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
@@ -350,10 +365,7 @@ def _ft_kernel_weighted(
         res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs        # (1, bn)
         res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
         det_c = jnp.abs(res_c) > threshold
-        safe = jnp.where(det_c, res_c, 1.0)
-        loc = jnp.round(res_cw / safe).astype(jnp.int32) - 1  # (1, bn)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0)
-        hit = det_c & (rows == loc)
+        hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
         out_ref[:] += jnp.where(hit, res_c, 0.0)
         count_ref[0] += jnp.sum(hit.astype(jnp.int32))
 
@@ -361,6 +373,114 @@ def _ft_kernel_weighted(
     def _epilogue():
         out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
+
+
+def _ft_kernel_weighted_precomp(
+    inj_ref, a_ref, b_ref, c_ref, exp_ref, out_ref, det_ref,
+    count_ref,
+    *, alpha, beta, nk, prec, threshold, bm, bn,
+):
+    """Weighted variant with PRECOMPUTED expected checksums (deferred check).
+
+    The weighted strategy's default cadence is a single final check (its
+    per-column localization corrects the whole fault backlog at once), so
+    the running ``c_exp``/``cw_exp`` accumulation never serves an
+    intermediate check — the totals are all that is consumed. Those totals
+    are a closed form over the inputs: for output tile (i, j),
+
+        c_exp  = (1^T A_i) B_j^T      cw_exp = (w^T A_i) B_j^T
+
+    which the wrapper computes for ALL tiles with one stacked XLA dot over
+    A (FLOP cost 2 * 2 * (M/bm) * N * K — ~0.2 % of the GEMM at bm=512,
+    full MXU rate). That strips every per-panel VPU/encode instruction out
+    of the kernel body: the hot loop is exactly the plain kernel's MXU dot,
+    and ABFT work happens once, at ``k == nk - 1``. The in-kernel encode
+    variant (:func:`_ft_kernel_weighted`) remains for user-set intermediate
+    cadences (``check_every < nk``), which need running partial sums.
+
+    Fault-coverage semantics are unchanged: expectations come from a
+    separate accumulation path over the same rounded inputs, so any
+    accumulator corruption (injected or real SDC) still surfaces as a
+    column residual at the final check, localized by the weighted ratio.
+    """
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        count_ref[0] = 0
+
+    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+
+    out_ref[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+
+    @pl.when(k == nk - 1)
+    def _detect_correct_epilogue():
+        w_col = jax.lax.broadcasted_iota(
+            jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+        acc = out_ref[:]
+        cs = jnp.sum(acc, axis=0, keepdims=True)             # (1, bn)
+        csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
+        res_c = exp_ref[0:1, :] - cs                         # (1, bn)
+        res_cw = exp_ref[1:2, :] - csw                       # (1, bn)
+        det_c = jnp.abs(res_c) > threshold
+        hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
+        corrected = acc + jnp.where(hit, res_c, 0.0)
+        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+        out_ref[:] = alpha * corrected + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+
+
+def _expected_col_checksums(ap, bp, bm, prec):
+    """Per-tile expected (plain, weighted) column checksums, via XLA.
+
+    ``ap`` is the padded (M, K) input in the kernel's consumption dtype
+    (checksums must see the same rounded values the MXU consumes). Returns
+    one (8 * M/bm, N) f32 array: within each 8-row group i, row 0 holds
+    ``1^T A_i @ B^T`` and row 1 ``w^T A_i @ B^T`` (weights {1..bm}), rows
+    2-7 are zero — an (8, bn)-blockable layout (Mosaic requires sublane
+    dims divisible by 8).
+
+    For bf16 inputs the checksum rows are carried as hi+lo bf16 pairs
+    (``x ~= bf16(x) + bf16(x - bf16(x))``) and the halves summed after the
+    dot: a single bf16 cast of ``w^T A_i`` (magnitudes up to ~1e4) leaves
+    ~0.3-1.4 of residual noise that the correction would deposit INTO the
+    corrected elements, failing the 0.01/0.01 verify tolerance; the split
+    brings expectation error down to the f32 accumulation-noise class at
+    unchanged MXU cost (4 sublanes instead of 2 in the same tile row).
+    """
+    m, kdim = ap.shape
+    gm = m // bm
+    af = ap.astype(jnp.float32).reshape(gm, bm, kdim)
+    w = (jnp.arange(bm, dtype=jnp.float32) + 1.0)[None, :, None]
+    sa = jnp.sum(af, axis=1)            # (gm, K)
+    swa = jnp.sum(af * w, axis=1)       # (gm, K)
+    stacked_f32 = jnp.concatenate([sa, swa], axis=0)
+    if ap.dtype == jnp.bfloat16:
+        hi = stacked_f32.astype(jnp.bfloat16)
+        lo = (stacked_f32 - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        stacked = jnp.concatenate([hi, lo], axis=0)   # (4*gm, K)
+    else:
+        stacked = stacked_f32
+    exp = jax.lax.dot_general(
+        stacked, bp,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )                                    # (2*gm or 4*gm, N) f32
+    if ap.dtype == jnp.bfloat16:
+        exp = exp[: 2 * gm] + exp[2 * gm:]
+    grouped = jnp.zeros((gm, 8, exp.shape[1]), jnp.float32)
+    grouped = grouped.at[:, 0, :].set(exp[:gm])
+    grouped = grouped.at[:, 1, :].set(exp[gm:])
+    return grouped.reshape(8 * gm, exp.shape[1])
 
 
 def _scratch_for(strategy, bm, bn, multifault):
@@ -409,22 +529,43 @@ def _ft_sgemm_padded(
     prec = jax.lax.Precision(precision)
     check_every = max(1, check_every)
 
-    extra = {"multifault": multifault} if strategy == "rowcol" else {}
-    kernel = functools.partial(
-        _KERNELS[strategy],
-        alpha=alpha, beta=beta, nk=nk, prec=prec,
-        threshold=threshold, check_every=check_every, bm=bm, bn=bn, **extra,
-    )
+    # Weighted strategy at its default single-final-check cadence: expected
+    # checksums are closed-form totals, precomputed by XLA outside the
+    # kernel (see _ft_kernel_weighted_precomp). Intermediate cadences need
+    # the running in-kernel encode.
+    precomp = strategy == "weighted" and check_every >= nk
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (3,)
+        pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+        pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+        pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+    ]
+    operands = [inj, a, b, c]
+    if precomp:
+        kernel = functools.partial(
+            _ft_kernel_weighted_precomp,
+            alpha=alpha, beta=beta, nk=nk, prec=prec,
+            threshold=threshold, bm=bm, bn=bn,
+        )
+        exp = _expected_col_checksums(a, b, bm, prec)
+        in_specs += [pl.BlockSpec((8, bn), lambda i, j, kk: (i, j))]
+        operands += [exp]
+        scratch = [pltpu.SMEM((1,), jnp.int32)]
+    else:
+        extra = {"multifault": multifault} if strategy == "rowcol" else {}
+        kernel = functools.partial(
+            _KERNELS[strategy],
+            alpha=alpha, beta=beta, nk=nk, prec=prec,
+            threshold=threshold, check_every=check_every, bm=bm, bn=bn,
+            **extra,
+        )
+        scratch = _scratch_for(strategy, bm, bn, multifault)
 
     out, det = pl.pallas_call(
         kernel,
         grid=(gm, gn, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (3,)
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
             # Full-array SMEM block: each (i, j) program writes its own cell
@@ -435,13 +576,13 @@ def _ft_sgemm_padded(
             jax.ShapeDtypeStruct((m, n), jnp.float32),
             jax.ShapeDtypeStruct((gm, gn), jnp.int32),
         ],
-        scratch_shapes=_scratch_for(strategy, bm, bn, multifault),
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
-    )(inj, a, b, c)
+    )(*operands)
     return out, det
 
 

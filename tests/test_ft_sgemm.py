@@ -93,6 +93,47 @@ def test_weighted_strategy_corrects():
     assert int(res.num_detected) == inj.expected_faults(k, shape.bk)
 
 
+def test_weighted_precomp_and_inkernel_cadences_agree():
+    """Default weighted cadence routes to the precomputed-checksum kernel
+    (no in-kernel encode); an intermediate cadence routes to the running
+    in-kernel encode. Both must correct the same injected schedule."""
+    m = n = 512
+    k = 2048
+    a, b, c = _inputs(m, n, k, seed=12)
+    shape = SHAPES["huge"]
+    nk = k // shape.bk
+    inj = InjectionSpec.reference_like(k, shape.bk, num_faults=4)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    for ce in (None, max(1, nk // 2)):  # None -> nk -> precomp path
+        ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA,
+                           strategy="weighted", check_every=ce)
+        res = ft(a, b, c, inject=inj)
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"check_every={ce}: {nbad} corrupted elements survived"
+        assert int(res.num_detected) == inj.expected_faults(k, shape.bk)
+
+
+def test_weighted_precomp_bf16_corrects():
+    """bf16 input mode through the precomputed-checksum path: expectations
+    are computed on the same bf16-rounded values the MXU consumes, so the
+    residual noise floor stays far below the 9500 threshold."""
+    m = n = 512
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=13)
+    ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="weighted",
+                       in_dtype="bfloat16")
+    # The bf16 flagship resolves to its own tuned tile (BF16_TILE_OVERRIDES)
+    # whose bk differs from the f32 tile — fault counts follow its K grid.
+    bk = ft.shape_config.bk
+    inj = InjectionSpec.reference_like(k, bk, num_faults=4)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(
+        sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"bf16 precomp: {nbad} corrupted elements survived"
+    assert int(res.num_detected) == inj.expected_faults(k, bk)
+
+
 def test_global_strategy_detects_but_does_not_correct():
     m = n = 512
     k = 1024
