@@ -134,6 +134,35 @@ def test_weighted_precomp_bf16_corrects():
     assert int(res.num_detected) == inj.expected_faults(k, bk)
 
 
+def test_precomp_expectation_noise_floor_bf16():
+    """The bf16 hi+lo checksum-row split keeps precomputed-expectation
+    error in the f32 accumulation-noise class. A single bf16 cast of
+    ``w^T A`` (magnitudes ~1e4) costs ~0.3-1.4 of noise — deposited into
+    every corrected element, which fails the 0.01/0.01 verify tolerance.
+    Regression-guards the split in ``_expected_col_checksums``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu.ops.ft_sgemm import _expected_col_checksums
+
+    m = n = 512
+    k = 1024
+    a, b, _ = _inputs(m, n, k, seed=13)
+    a16 = jnp.asarray(a, jnp.bfloat16)
+    b16 = jnp.asarray(b, jnp.bfloat16)
+    exp = _expected_col_checksums(a16, b16, m, jax.lax.Precision("default"))
+    acc = jax.lax.dot_general(
+        a16, b16, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    w = (jnp.arange(m, dtype=jnp.float32) + 1.0)[:, None]
+    res_c = np.asarray(exp[0] - jnp.sum(acc, axis=0))
+    res_cw = np.asarray(exp[1] - jnp.sum(acc * w, axis=0))
+    # Bounds ~20x above observed f32 accumulation noise (0.004 / 1.2) and
+    # ~15x below the single-cast regression (0.3-1.4 / 100+).
+    assert np.abs(res_c).max() < 0.02, np.abs(res_c).max()
+    assert np.abs(res_cw).max() < 20.0, np.abs(res_cw).max()
+
+
 def test_global_strategy_detects_but_does_not_correct():
     m = n = 512
     k = 1024

@@ -48,13 +48,16 @@ def test_sharded_ft_clean_matches_oracle():
     assert int(res.num_detected) == 0
 
 
-def test_sharded_ft_corrects_injected_faults_before_psum():
+@pytest.mark.parametrize("strategy", ["rowcol", "weighted"])
+def test_sharded_ft_corrects_injected_faults_before_psum(strategy):
+    # "weighted" at default cadence routes to the precomputed-checksum
+    # kernel — exercising the XLA expectation dots under shard_map.
     mesh = make_mesh(8)
     m, n, k = 256, 128, 512
     a, b, c = _inputs(m, n, k, seed=4)
     inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
     res = sharded_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
-                           inject=inj)
+                           inject=inj, strategy=strategy)
     want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
     ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
     assert ok, f"{nbad} corrupted elements survived the cross-chip psum"
