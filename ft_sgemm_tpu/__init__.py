@@ -40,6 +40,12 @@ from ft_sgemm_tpu.ops.ft_sgemm import (
     make_ft_sgemm,
 )
 from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
+from ft_sgemm_tpu.ops.attention import (
+    FtAttentionResult,
+    attention_reference,
+    ft_attention,
+    make_ft_attention,
+)
 
 __version__ = "0.1.0"
 
@@ -57,4 +63,8 @@ __all__ = [
     "FtSgemmResult",
     "STRATEGIES",
     "abft_baseline_sgemm",
+    "FtAttentionResult",
+    "attention_reference",
+    "ft_attention",
+    "make_ft_attention",
 ]

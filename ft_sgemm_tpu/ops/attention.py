@@ -1,0 +1,154 @@
+"""Fault-tolerant attention: ABFT-protected ``softmax(Q K^T / sqrt(d)) V``.
+
+A capability extension beyond the reference (which is a pure GEMM study —
+SURVEY.md §5 notes it has no attention or sequence dimension), built the way
+the retrieved ABFT-for-attention literature prescribes (PAPERS.md: "Custom
+Algorithm-based Fault Tolerance for Attention Layers in Transformers"): the
+two GEMMs inside attention are where the FLOPs and the silent-data-corruption
+exposure are, and each is protected by the framework's fused-ABFT kernels —
+faults in either accumulator are detected and corrected in-kernel, so they
+never reach the softmax or the output.
+
+The softmax stage itself is elementwise VPU work that linear checksums cannot
+cover. It carries its own *algebraic invariant* instead: every row of
+``P = softmax(S)`` sums to exactly 1, so ``max_i |1 - sum_j P[i, j]|`` is a
+zero-FLOP detection residual for the normalization stage (detect-only — a
+flagged row has no redundancy to reconstruct from; re-run the row). This is
+the attention analog of the reference's checksum residual test.
+
+GEMM shape mapping (the framework's kernels compute ``A @ B^T``):
+
+  S = scale * Q K^T    ->  ft_sgemm(a=Q (L, d),  b=K (Lk, d),  alpha=scale)
+  O = P V              ->  ft_sgemm(a=P (L, Lk), b=V^T (dv, Lk))
+
+Multi-head / batched use: ``jax.vmap`` over the leading axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+# Attention-tuned tiles. QK^T contracts over the head dim (64-256): a
+# shallow-K tile avoids padding the contraction several-fold. P@V contracts
+# over the (long) key sequence with a narrow output (dv columns): K-deep,
+# bn-minimal. Explicit KernelShape objects are used as-is (no auto-shrink);
+# small problems pad up to these tiles — pass smaller shapes to tune.
+QK_SHAPE = KernelShape("attn_qk", 256, 256, 128, (0,) * 7)
+PV_SHAPE = KernelShape("attn_pv", 256, 128, 512, (0,) * 7)
+
+# Clean-run |1 - rowsum(softmax)| is a few f32 ulps (observed < 1e-6 at
+# Lk = 4096); 1e-3 sits ~3 orders above the noise floor and far below any
+# fault that could meaningfully skew a probability row.
+SOFTMAX_RESIDUAL_THRESHOLD = 1e-3
+
+
+class FtAttentionResult(NamedTuple):
+    """Output of a fault-tolerant attention call.
+
+    ``detections`` counts corrected accumulator faults across both GEMMs;
+    ``softmax_flags`` counts rows whose softmax normalization invariant
+    (rowsum == 1) broke — detect-only, 0 on clean runs.
+    """
+
+    out: jax.Array            # (L, dv)
+    detections: jax.Array     # scalar int32 — corrected GEMM faults
+    softmax_flags: jax.Array  # scalar int32 — flagged softmax rows
+
+    @property
+    def num_detected(self):
+        return self.detections
+
+
+def softmax_rowsum_residual(p) -> jax.Array:
+    """Max |1 - rowsum(p)|: the softmax normalization invariant residual."""
+    return jnp.max(jnp.abs(1.0 - jnp.sum(p, axis=-1)))
+
+
+def make_ft_attention(
+    *,
+    scale: Optional[float] = None,
+    strategy: str = "weighted",
+    threshold: float = REFERENCE_THRESHOLD,
+    softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
+    qk_shape: KernelShape = QK_SHAPE,
+    pv_shape: KernelShape = PV_SHAPE,
+    in_dtype: str = "float32",
+    interpret: Optional[bool] = None,
+):
+    """Build ``fn(q, k, v, inject=None) -> FtAttentionResult``.
+
+    ``q`` (L, d), ``k`` (Lk, d), ``v`` (Lk, dv); any sizes (kernels pad).
+    ``scale`` defaults to 1/sqrt(d). ``inject`` drives BOTH protected GEMMs
+    (fault counts add). Default strategy is ``weighted``: at its deferred
+    single-check cadence the FT GEMM hot loop is identical to the plain
+    kernel's (see ops/ft_sgemm.py), so protected attention costs ~one extra
+    detect/correct pass per GEMM.
+    """
+    qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
+                       threshold=threshold, in_dtype=in_dtype,
+                       interpret=interpret)
+    pv = make_ft_sgemm(pv_shape, alpha=1.0, beta=0.0, strategy=strategy,
+                       threshold=threshold, in_dtype=in_dtype,
+                       interpret=interpret)
+
+    def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
+        sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+        zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
+        s = qk(q, k, zs, inject)
+        p = jax.nn.softmax(sc * s.c, axis=-1)
+        flags = jnp.sum(
+            (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
+            .astype(jnp.int32))
+        zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
+        o = pv(p, jnp.swapaxes(v, 0, 1), zo, inject)
+        det = jnp.sum(s.detections) + jnp.sum(o.detections)
+        return FtAttentionResult(o.c, det, flags)
+
+    fn.strategy = strategy
+    fn.in_dtype = in_dtype
+    return fn
+
+
+def ft_attention(q, k, v, *, inject: Optional[InjectionSpec] = None,
+                 **kwargs) -> FtAttentionResult:
+    """One-shot fault-tolerant attention (see :func:`make_ft_attention`)."""
+    return make_ft_attention(**kwargs)(q, k, v, inject)
+
+
+def attention_reference(q, k, v, *, scale: Optional[float] = None,
+                        in_dtype: str = "float32") -> jax.Array:
+    """Plain XLA attention oracle for differential tests.
+
+    Inputs are rounded to ``in_dtype`` like the kernel path, but the
+    intermediate ``P = softmax(S)`` stays f32 here while the bf16 kernel
+    path rounds P once more feeding the PV GEMM — so bf16 comparisons
+    carry ~1e-2 relative P-rounding noise on top of input rounding (tests
+    use a correspondingly looser tolerance).
+    """
+    dt = jnp.dtype(in_dtype)
+    q = jnp.asarray(q, dt).astype(jnp.float32)
+    k = jnp.asarray(k, dt).astype(jnp.float32)
+    v = jnp.asarray(v, dt).astype(jnp.float32)
+    sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    p = jax.nn.softmax(sc * (q @ k.T), axis=-1)
+    return p @ v
+
+
+__all__ = [
+    "FtAttentionResult",
+    "PV_SHAPE",
+    "QK_SHAPE",
+    "SOFTMAX_RESIDUAL_THRESHOLD",
+    "attention_reference",
+    "ft_attention",
+    "make_ft_attention",
+    "softmax_rowsum_residual",
+]

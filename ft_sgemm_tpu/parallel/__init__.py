@@ -10,6 +10,7 @@ from ft_sgemm_tpu.parallel.ring import (
     ring_ft_sgemm,
     ring_sgemm,
 )
+from ft_sgemm_tpu.parallel.ring_attention import ring_ft_attention
 from ft_sgemm_tpu.parallel.sharded import (
     make_mesh,
     sharded_ft_sgemm,
@@ -22,6 +23,7 @@ __all__ = [
     "make_multihost_mesh",
     "multihost_ft_sgemm",
     "make_ring_mesh",
+    "ring_ft_attention",
     "ring_ft_sgemm",
     "ring_sgemm",
     "sharded_ft_sgemm",

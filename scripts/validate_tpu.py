@@ -112,6 +112,28 @@ def main():
           f"verify={'OK' if ok else f'FAIL({nbad})'} "
           f"det={int(res.num_detected)}")
 
+    # FT attention (both GEMMs ABFT-protected + softmax invariant): Mosaic-
+    # compile + verify the composed op and its ring form on the live chip.
+    from ft_sgemm_tpu import attention_reference, ft_attention  # noqa: E402
+    from ft_sgemm_tpu.parallel import ring_ft_attention  # noqa: E402
+
+    la, dh = min(size, 2048), 128
+    q = jax.device_put(generate_random_matrix(la, dh, rng=rng))
+    kk = jax.device_put(generate_random_matrix(la, dh, rng=rng))
+    vv = jax.device_put(generate_random_matrix(la, dh, rng=rng))
+    inj1 = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    want_att = np.asarray(attention_reference(q, kk, vv))
+    ares = ft_attention(q, kk, vv, inject=inj1)
+    ok, nbad, _ = verify_matrix(want_att, np.asarray(ares.out), verbose=False)
+    print(f"{'ft_attention (L=%d)' % la:28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(ares.detections)} softmax_flags={int(ares.softmax_flags)}")
+    ares = ring_ft_attention(q, kk, vv, make_ring_mesh(1), inject=inj1)
+    ok, nbad, _ = verify_matrix(want_att, np.asarray(ares.out), verbose=False)
+    print(f"{'ring_ft_attention (d=1)':28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(ares.detections)}")
+
     if "--bf16" in sys.argv:
         import jax.numpy as jnp
 
