@@ -18,8 +18,13 @@ the attention analog of the reference's checksum residual test.
 
 GEMM shape mapping (the framework's kernels compute ``A @ B^T``):
 
-  S = scale * Q K^T    ->  ft_sgemm(a=Q (L, d),  b=K (Lk, d),  alpha=scale)
+  S = Q K^T            ->  ft_sgemm(a=Q (L, d),  b=K (Lk, d))
   O = P V              ->  ft_sgemm(a=P (L, Lk), b=V^T (dv, Lk))
+
+``scale`` is applied OUTSIDE the first kernel (not as its alpha): the ABFT
+residual check then sees the unscaled ``Q K^T`` accumulator, so fault
+magnitudes compare against the detection threshold undamped — a 1e4 fault
+stays 1e4 at the check, rather than 1e4/sqrt(d).
 
 Multi-head / batched use: ``jax.vmap`` over the leading axis.
 """

@@ -1,0 +1,66 @@
+"""Live FT-attention benchmark: overhead of ABFT-protected attention.
+
+Measures plain XLA attention vs ft_attention (both GEMMs through the
+fused-ABFT kernels, injection on) at long sequence lengths on the real
+chip. GFLOPS counts the two GEMMs (2*L*Lk*d + 2*L*Lk*dv), the standard
+attention accounting.
+
+Usage: python scripts/bench_attention.py [L] [--bf16]
+"""
+
+import sys
+
+import numpy as np
+import jax
+
+sys.path.insert(0, ".")
+
+from ft_sgemm_tpu import InjectionSpec, make_ft_attention  # noqa: E402
+from ft_sgemm_tpu.ops.attention import attention_reference  # noqa: E402
+from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
+from ft_sgemm_tpu.utils.timing import bench_seconds_per_call  # noqa: E402
+
+D_HEAD = 128
+
+
+def main():
+    size = 4096
+    for tok in sys.argv[1:]:
+        if tok.isdigit():
+            size = int(tok)
+    in_dtype = "bfloat16" if "--bf16" in sys.argv else "float32"
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    rng = np.random.default_rng(10)
+    q = jax.device_put(generate_random_matrix(size, D_HEAD, rng=rng))
+    k = jax.device_put(generate_random_matrix(size, D_HEAD, rng=rng))
+    v = jax.device_put(generate_random_matrix(size, D_HEAD, rng=rng))
+    flop = 2.0 * size * size * D_HEAD * 2  # QK^T + PV
+
+    # bench_seconds_per_call has the (a, b, c) GEMM calling shape — attention
+    # maps (q, k, v) onto it directly.
+    xla = lambda q, k, v: attention_reference(q, k, v, in_dtype=in_dtype)  # noqa: E731
+    sec = bench_seconds_per_call(xla, q, k, v, min_device_time=2.0)
+    xla_gf = flop / 1e9 / sec
+    print(f"{'xla_attention':24s} {xla_gf:10.1f} GFLOPS")
+
+    fn = make_ft_attention(in_dtype=in_dtype)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = fn(q, k, v, inj)
+    print(f"  det={int(res.detections)} softmax_flags="
+          f"{int(res.softmax_flags)}")
+    # Fold detections/softmax_flags into the timed output so XLA cannot
+    # dead-code-eliminate the invariant checks being benchmarked (1e-30
+    # scaling, not *0.0 — the algebraic simplifier folds the latter).
+    def ft(q, k, v):
+        r = fn(q, k, v, inj)
+        return r.out + (r.detections + r.softmax_flags).astype(np.float32) * 1e-30
+    sec = bench_seconds_per_call(ft, q, k, v, min_device_time=2.0)
+    ft_gf = flop / 1e9 / sec
+    print(f"{'ft_attention (inject on)':24s} {ft_gf:10.1f} GFLOPS  "
+          f"({ft_gf / xla_gf * 100:5.1f}% of XLA attention, "
+          f"overhead {100 * (1 - ft_gf / xla_gf):.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,28 @@ def test_bf16_input_mode():
     assert int(res.detections) > 0
 
 
+def test_multihead_via_vmap():
+    """Multi-head use is jax.vmap over the single-head op (module
+    docstring): pallas_call batches, detections count per head."""
+    import jax
+
+    rng = np.random.default_rng(17)
+    h, l, d = 3, 128, 64
+    q, k, v = (rng.uniform(-1, 1, (h, l, d)).astype(np.float32)
+               for _ in range(3))
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    fn = make_ft_attention()
+    res = jax.vmap(lambda q, k, v: fn(q, k, v, inj))(q, k, v)
+    assert res.out.shape == (h, l, d)
+    want = np.stack([np.asarray(attention_reference(q[i], k[i], v[i]))
+                     for i in range(h)])
+    for i in range(h):
+        ok, nbad, _ = verify_matrix(want[i], np.asarray(res.out[i]),
+                                    verbose=False)
+        assert ok, f"head {i}: {nbad} corrupted elements survived"
+    assert np.all(np.asarray(res.detections) > 0)
+
+
 def test_softmax_invariant_flags_corrupted_rows():
     import jax.numpy as jnp
 
