@@ -77,9 +77,30 @@ def softmax_rowsum_residual(p) -> jax.Array:
     return jnp.max(jnp.abs(1.0 - jnp.sum(p, axis=-1)))
 
 
+def _check_causal_lengths(lq: int, lk: int) -> None:
+    """Causal masking needs ``lq <= lk`` (end-aligned positions): leading
+    query rows would otherwise attend to zero keys and their softmax is
+    undefined. Shared by the single-device and ring paths."""
+    if lq > lk:
+        raise ValueError(
+            f"causal attention needs L_q ({lq}) <= L_k ({lk}): leading"
+            " queries would attend to zero keys")
+
+
+def causal_mask_bias(lq: int, lk: int) -> jax.Array:
+    """(lq, lk) additive bias: 0 where query may attend, -inf above the
+    causal diagonal. Positions align at the sequence END (the decoding
+    convention): query row i sits at key position ``i + (lk - lq)``."""
+    _check_causal_lengths(lq, lk)
+    qpos = jnp.arange(lq)[:, None] + (lk - lq)
+    kpos = jnp.arange(lk)[None, :]
+    return jnp.where(kpos <= qpos, 0.0, -jnp.inf).astype(jnp.float32)
+
+
 def make_ft_attention(
     *,
     scale: Optional[float] = None,
+    causal: bool = False,
     strategy: str = "weighted",
     threshold: float = REFERENCE_THRESHOLD,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
@@ -91,7 +112,10 @@ def make_ft_attention(
     """Build ``fn(q, k, v, inject=None) -> FtAttentionResult``.
 
     ``q`` (L, d), ``k`` (Lk, d), ``v`` (Lk, dv); any sizes (kernels pad).
-    ``scale`` defaults to 1/sqrt(d). ``inject`` drives BOTH protected GEMMs
+    ``scale`` defaults to 1/sqrt(d). ``causal=True`` applies the decoder
+    mask (end-aligned positions) AFTER the QK kernel's detect/correct, so
+    faults landing at masked positions are still corrected in-kernel before
+    the mask zeroes their influence. ``inject`` drives BOTH protected GEMMs
     (fault counts add). Default strategy is ``weighted``: at its deferred
     single-check cadence the FT GEMM hot loop is identical to the plain
     kernel's (see ops/ft_sgemm.py), so protected attention costs ~one extra
@@ -105,10 +129,16 @@ def make_ft_attention(
                        interpret=interpret)
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
+        if causal:
+            # Validate BEFORE launching any kernel work.
+            _check_causal_lengths(q.shape[0], k.shape[0])
         sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
         zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
         s = qk(q, k, zs, inject)
-        p = jax.nn.softmax(sc * s.c, axis=-1)
+        logits = sc * s.c
+        if causal:
+            logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
+        p = jax.nn.softmax(logits, axis=-1)
         flags = jnp.sum(
             (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
             .astype(jnp.int32))
@@ -119,6 +149,7 @@ def make_ft_attention(
 
     fn.strategy = strategy
     fn.in_dtype = in_dtype
+    fn.causal = causal
     return fn
 
 
@@ -129,6 +160,7 @@ def ft_attention(q, k, v, *, inject: Optional[InjectionSpec] = None,
 
 
 def attention_reference(q, k, v, *, scale: Optional[float] = None,
+                        causal: bool = False,
                         in_dtype: str = "float32") -> jax.Array:
     """Plain XLA attention oracle for differential tests.
 
@@ -143,7 +175,10 @@ def attention_reference(q, k, v, *, scale: Optional[float] = None,
     k = jnp.asarray(k, dt).astype(jnp.float32)
     v = jnp.asarray(v, dt).astype(jnp.float32)
     sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    p = jax.nn.softmax(sc * (q @ k.T), axis=-1)
+    logits = sc * (q @ k.T)
+    if causal:
+        logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
+    p = jax.nn.softmax(logits, axis=-1)
     return p @ v
 
 
@@ -153,6 +188,7 @@ __all__ = [
     "QK_SHAPE",
     "SOFTMAX_RESIDUAL_THRESHOLD",
     "attention_reference",
+    "causal_mask_bias",
     "ft_attention",
     "make_ft_attention",
     "softmax_rowsum_residual",

@@ -53,6 +53,7 @@ def ring_ft_attention(
     mesh: Mesh,
     *,
     scale: Optional[float] = None,
+    causal: bool = False,
     inject: Optional[InjectionSpec] = None,
     strategy: str = "weighted",
     threshold: float = REFERENCE_THRESHOLD,
@@ -79,6 +80,10 @@ def ring_ft_attention(
     dnum = mesh.shape["x"]
     _check_divisible("L_q", lq, dnum)
     _check_divisible("L_k", lk, dnum)
+    if causal:
+        from ft_sgemm_tpu.ops.attention import _check_causal_lengths
+
+        _check_causal_lengths(lq, lk)
     sc = (1.0 / math.sqrt(d_head)) if scale is None else scale
 
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
@@ -90,17 +95,32 @@ def ring_ft_attention(
     perm = [(i, (i + 1) % dnum) for i in range(dnum)]
 
     def step_fn(q_loc, k_loc, vt_loc):
+        my = jax.lax.axis_index("x")
         nq = q_loc.shape[0]
-        zs = jnp.zeros((nq, k_loc.shape[0]), jnp.float32)
+        nk_blk = k_loc.shape[0]
+        zs = jnp.zeros((nq, nk_blk), jnp.float32)
         zo = jnp.zeros((nq, dv), jnp.float32)
+        # Global positions, end-aligned (decoding convention): local query
+        # row r sits at key position my*nq + r + (lk - lq).
+        qpos = (my * nq + jnp.arange(nq) + (lk - lq))[:, None]
 
         def hop(t, carry):
             m, l, o, k_vis, vt_vis, det = carry
             s_res = qk(q_loc, k_vis, zs, inject)
             s_t = sc * s_res.c
+            if causal:
+                # The visiting block started at device mod(my - t, dnum);
+                # mask runs AFTER the QK kernel's detect/correct, so faults
+                # at masked positions are corrected, then silenced.
+                owner = jnp.mod(my - t, dnum)
+                kpos = owner * nk_blk + jnp.arange(nk_blk)[None, :]
+                s_t = jnp.where(kpos <= qpos, s_t, -jnp.inf)
+            # Masked-block-safe online softmax: m_new may stay -inf while a
+            # device has only future keys; exp() then sees finite args only.
             m_new = jnp.maximum(m, jnp.max(s_t, axis=1, keepdims=True))
-            a = jnp.exp(m - m_new)
-            p_t = jnp.exp(s_t - m_new)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            a = jnp.where(m == m_new, 1.0, jnp.exp(m - m_safe))
+            p_t = jnp.exp(s_t - m_safe)
             o_res = pv(p_t, vt_vis, zo, inject)
             o = a * o + o_res.c
             l = a * l + jnp.sum(p_t, axis=1, keepdims=True)

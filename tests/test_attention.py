@@ -88,6 +88,48 @@ def test_bf16_input_mode():
     assert int(res.detections) > 0
 
 
+def test_causal_matches_oracle_and_corrects():
+    q, k, v = _qkv(256, 256, 128, 128, seed=19)
+    fn = make_ft_attention(causal=True)
+    res = fn(q, k, v)
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(np.asarray(res.out), want, rtol=1e-4,
+                               atol=1e-5)
+    assert int(res.softmax_flags) == 0
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = fn(q, k, v, inj)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.out), verbose=False)
+    assert ok, f"causal: {nbad} corrupted elements survived"
+    assert int(res.detections) > 0
+
+
+def test_causal_shorter_query_end_aligned():
+    # Decoding convention: L_q < L_k aligns at the end; the first query row
+    # already sees lk - lq + 1 keys.
+    q, k, v = _qkv(128, 384, 64, 64, seed=23)
+    res = ft_attention(q, k, v, causal=True)
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(np.asarray(res.out), want, rtol=1e-4,
+                               atol=1e-5)
+    with pytest.raises(ValueError, match="causal"):
+        ft_attention(k[:, :64], q[:, :64], v, causal=True)  # L_q > L_k
+
+
+def test_ring_causal_matches_oracle():
+    mesh = make_ring_mesh(8)
+    q, k, v = _qkv(256, 512, 128, 128, seed=29)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    res = ring_ft_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(res.out), want, rtol=1e-4,
+                               atol=1e-5)
+    assert int(res.softmax_flags) == 0
+    res = ring_ft_attention(q, k, v, mesh, causal=True, inject=inj)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.out), verbose=False)
+    assert ok, f"ring causal: {nbad} corrupted elements survived"
+    assert int(res.detections) > 0
+
+
 def test_multihead_via_vmap():
     """Multi-head use is jax.vmap over the single-head op (module
     docstring): pallas_call batches, detections count per head."""
