@@ -144,6 +144,21 @@ def _measure(context, errors):
     ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, strategy="weighted")
     ft_gflops = stage("ft_weighted", lambda a, b, x: ft(a, b, x, inj).c,
                       a, b, c, attempts=3)
+    if ft_gflops is None:
+        # The default cadence routes to the precomputed-expectation kernel;
+        # if that path fails on this backend, fall back to the in-kernel
+        # encode variant (any check_every < nk) so the round still gets a
+        # valid FT headline. Same strategy, same correction guarantees.
+        nk = SIZE // ft.shape_config.bk
+        ft_fb = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                              strategy="weighted",
+                              check_every=max(1, nk // 2))
+        ft_gflops = stage("ft_weighted_inkernel",
+                          lambda a, b, x: ft_fb(a, b, x, inj).c,
+                          a, b, c, attempts=2)
+        if ft_gflops is not None:
+            context["strategy"] = ("weighted (in-kernel encode fallback,"
+                                   " 2 checks)")
 
     xla = stage("xla_dot", lambda a, b, x: sgemm_reference(a, b, x, 1.0, -1.5),
                 a, b, c)
