@@ -134,6 +134,24 @@ def test_weighted_precomp_bf16_corrects():
     assert int(res.num_detected) == inj.expected_faults(k, bk)
 
 
+def test_weighted_bf16_inkernel_cadence_corrects():
+    """bf16 weighted at an INTERMEDIATE cadence (in-kernel running encode,
+    not the precomp path) — the remaining strategy x dtype x cadence cell."""
+    m = n = 512
+    k = 1024
+    a, b, c = _inputs(m, n, k, seed=14)
+    ft = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="weighted",
+                       in_dtype="bfloat16", check_every=2)
+    bk = ft.shape_config.bk
+    inj = InjectionSpec.reference_like(k, bk, num_faults=4)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(
+        sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"bf16 in-kernel weighted: {nbad} corrupted elements survived"
+    assert int(res.num_detected) == inj.expected_faults(k, bk)
+
+
 def test_precomp_expectation_noise_floor_bf16():
     """The bf16 hi+lo checksum-row split keeps precomputed-expectation
     error in the f32 accumulation-noise class. A single bf16 cast of
