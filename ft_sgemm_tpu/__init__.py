@@ -46,6 +46,7 @@ from ft_sgemm_tpu.ops.attention import (
     ft_attention,
     make_ft_attention,
 )
+from ft_sgemm_tpu.ops.autodiff import ft_matmul, make_ft_matmul
 
 __version__ = "0.1.0"
 
@@ -67,4 +68,6 @@ __all__ = [
     "attention_reference",
     "ft_attention",
     "make_ft_attention",
+    "ft_matmul",
+    "make_ft_matmul",
 ]

@@ -1,0 +1,107 @@
+"""Differentiable fault-tolerant matmul: ABFT on the backward pass too.
+
+The reference is an inference-style kernel study — nothing differentiates.
+A TPU framework is expected to sit inside ``jax.grad``/``jax.jit`` training
+steps, so this module provides ``ft_matmul``: a ``jax.custom_vjp`` matmul
+whose forward AND backward products all run through the fused-ABFT kernels.
+SDC striking any of the three GEMMs of a linear layer's step (forward
+``A Bᵀ``, gradient ``g B`` and ``gᵀ A``) is detected and corrected
+in-kernel before it can poison activations, gradients, or optimizer state.
+
+Semantics: ``ft_matmul(a, b) = a @ b.T`` with ``a`` (M, K), ``b`` (N, K) —
+the framework's native GEMM orientation (a linear layer with stored weight
+``W`` (N, K) applied to activations ``x`` (M, K)).
+
+  dA = g @ B      -> kernel(a=g (M, N), b=Bᵀ (K, N))
+  dB = gᵀ @ A     -> kernel(a=gᵀ (N, M), b=Aᵀ (K, M))
+
+Detection counts are not part of the differentiable value (a custom_vjp
+primal must be the array the cotangent flows against); use
+:func:`ft_sgemm_tpu.ft_sgemm` directly where counts must be observable.
+
+**Threshold scale caveat.** ABFT detection compares checksum residuals
+against an ABSOLUTE threshold. Gradients are usually orders of magnitude
+smaller than forward activations (mean-reduced losses scale cotangents by
+1/batch), so an SDC large relative to gradient scale can still sit below
+the forward-calibrated threshold and pass undetected. ``bwd_threshold``
+exists for exactly this: set it near the backward pass's own noise floor
+(``analysis.estimate_noise_floor`` on (g, b) / (g, a) scales) to keep the
+gradient GEMMs' detection as tight as the forward one's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+
+@functools.lru_cache(maxsize=64)
+def _kernels(shape, strategy, threshold, in_dtype, interpret):
+    fn = make_ft_sgemm(shape, alpha=1.0, beta=0.0, strategy=strategy,
+                       threshold=threshold, in_dtype=in_dtype,
+                       interpret=interpret)
+    return fn
+
+
+def make_ft_matmul(
+    shape="huge",
+    *,
+    strategy: str = "weighted",
+    threshold: float = REFERENCE_THRESHOLD,
+    bwd_threshold: Optional[float] = None,
+    inject: Optional[InjectionSpec] = None,
+    in_dtype: str = "float32",
+    interpret: Optional[bool] = None,
+):
+    """Build a differentiable ``fn(a, b) = a @ b.T`` with FT fwd + bwd.
+
+    ``inject`` (static at build time) drives all three protected GEMMs —
+    the self-test mode; default None runs clean. ``bwd_threshold``
+    (default: ``threshold``) sets the gradient GEMMs' detection threshold
+    separately — gradients live at a much smaller scale than activations,
+    so a tighter backward threshold catches SDC the forward-calibrated one
+    would miss (module docstring). The returned function is a
+    ``jax.custom_vjp``: compose freely with ``jit``/``grad``/``vmap``.
+    """
+    inj = inject or InjectionSpec.none()
+    kern = _kernels(shape, strategy, threshold, in_dtype, interpret)
+    bwd_kern = _kernels(
+        shape, strategy,
+        threshold if bwd_threshold is None else bwd_threshold,
+        in_dtype, interpret)
+
+    @jax.custom_vjp
+    def ft_mm(a, b):
+        z = jnp.zeros((a.shape[0], b.shape[0]), jnp.float32)
+        return kern(a, b, z, inj).c
+
+    def fwd(a, b):
+        return ft_mm(a, b), (a, b)
+
+    def bwd(res, g):
+        a, b = res
+        zk_a = jnp.zeros((g.shape[0], a.shape[1]), jnp.float32)
+        zk_b = jnp.zeros((g.shape[1], a.shape[1]), jnp.float32)
+        # dA = g @ B: kernel contracts over the second axis of both args.
+        da = bwd_kern(g, jnp.swapaxes(b, 0, 1), zk_a, inj).c
+        # dB = g^T @ A.
+        db = bwd_kern(jnp.swapaxes(g, 0, 1), jnp.swapaxes(a, 0, 1),
+                      zk_b, inj).c
+        return da.astype(a.dtype), db.astype(b.dtype)
+
+    ft_mm.defvjp(fwd, bwd)
+    return ft_mm
+
+
+def ft_matmul(a, b, **kwargs):
+    """One-shot differentiable FT matmul (see :func:`make_ft_matmul`)."""
+    return make_ft_matmul(**kwargs)(a, b)
+
+
+__all__ = ["ft_matmul", "make_ft_matmul"]
