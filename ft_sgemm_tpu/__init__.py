@@ -45,6 +45,7 @@ from ft_sgemm_tpu.ops.attention import (
     attention_reference,
     ft_attention,
     make_ft_attention,
+    make_ft_attention_diff,
 )
 from ft_sgemm_tpu.ops.autodiff import ft_matmul, make_ft_matmul
 
@@ -68,6 +69,7 @@ __all__ = [
     "attention_reference",
     "ft_attention",
     "make_ft_attention",
+    "make_ft_attention_diff",
     "ft_matmul",
     "make_ft_matmul",
 ]

@@ -159,6 +159,104 @@ def ft_attention(q, k, v, *, inject: Optional[InjectionSpec] = None,
     return make_ft_attention(**kwargs)(q, k, v, inject)
 
 
+def make_ft_attention_diff(
+    *,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    strategy: str = "weighted",
+    threshold: float = REFERENCE_THRESHOLD,
+    bwd_threshold: Optional[float] = None,
+    inject: Optional[InjectionSpec] = None,
+    qk_shape: KernelShape = QK_SHAPE,
+    pv_shape: KernelShape = PV_SHAPE,
+    in_dtype: str = "float32",
+    interpret: Optional[bool] = None,
+):
+    """Differentiable FT attention: ABFT on all six GEMMs of fwd + bwd.
+
+    Returns ``fn(q, k, v) -> (L, dv)`` as a ``jax.custom_vjp``. Forward
+    runs the two protected GEMMs of :func:`make_ft_attention`; backward
+    runs the four attention-gradient GEMMs through FT kernels too:
+
+        dV = Pᵀ g      dP = g Vᵀ
+        dS = P ⊙ (dP − rowsum(dP ⊙ P)) · scale     (softmax bwd, VPU)
+        dQ = dS K      dK = dSᵀ Q
+
+    The elementwise softmax forward/backward stages are the only
+    unprotected compute — and unlike :func:`make_ft_attention`, this path
+    computes NO softmax rowsum invariant either (a custom_vjp primal is
+    just the output array, so there is no channel for flags): softmax-stage
+    SDC is undetected here. Where softmax detection or fault counts
+    matter, use :func:`make_ft_attention`. ``bwd_threshold`` tightens the
+    gradient GEMMs' detection threshold — cotangents usually live far
+    below activation scale (see ops/autodiff.py). ``inject`` is static at
+    build time and drives all six GEMMs.
+    """
+    if strategy == "global":
+        raise ValueError(
+            "make_ft_attention_diff requires a CORRECTING strategy: "
+            "'global' only detects, and the differentiable API discards "
+            "detection counts — faults would pass silently. Pick 'rowcol' "
+            "or 'weighted', or use make_ft_attention for detect-only runs.")
+    inj = inject or InjectionSpec.none()
+    bthr = threshold if bwd_threshold is None else bwd_threshold
+    mk = lambda shp, thr: make_ft_sgemm(  # noqa: E731
+        shp, alpha=1.0, beta=0.0, strategy=strategy, threshold=thr,
+        in_dtype=in_dtype, interpret=interpret)
+    qk = mk(qk_shape, threshold)
+    pv = mk(pv_shape, threshold)
+    # Long-contraction grads (dV, dQ, dK) share pv's profile; the
+    # short-contraction dP shares qk's. Reuse the forward kernels when the
+    # backward threshold is unchanged.
+    b_long = pv if bthr == threshold else mk(pv_shape, bthr)
+    b_short = qk if bthr == threshold else mk(qk_shape, bthr)
+
+    def _fwd_parts(q, k, v):
+        if causal:
+            _check_causal_lengths(q.shape[0], k.shape[0])
+        sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+        zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
+        logits = sc * qk(q, k, zs, inj).c
+        if causal:
+            logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
+        p = jax.nn.softmax(logits, axis=-1)
+        zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
+        o = pv(p, jnp.swapaxes(v, 0, 1), zo, inj).c
+        return o, p, sc
+
+    @jax.custom_vjp
+    def att(q, k, v):
+        return _fwd_parts(q, k, v)[0]
+
+    def fwd_fn(q, k, v):
+        o, p, sc = _fwd_parts(q, k, v)
+        return o, (q, k, v, p, sc)
+
+    def bwd_fn(res, g):
+        q, k, v, p, sc = res
+        lq, lk = p.shape
+        dv_z = jnp.zeros((lk, v.shape[1]), jnp.float32)
+        dp_z = jnp.zeros((lq, lk), jnp.float32)
+        dq_z = jnp.zeros((lq, q.shape[1]), jnp.float32)
+        dk_z = jnp.zeros((lk, k.shape[1]), jnp.float32)
+        pt = jnp.swapaxes(p, 0, 1)
+        # dV = P^T g: contract over L_q -> kernel(a=P^T (Lk, L), b=g^T).
+        dv = b_long(pt, jnp.swapaxes(g, 0, 1), dv_z, inj).c
+        # dP = g V^T: contract over dv -> kernel(a=g, b=V (Lk, dv)).
+        dp = b_short(g, v, dp_z, inj).c
+        # Softmax backward (elementwise; masked entries have p == 0).
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True)) * sc
+        # dQ = dS K: contract over L_k -> kernel(a=dS, b=K^T (d, Lk)).
+        dq = b_long(ds, jnp.swapaxes(k, 0, 1), dq_z, inj).c
+        # dK = dS^T Q: contract over L_q.
+        dk = b_long(jnp.swapaxes(ds, 0, 1), jnp.swapaxes(q, 0, 1),
+                    dk_z, inj).c
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+    att.defvjp(fwd_fn, bwd_fn)
+    return att
+
+
 def attention_reference(q, k, v, *, scale: Optional[float] = None,
                         causal: bool = False,
                         in_dtype: str = "float32") -> jax.Array:
@@ -191,5 +289,6 @@ __all__ = [
     "causal_mask_bias",
     "ft_attention",
     "make_ft_attention",
+    "make_ft_attention_diff",
     "softmax_rowsum_residual",
 ]

@@ -69,6 +69,12 @@ def make_ft_matmul(
     would miss (module docstring). The returned function is a
     ``jax.custom_vjp``: compose freely with ``jit``/``grad``/``vmap``.
     """
+    if strategy == "global":
+        raise ValueError(
+            "make_ft_matmul requires a CORRECTING strategy: 'global' only "
+            "detects, and the differentiable API discards detection counts "
+            "— faults would pass silently. Pick 'rowcol' or 'weighted', or "
+            "use ft_sgemm directly for detect-only runs.")
     inj = inject or InjectionSpec.none()
     kern = _kernels(shape, strategy, threshold, in_dtype, interpret)
     bwd_kern = _kernels(

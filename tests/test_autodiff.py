@@ -82,6 +82,17 @@ def test_bwd_threshold_catches_small_faults():
         assert ok, f"{name}: {nbad} small faults survived tight threshold"
 
 
+def test_detect_only_strategy_rejected():
+    """The differentiable APIs discard detection counts, so a detect-only
+    strategy would provide zero protection — both factories refuse it."""
+    from ft_sgemm_tpu import make_ft_attention_diff
+
+    with pytest.raises(ValueError, match="CORRECTING"):
+        make_ft_matmul(TILE, strategy="global")
+    with pytest.raises(ValueError, match="CORRECTING"):
+        make_ft_attention_diff(strategy="global")
+
+
 def test_composes_with_jit_and_vmap():
     a, b = _ab(128, 128, 128, seed=5)
     mm = make_ft_matmul(TILE)
@@ -93,6 +104,39 @@ def test_composes_with_jit_and_vmap():
     outs = jax.vmap(mm)(ab, bb)
     np.testing.assert_allclose(np.asarray(outs[1]), a @ b.T, rtol=1e-4,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ft_attention_diff_grads_match_reference(causal):
+    """All six GEMMs (2 fwd + 4 bwd) ABFT-protected: attention gradients
+    match the plain-JAX reference, clean AND with injection on."""
+    from ft_sgemm_tpu import attention_reference, make_ft_attention_diff
+
+    rng = np.random.default_rng(11)
+    l, d = 256, 128
+    q, k, v = (generate_random_matrix(l, d, rng=rng) for _ in range(3))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(
+            attention_reference(q, k, v, causal=causal)))
+
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    att = make_ft_attention_diff(causal=causal)
+    got = jax.grad(lambda q, k, v: jnp.sum(jnp.tanh(att(q, k, v))),
+                   argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-5)
+
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    att = make_ft_attention_diff(causal=causal, inject=inj)
+    got = jax.grad(lambda q, k, v: jnp.sum(jnp.tanh(att(q, k, v))),
+                   argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, ("dQ", "dK", "dV")):
+        ok, nbad, _ = verify_matrix(np.asarray(w), np.asarray(g),
+                                    verbose=False)
+        assert ok, f"{name}: {nbad} corrupted elements survived"
 
 
 def test_training_step_converges_under_injection():
