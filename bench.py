@@ -9,36 +9,398 @@ Also embeds context fields: XLA f32 dot GFLOPS on the same chip and the
 fraction of it we reach (north-star target >= 0.80, BASELINE.json), the
 plain (non-FT) kernel GFLOPS, and the fused-ABFT overhead.
 
-Resilience: the axon TPU tunnel occasionally fails backend init or a
-compile with a transient error (round-1 postmortem: BENCH_r01.json died in
-the first ``jax.device_put``). Backend bring-up is retried with exponential
-backoff (~2 min budget), every measurement stage is independently retried,
-a wall-clock deadline (``FT_SGEMM_BENCH_DEADLINE`` seconds, default 1500)
-skips remaining context stages when the tunnel crawls, and the JSON line
-is ALWAYS emitted — with whatever stages succeeded and the per-stage
-errors recorded in ``context.errors``. Exit code is 0 iff the headline
-value was measured.
+Architecture (round-3 rework): a SUPERVISOR / WORKER split.
+
+Rounds 1 and 2 both lost their number to the axon TPU tunnel:
+``BENCH_r01.json`` rc=1 (backend init raised), ``BENCH_r02.json`` rc=124
+(backend init HUNG — two xla_bridge warnings 25 minutes apart, then the
+driver's SIGKILL).  A hang inside ``jax.devices()`` blocks in C and cannot
+be interrupted from Python in-process, so no amount of in-process retry or
+deadline checking protects the JSON line.  Therefore:
+
+* The supervisor (this file's ``main``) never imports jax.  It launches the
+  measurement as a child subprocess in its own process group, enforces a
+  hard per-attempt budget (SIGTERM, then SIGKILL), relaunches while the
+  headline is missing and budget remains, and ALWAYS prints the JSON line
+  assembled from whatever stage records landed on disk.
+* The worker (``--worker RECORDS``) appends one JSON record per completed
+  stage to the records file (fsync'd), headline FIRST, so a kill at any
+  moment loses at most the stage in flight.  A fresh worker resumes: it
+  reads the records file and skips completed stages.
+* The supervisor handles SIGTERM/SIGINT by killing the worker group and
+  flushing the JSON line before exiting — so even a driver that times the
+  whole script out gets a parseable artifact as long as it sends SIGTERM
+  before SIGKILL.
+
+Budget knobs (env): ``FT_SGEMM_BENCH_DEADLINE`` total seconds (default 900,
+well under any plausible driver window), ``FT_SGEMM_BENCH_WORKER_MAX`` per
+attempt (default 480), ``FT_SGEMM_BENCH_MARGIN`` reserved for final
+assembly (default 30), ``FT_SGEMM_BENCH_GRACE`` SIGTERM->SIGKILL (default
+5), ``FT_SGEMM_BENCH_MIN_ATTEMPT`` smallest budget worth launching a
+worker for (default 90), ``FT_SGEMM_BENCH_RECORDS`` records path (default
+a fresh temp file; point at an existing file to resume).
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 import traceback
-
-import numpy as np
-
-sys.path.insert(0, ".")
 
 SIZE = 4096
 REFERENCE_ABFT_HUGE_GFLOPS = 4005.0  # sm_80, reference README.md:53
 _T0 = time.monotonic()
-_DEADLINE = float(os.environ.get("FT_SGEMM_BENCH_DEADLINE", 1500.0))
+_DEADLINE = float(os.environ.get("FT_SGEMM_BENCH_DEADLINE", 900.0))
+_WORKER_MAX = float(os.environ.get("FT_SGEMM_BENCH_WORKER_MAX", 480.0))
+_MARGIN = float(os.environ.get("FT_SGEMM_BENCH_MARGIN", 30.0))
+_GRACE = float(os.environ.get("FT_SGEMM_BENCH_GRACE", 5.0))
+_MIN_ATTEMPT = float(os.environ.get("FT_SGEMM_BENCH_MIN_ATTEMPT", 90.0))
 
 
 def _time_left() -> float:
     return _DEADLINE - (time.monotonic() - _T0)
 
+
+# --------------------------------------------------------------------------
+# Stage records: one JSON object per line, later lines win.
+# {"name": str, "ok": true, "value": any} | {"name": str, "ok": false,
+#  "error": str}
+# --------------------------------------------------------------------------
+
+def _read_records(path):
+    values, errors = {}, {}
+    try:
+        # errors="replace": a SIGKILL mid-write can tear a multi-byte UTF-8
+        # sequence; decoding must never take down the emit path.
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn write from a killed worker
+                if not isinstance(rec, dict):
+                    continue  # stray scalar/array line in a resumed file
+                name = rec.get("name")
+                if rec.get("ok"):
+                    values[name] = rec.get("value")
+                    errors.pop(name, None)
+                else:
+                    errors[name] = rec.get("error", "unknown")
+    except (OSError, ValueError):
+        pass
+    return values, errors
+
+
+# Context stages the worker wants beyond the headline; _worker_rc derives
+# the supervisor-facing exit status from the records alone.
+WANTED_STAGES = ("backend", "xla_dot", "plain_huge", "ft_rowcol",
+                 "bf16_abft", "bf16_plain", "bf16_xla")
+
+
+def _worker_rc(rec):
+    """rc protocol: 0 = every stage recorded (supervisor stops
+    relaunching), 3 = headline safe but context stages missing (supervisor
+    may relaunch a resuming worker while budget remains), 1 = no
+    headline."""
+    if not rec.done("ft_headline"):
+        return 1
+    return 0 if all(rec.done(w) for w in WANTED_STAGES) else 3
+
+
+class Recorder:
+    """Append-only, fsync'd stage log shared across worker attempts."""
+
+    def __init__(self, path):
+        self.path = path
+        self.values, self.errors = _read_records(path)
+
+    def done(self, name):
+        return name in self.values
+
+    def _write(self, rec):
+        # Best-effort: an unwritable records file (disk full, bad
+        # user-supplied path) must degrade to losing persistence, never
+        # raise into a crash handler that is itself trying to record.
+        try:
+            # A SIGKILLed predecessor can leave a torn, newline-less
+            # tail; appending directly would glue this record onto the
+            # unparseable line and lose it. Start fresh in that case.
+            lead = ""
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        if f.read(1) != b"\n":
+                            lead = "\n"
+            except OSError:
+                pass
+            with open(self.path, "a") as f:
+                f.write(lead + json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            sys.stderr.write(f"bench: records write failed ({e}); "
+                             f"record kept in memory only: {rec}\n")
+
+    def ok(self, name, value):
+        self.values[name] = value
+        self.errors.pop(name, None)
+        self._write({"name": name, "ok": True, "value": value})
+
+    def fail(self, name, error):
+        self.errors[name] = error
+        self._write({"name": name, "ok": False, "error": str(error)})
+
+
+# --------------------------------------------------------------------------
+# Supervisor
+# --------------------------------------------------------------------------
+
+_CHILD = None
+_EMITTED = False
+_FINAL_RC = None
+_RECORDS_PATH = None
+_ATTEMPTS = 0
+
+
+def _worker_output():
+    """A real fd for worker stdout/stderr (keeps the supervisor's stdout
+    clean for the JSON line; worker chatter lands in the artifact tail)."""
+    try:
+        sys.stderr.fileno()
+        return sys.stderr
+    except Exception:  # noqa: BLE001 — pytest capture objects lack fileno
+        return subprocess.DEVNULL
+
+
+def _kill_child():
+    global _CHILD
+    proc = _CHILD
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        proc.wait(timeout=_GRACE)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _emit(values, errors, extra_errors=None):
+    """Assemble and print THE json line from stage records. Returns rc.
+
+    Signal-safe: SIGTERM/SIGINT are masked during assembly+print so the
+    handler (which also funnels here) cannot interrupt mid-emit and
+    os._exit before the line lands; a second call after a completed emit
+    returns the latched rc instead of clobbering the contract.
+    """
+    global _EMITTED, _FINAL_RC
+    if _EMITTED:
+        return _FINAL_RC if _FINAL_RC is not None else 1
+    try:
+        old_mask = signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
+    except (AttributeError, ValueError, OSError):
+        old_mask = None
+    try:
+        if _EMITTED:
+            return _FINAL_RC if _FINAL_RC is not None else 1
+        _EMITTED = True
+        try:
+            _FINAL_RC = _emit_locked(values, errors, extra_errors)
+        except Exception as e:  # noqa: BLE001 — a line MUST still print
+            print(json.dumps({
+                "metric": "abft_kernel_huge_gflops_4096", "value": None,
+                "unit": "GFLOPS", "vs_baseline": None,
+                "context": {"errors": {
+                    "emit": f"{type(e).__name__}: {e}"}},
+            }), flush=True)
+            sys.stderr.write(traceback.format_exc())
+            _FINAL_RC = 1
+        return _FINAL_RC
+    finally:
+        if old_mask is not None:
+            signal.pthread_sigmask(signal.SIG_SETMASK, old_mask)
+
+
+def _emit_locked(values, errors, extra_errors=None):
+    errors = dict(errors)
+    errors.update(extra_errors or {})
+
+    ft_rec = values.get("ft_headline")
+    ft = ft_rec.get("gflops") if isinstance(ft_rec, dict) else ft_rec
+    context = {}
+    if isinstance(ft_rec, dict) and ft_rec.get("strategy"):
+        context["strategy"] = ft_rec["strategy"]
+    backend = values.get("backend")
+    if isinstance(backend, dict):
+        context.update(backend)
+
+    key_map = {
+        "xla_dot": "xla_dot_gflops",
+        "plain_huge": "kernel_sgemm_huge_gflops",
+        "ft_rowcol": "abft_rowcol_gflops",
+        "bf16_abft": "bf16_abft_huge_gflops",
+        "bf16_plain": "bf16_sgemm_huge_gflops",
+        "bf16_xla": "bf16_xla_dot_gflops",
+        "injected_faults_per_tile": "injected_faults_per_tile",
+    }
+    for src, dst in key_map.items():
+        if src in values and values[src] is not None:
+            v = values[src]
+            context[dst] = round(v, 1) if isinstance(v, float) else v
+
+    xla = values.get("xla_dot")
+    plain = values.get("plain_huge")
+    if ft is not None and xla:
+        context["ft_vs_xla"] = round(ft / xla, 3)
+    if ft is not None and plain:
+        context["abft_overhead"] = round(1.0 - ft / plain, 3)
+    bf_ft, bf_xla = values.get("bf16_abft"), values.get("bf16_xla")
+    bf_plain = values.get("bf16_plain")
+    if bf_ft and bf_xla:
+        context["bf16_ft_vs_xla"] = round(bf_ft / bf_xla, 3)
+    if bf_plain and bf_xla:
+        context["bf16_plain_vs_xla"] = round(bf_plain / bf_xla, 3)
+
+    context["bench_attempts"] = _ATTEMPTS
+    context["errors"] = errors
+    print(json.dumps({
+        "metric": "abft_kernel_huge_gflops_4096",
+        "value": None if ft is None else round(ft, 1),
+        "unit": "GFLOPS",
+        "vs_baseline": (None if ft is None
+                        else round(ft / REFERENCE_ABFT_HUGE_GFLOPS, 3)),
+        "context": context,
+    }), flush=True)
+    return 0 if ft is not None else 1
+
+
+def _emit_from_disk(extra_errors=None):
+    values, errors = _read_records(_RECORDS_PATH) if _RECORDS_PATH else ({}, {})
+    return _emit(values, errors, extra_errors)
+
+
+def _on_signal(signum, frame):
+    """Driver timeout path: flush the JSON line, kill the worker, exit.
+
+    Emit FIRST: the records are already on disk and the worker never
+    writes to stdout, while killing a tunnel-hung worker can block up to
+    ~2x grace — a driver with a short SIGTERM->SIGKILL window must not be
+    able to SIGKILL us before the line lands. The worker is then reaped
+    here or, failing even that, by its PR_SET_PDEATHSIG when we exit."""
+    rc = _emit_from_disk({"signal": f"supervisor received signal {signum}"})
+    _kill_child()
+    os._exit(rc)
+
+
+def _worker_preexec():
+    """Runs in the forked child: die with the supervisor.
+
+    start_new_session detaches the worker from the driver's process group,
+    so a driver that SIGKILLs the supervisor directly (no SIGTERM) would
+    otherwise orphan a jax-hung worker holding the TPU tunnel forever.
+    PR_SET_PDEATHSIG delivers SIGKILL to the worker the moment the
+    supervisor dies, whatever killed it."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGKILL, 0, 0, 0)  # 1 = PR_SET_PDEATHSIG
+    except Exception:  # noqa: BLE001 — best-effort; non-Linux fallback
+        pass
+
+
+def main():
+    global _CHILD, _RECORDS_PATH, _ATTEMPTS
+    _RECORDS_PATH = os.environ.get("FT_SGEMM_BENCH_RECORDS")
+    if not _RECORDS_PATH:
+        fd, _RECORDS_PATH = tempfile.mkstemp(prefix="ft_sgemm_bench_",
+                                             suffix=".jsonl")
+        os.close(fd)
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    worker_rc = None
+    extra = {}
+    completed_partials = 0
+    while True:
+        values, _ = _read_records(_RECORDS_PATH)
+        remaining = _time_left() - _MARGIN
+        if remaining < _MIN_ATTEMPT:
+            break
+        if worker_rc == 0:
+            break  # worker finished everything it wanted
+        if "ft_headline" in values and remaining < 2 * _MIN_ATTEMPT:
+            break  # headline safe; not enough budget to chase context stages
+        if worker_rc == 3:
+            # A worker RAN TO COMPLETION with the headline safe but some
+            # context stages failed. One fresh-process relaunch covers
+            # transient tunnel errors; beyond that the failures are
+            # deterministic and relaunching just re-pays backend init.
+            completed_partials += 1
+            if completed_partials >= 2:
+                break
+        if _ATTEMPTS >= 8:
+            break
+        budget = min(_WORKER_MAX, remaining)
+        attempt_t0 = time.monotonic()
+        env = dict(os.environ)
+        env["FT_SGEMM_WORKER_DEADLINE"] = str(budget)
+        out = _worker_output()
+        try:
+            _CHILD = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 _RECORDS_PATH],
+                stdout=out, stderr=out, start_new_session=True,
+                preexec_fn=_worker_preexec, env=env)
+        except Exception as e:  # noqa: BLE001 — the JSON line must survive
+            extra["worker_launch"] = f"{type(e).__name__}: {e}"
+            sys.stderr.write(traceback.format_exc())
+            break
+        _ATTEMPTS += 1
+        try:
+            worker_rc = _CHILD.wait(timeout=budget + _GRACE)
+        except subprocess.TimeoutExpired:
+            _kill_child()
+            worker_rc = "killed (per-attempt budget exhausted)"
+        _CHILD = None
+        if worker_rc not in (0, 3) and time.monotonic() - attempt_t0 < 60:
+            # A fast failure is a tunnel outage, not a slow measurement:
+            # pace relaunches across the remaining budget (outages last
+            # seconds to minutes) instead of burning the attempt cap in
+            # the first minutes and idling away the rest of the deadline.
+            pause = min(45.0, 5.0 * (2 ** (_ATTEMPTS - 1)))
+            pause = min(pause,
+                        max(0.0, _time_left() - _MARGIN - _MIN_ATTEMPT))
+            if pause > 0:
+                time.sleep(pause)  # SIGTERM still handled during sleep
+
+    # rc 3 is the protocol's "headline safe, context incomplete" status —
+    # not an error; the individual skipped stages carry their own records.
+    if worker_rc not in (0, 3, None):
+        extra["worker_rc"] = str(worker_rc)
+    return _emit_from_disk(extra)
+
+
+# --------------------------------------------------------------------------
+# Worker
+# --------------------------------------------------------------------------
 
 def _retry(what, fn, errors, attempts=4, base=3.0):
     """Run fn() with exponential-backoff retries; record failure and return
@@ -49,68 +411,88 @@ def _retry(what, fn, errors, attempts=4, base=3.0):
     for i in range(attempts):
         try:
             return fn()
-        except Exception as e:  # noqa: BLE001 — must never kill the JSON line
+        except Exception as e:  # noqa: BLE001 — must never kill the worker
             last = e
             last_tb = traceback.format_exc()
             if i < attempts - 1:
                 time.sleep(min(base * (2 ** i), 60.0))
     errors[what] = f"{type(last).__name__}: {last}"
-    sys.stderr.write(f"bench: stage {what!r} failed after {attempts}"
+    sys.stderr.write(f"bench worker: stage {what!r} failed after {attempts}"
                      f" attempts:\n{last_tb}")
     return None
 
 
-def _init_backend(errors):
-    """Bring up the JAX backend (retrying, ~4-5 min budget) and return
-    device info."""
+def worker_main(records_path):
+    rec = Recorder(records_path)
+    try:
+        return _worker_stages(rec)
+    except Exception as e:  # noqa: BLE001 — a crash must leave a record
+        # Deterministic failures outside any _retry wrapper (imports,
+        # kernel factories) land here so the artifact says WHAT died
+        # instead of just worker_rc=1 (the round-1 failure mode).
+        rec.fail("worker_crash", f"{type(e).__name__}: {e}")
+        sys.stderr.write(traceback.format_exc())
+        return _worker_rc(rec)
+
+
+def _worker_stages(rec):
+    deadline = float(os.environ.get("FT_SGEMM_WORKER_DEADLINE", _WORKER_MAX))
+    t0 = time.monotonic()
+
+    def left():
+        return deadline - (time.monotonic() - t0)
+
+    # Test hooks: exercise the supervisor's kill / assemble paths without a
+    # TPU or a jax import (tests/test_bench.py). Honored ONLY under pytest
+    # so a leftover env var can never fabricate a scored artifact.
+    if os.environ.get("PYTEST_CURRENT_TEST"):
+        fake = os.environ.get("FT_SGEMM_BENCH_FAKE_VALUE")
+        if fake:
+            rec.ok("backend", {"backend": "fake", "device": "fake",
+                               "num_devices": 1})
+            rec.ok("ft_headline", {"gflops": float(fake),
+                                   "strategy": "fake"})
+            rec.ok("xla_dot", float(fake) * 1.05)
+            return 0
+        if os.environ.get("FT_SGEMM_BENCH_FAKE_HANG"):
+            time.sleep(100000)
+
+    if _worker_rc(rec) == 0:
+        return 0  # resume of a finished run: skip jax init entirely
+
+    errors = {}
+
+    def record_retry(name, fn, attempts=3, base=2.0):
+        if rec.done(name):
+            return rec.values[name]
+        if left() < 20:
+            rec.fail(name, "skipped: worker deadline reached")
+            return None
+        out = _retry(name, fn, errors, attempts=attempts, base=base)
+        if out is None:
+            rec.fail(name, errors.get(name, "unknown"))
+        else:
+            rec.ok(name, out)
+        return out
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+
     import jax
 
     def probe():
         devs = jax.devices()
         x = jax.device_put(np.zeros((8, 128), np.float32))
         jax.block_until_ready(x)
-        return devs
+        return {"backend": jax.default_backend(), "device": str(devs[0]),
+                "num_devices": len(devs)}
 
-    # Backoff sleeps 5+10+20+40+60x3 = 255s (~4.3 min) across 8 attempts,
-    # plus probe time: axon tunnel outages observed live range from seconds
-    # to hours; this covers the short tail without eating the whole
-    # FT_SGEMM_BENCH_DEADLINE budget.
-    devs = _retry("backend_init", probe, errors, attempts=8, base=5.0)
-    if devs is None:
-        return None
-    return {"backend": jax.default_backend(),
-            "device": str(devs[0]), "num_devices": len(devs)}
+    # Short in-process retries only: a HANG here is bounded by the
+    # supervisor's per-attempt kill, and a fresh worker process is the
+    # better retry for tunnel outages.
+    if record_retry("backend", probe) is None:
+        return _worker_rc(rec)
 
-
-def main():
-    errors = {}
-    context = {"strategy": "weighted (deferred single-check localization)"}
-    ft_gflops = None
-
-    dev_info = _init_backend(errors)
-    if dev_info is not None:
-        context.update(dev_info)
-        try:
-            ft_gflops = _measure(context, errors)
-        except Exception as e:  # noqa: BLE001 — the JSON line must survive
-            errors["measure"] = f"{type(e).__name__}: {e}"
-            sys.stderr.write(traceback.format_exc())
-
-    context["errors"] = errors
-    print(json.dumps({
-        "metric": "abft_kernel_huge_gflops_4096",
-        "value": None if ft_gflops is None else round(ft_gflops, 1),
-        "unit": "GFLOPS",
-        "vs_baseline": (None if ft_gflops is None
-                        else round(ft_gflops / REFERENCE_ABFT_HUGE_GFLOPS, 3)),
-        "context": context,
-    }), flush=True)
-    return 0 if ft_gflops is not None else 1
-
-
-def _measure(context, errors):
-    """All measurement stages; returns the headline GFLOPS (or None)."""
-    import jax
     import jax.numpy as jnp
 
     from ft_sgemm_tpu import InjectionSpec, SHAPES, make_ft_sgemm, make_sgemm
@@ -126,101 +508,132 @@ def _measure(context, errors):
             jax.device_put(generate_random_matrix(SIZE, SIZE, rng=rng))
             for _ in range(3))
 
-    inputs = _retry("device_put_inputs", put_inputs, errors, attempts=4)
+    inputs = _retry("device_put_inputs", put_inputs, errors, attempts=3)
     if inputs is None:
-        return None
+        rec.fail("device_put_inputs", errors["device_put_inputs"])
+        return _worker_rc(rec)
     a, b, c = inputs
 
-    def stage(name, fn, *args, attempts=2):
-        if _time_left() <= 0:
-            errors[name] = "skipped: bench deadline reached"
-            return None
-        sec = _retry(name, lambda: bench_seconds_per_call(
-            fn, *args, min_device_time=2.0), errors, attempts=attempts)
-        return None if sec is None else flop / 1e9 / sec
+    def gf(fn, *args):
+        sec = bench_seconds_per_call(fn, *args, min_device_time=2.0)
+        return flop / 1e9 / sec
+
+    inj = InjectionSpec.reference_like(SIZE, SHAPES["huge"].bk)
+    if not rec.done("injected_faults_per_tile"):
+        rec.ok("injected_faults_per_tile",
+               inj.expected_faults(SIZE, SHAPES["huge"].bk))
 
     # Headline FIRST so later-stage failures can't cost the round's number.
-    inj = InjectionSpec.reference_like(SIZE, SHAPES["huge"].bk)
-    ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, strategy="weighted")
-    ft_gflops = stage("ft_weighted", lambda a, b, x: ft(a, b, x, inj).c,
-                      a, b, c, attempts=3)
-    if ft_gflops is None:
-        # The default cadence routes to the precomputed-expectation kernel;
-        # if that path fails on this backend, fall back to the in-kernel
-        # encode variant (any check_every < nk) so the round still gets a
-        # valid FT headline. Same strategy, same correction guarantees.
-        nk = SIZE // ft.shape_config.bk
-        ft_fb = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
-                              strategy="weighted",
-                              check_every=max(1, nk // 2))
-        ft_gflops = stage("ft_weighted_inkernel",
-                          lambda a, b, x: ft_fb(a, b, x, inj).c,
-                          a, b, c, attempts=2)
-        if ft_gflops is not None:
-            context["strategy"] = ("weighted (in-kernel encode fallback,"
-                                   " 2 checks)")
+    # Fallback ladder: weighted precomp -> weighted in-kernel encode (only
+    # meaningful when nk >= 2; ADVICE.md r2) -> rowcol. Any rung is a valid
+    # fused-ABFT headline; context records which one landed.
+    if not rec.done("ft_headline"):
+        nk = SIZE // SHAPES["huge"].bk
+        ladder = [("weighted (deferred single-check localization)",
+                   dict(strategy="weighted"))]
+        if nk >= 2:
+            ladder.append(("weighted (in-kernel encode fallback, 2 checks)",
+                           dict(strategy="weighted", check_every=nk // 2)))
+        ladder.append(("rowcol", dict(strategy="rowcol")))
+        for label, kwargs in ladder:
+            if left() < 30:
+                rec.fail("ft_headline", "skipped: worker deadline reached")
+                break
+            rung = f"ft_headline[{label}]"
 
-    xla = stage("xla_dot", lambda a, b, x: sgemm_reference(a, b, x, 1.0, -1.5),
-                a, b, c)
-    if xla is not None:
-        context["xla_dot_gflops"] = round(xla, 1)
+            def rung_fn(kwargs=kwargs):
+                # Factory inside the retry scope: a factory-time failure
+                # on one rung must fall through to the next, not abort
+                # the ladder.
+                ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, **kwargs)
+                return gf(lambda a, b, x: ft(a, b, x, inj).c, a, b, c)
 
-    plain_fn = make_sgemm("huge", alpha=1.0, beta=-1.5)
-    plain = stage("plain_huge", plain_fn, a, b, c)
-    if plain is not None:
-        context["kernel_sgemm_huge_gflops"] = round(plain, 1)
+            val = _retry(rung, rung_fn, errors, attempts=2)
+            if val is not None:
+                rec.ok("ft_headline", {"gflops": val, "strategy": label})
+                break
+            # Land the rung's error on disk even when a later rung
+            # rescues the headline, so the artifact says WHAT died.
+            rec.fail(rung, errors.get(rung, "unknown"))
+        else:
+            rec.fail("ft_headline", json.dumps(errors))
 
-    ft_rc = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, strategy="rowcol")
-    rowcol = stage("ft_rowcol", lambda a, b, x: ft_rc(a, b, x, inj).c, a, b, c)
-    if rowcol is not None:
-        context["abft_rowcol_gflops"] = round(rowcol, 1)
+    if not rec.done("ft_headline"):
+        # No number, no point burning budget on context stages: return so
+        # the supervisor can relaunch a fresh worker whose FIRST job is
+        # the headline ladder again.
+        return _worker_rc(rec)
 
-    if ft_gflops is not None:
-        if xla is not None:
-            context["ft_vs_xla"] = round(ft_gflops / xla, 3)
-        if plain is not None:
-            context["abft_overhead"] = round(1.0 - ft_gflops / plain, 3)
+    record_retry("xla_dot",
+                 lambda: gf(lambda a, b, x: sgemm_reference(a, b, x, 1.0,
+                                                            -1.5), a, b, c),
+                 attempts=2)
+    # Factories stay inside the retry scopes: a deterministic factory
+    # failure must cost one stage, not crash the worker.
+    record_retry("plain_huge",
+                 lambda: gf(make_sgemm("huge", alpha=1.0, beta=-1.5),
+                            a, b, c), attempts=2)
+
+    def rowcol_fn():
+        ft_rc = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                              strategy="rowcol")
+        return gf(lambda a, b, x: ft_rc(a, b, x, inj).c, a, b, c)
+
+    record_retry("ft_rowcol", rowcol_fn, attempts=2)
 
     # TPU-native bf16 input mode (f32 accumulation + checksums): the MXU's
     # full-rate path — context only; the headline stays f32 for reference
     # parity (the reference is SGEMM).
-    def bf16_stages():
+    def bf16_inputs():
         a16 = jax.device_put(jnp.asarray(a, jnp.bfloat16))
         b16 = jax.device_put(jnp.asarray(b, jnp.bfloat16))
-        ft16 = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
-                             strategy="weighted", in_dtype="bfloat16")
-        # The bf16 override tile has a different bk: rebuild the
-        # reference-like schedule so fault density matches the f32 row.
-        inj16 = InjectionSpec.reference_like(SIZE, ft16.shape_config.bk)
-        sec_ft = bench_seconds_per_call(
-            lambda a, b, x: ft16(a, b, x, inj16).c, a16, b16, c,
-            min_device_time=2.0)
-        plain16 = make_sgemm("huge", alpha=1.0, beta=-1.5,
-                             in_dtype="bfloat16")
-        sec_plain = bench_seconds_per_call(plain16, a16, b16, c,
-                                           min_device_time=2.0)
-        xla16 = lambda a, b, x: sgemm_reference(  # noqa: E731
-            a, b, x, 1.0, -1.5, in_dtype="bfloat16")
-        sec_xla = bench_seconds_per_call(xla16, a16, b16, c,
-                                         min_device_time=2.0)
-        return flop / 1e9 / sec_ft, flop / 1e9 / sec_plain, flop / 1e9 / sec_xla
+        return a16, b16
 
-    if _time_left() <= 0:
-        errors["bf16"] = "skipped: bench deadline reached"
-        bf16 = None
-    else:
-        bf16 = _retry("bf16", bf16_stages, errors, attempts=2)
-    if bf16 is not None:
-        context["bf16_abft_huge_gflops"] = round(bf16[0], 1)
-        context["bf16_sgemm_huge_gflops"] = round(bf16[1], 1)
-        context["bf16_xla_dot_gflops"] = round(bf16[2], 1)
-        context["bf16_ft_vs_xla"] = round(bf16[0] / bf16[2], 3)
-        context["bf16_plain_vs_xla"] = round(bf16[1] / bf16[2], 3)
+    bf16_names = ("bf16_abft", "bf16_plain", "bf16_xla")
+    if not all(rec.done(n) for n in bf16_names):
+        if left() <= 60:
+            for n in bf16_names:
+                if not rec.done(n):
+                    rec.fail(n, "skipped: worker deadline reached")
+            pair = None
+        else:
+            pair = _retry("bf16_inputs", bf16_inputs, errors, attempts=2)
+            if pair is None:
+                for n in bf16_names:
+                    if not rec.done(n):
+                        rec.fail(n, "bf16_inputs: "
+                                 + errors.get("bf16_inputs", "unknown"))
+        if pair is not None:
+            a16, b16 = pair
 
-    context["injected_faults_per_tile"] = inj.expected_faults(
-        SIZE, SHAPES["huge"].bk)
-    return ft_gflops
+            def bf16_abft_fn():
+                ft16 = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                                     strategy="weighted",
+                                     in_dtype="bfloat16")
+                # The bf16 override tile has a different bk: rebuild the
+                # reference-like schedule so fault density matches the
+                # f32 row.
+                inj16 = InjectionSpec.reference_like(
+                    SIZE, ft16.shape_config.bk)
+                return gf(lambda a, b, x: ft16(a, b, x, inj16).c,
+                          a16, b16, c)
+
+            record_retry("bf16_abft", bf16_abft_fn, attempts=2)
+            record_retry(
+                "bf16_plain",
+                lambda: gf(make_sgemm("huge", alpha=1.0, beta=-1.5,
+                                      in_dtype="bfloat16"), a16, b16, c),
+                attempts=2)
+            record_retry(
+                "bf16_xla",
+                lambda: gf(lambda a, b, x: sgemm_reference(
+                    a, b, x, 1.0, -1.5, in_dtype="bfloat16"), a16, b16, c),
+                attempts=2)
+
+    return _worker_rc(rec)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        sys.exit(worker_main(sys.argv[2]))
     sys.exit(main())
