@@ -47,7 +47,11 @@ from ft_sgemm_tpu.ops.attention import (
     make_ft_attention,
     make_ft_attention_diff,
 )
-from ft_sgemm_tpu.ops.autodiff import ft_matmul, make_ft_matmul
+from ft_sgemm_tpu.ops.autodiff import (
+    FtMatmulResult,
+    ft_matmul,
+    make_ft_matmul,
+)
 
 __version__ = "0.1.0"
 
@@ -62,6 +66,7 @@ __all__ = [
     "sgemm",
     "make_ft_sgemm",
     "ft_sgemm",
+    "FtMatmulResult",
     "FtSgemmResult",
     "STRATEGIES",
     "abft_baseline_sgemm",

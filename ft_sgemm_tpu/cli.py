@@ -67,6 +67,18 @@ ALPHA = 1.0   # sgemm.cu:22
 BETA = -1.5   # sgemm.cu:24,234
 
 
+def _build_ft(kernel_id: int, size: int, in_dtype: str, strategy: str):
+    """The fused-ABFT kernel + reference-like injection for one kernel id —
+    the ONE place the verification and perf paths get their FT recipe
+    (kernel from the shape NAME so per-dtype tile overrides apply;
+    injection cadence following the tile the kernel actually runs)."""
+    _, shape, _ = kernel_for_id(kernel_id)
+    ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA, in_dtype=in_dtype,
+                       strategy=strategy)
+    inj = InjectionSpec.reference_like(size, ft.shape_config.bk)
+    return ft, inj
+
+
 def _build_callable(kernel_id: int, size: int, inject_ft: bool,
                     in_dtype: str = "float32", strategy: str = "rowcol"):
     """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
@@ -82,11 +94,9 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     if not is_abft:
         return make_sgemm(shape.name, alpha=ALPHA, beta=BETA,
                           in_dtype=in_dtype)
-    ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA, in_dtype=in_dtype,
-                       strategy=strategy)
-    # Injection cadence follows the tile the kernel actually runs.
-    inj = (InjectionSpec.reference_like(size, ft.shape_config.bk)
-           if inject_ft else InjectionSpec.none())
+    ft, inj = _build_ft(kernel_id, size, in_dtype, strategy)
+    if not inject_ft:
+        inj = InjectionSpec.none()
     return lambda a, b, c: ft(a, b, c, inj).c
 
 
@@ -109,8 +119,10 @@ def print_device_info(out=sys.stdout) -> None:
 
 @functools.lru_cache(maxsize=2)
 def _host_inputs(size: int):
-    """Host-side A/B/C for one sweep size (regenerating ~O(n^2) RNG draws
-    for each of the 14 kernel rows would dominate large sweeps)."""
+    """Host-side A/B/C for one sweep size. The perf sweep iterates
+    SIZE-major (all kernel rows per size), so this generates each size's
+    ~O(n^2) RNG draws exactly once per sweep — maxsize=2 only needs to
+    hold the current size (plus one for interleaved callers)."""
     rng = np.random.default_rng(10)
     return (
         generate_random_matrix(size, size, rng=rng),
@@ -175,6 +187,23 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
             ok, status = _verify_global_strategy(
                 kernel_id, end_size, a, b, c, want, in_dtype)
             all_ok &= ok
+        elif is_abft and kernel_id != 10:
+            # Correcting FT rows: diff gate PLUS the residual-after-correct
+            # re-check — an interval the kernel itself could not verify
+            # fails the row even if the diff happens to pass.
+            ft, inj = _build_ft(kernel_id, end_size, in_dtype, strategy)
+            res = ft(a, b, c, inj)
+            ok, nbad, first = verify_matrix(want, np.asarray(res.c),
+                                            verbose=False)
+            unc = int(res.num_uncorrectable)
+            parts = []
+            if not ok:
+                parts.append(f"{nbad} bad, first at {first}")
+            if unc:
+                parts.append(f"{unc} uncorrectable intervals reported")
+            ok = ok and unc == 0
+            status = "pass" if ok else "FAIL (" + "; ".join(parts) + ")"
+            all_ok &= ok
         else:
             fn = _build_callable(kernel_id, end_size, inject_ft=True,
                                  in_dtype=in_dtype, strategy=strategy)
@@ -192,34 +221,43 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
                    min_device_time: float = 1.0, out=sys.stdout,
                    in_dtype: str = "float32",
                    strategy: str = "rowcol") -> dict:
-    """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439)."""
-    sizes = list(range(start_size, end_size + 1, gap_size))
-    print("################## Performance (GFLOPS) ########################",
-          file=out)
-    print("Matrix Size         |" + "".join(f"{s:8d}|" for s in sizes),
-          file=out)
+    """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439).
 
-    results = {}
-    for kernel_id in PERF_ROW_IDS:
-        if kernel_id < st_kernel:
-            continue
-        if kernel_id > end_kernel:
-            break
-        name, _, _ = kernel_for_id(kernel_id)
-        row = []
-        print(f"{name:<20s}|", end="", file=out, flush=True)
-        for size in sizes:
-            ah, bh, ch = _host_inputs(size)
-            a, b, c = map(jax.device_put, (ah, bh, ch))
+    The sweep runs SIZE-major — all kernel rows measured per size — so
+    each size's host inputs are generated and device_put ONCE for the
+    whole sweep (the reference regenerates nothing because its buffers
+    live on device for the whole run, ``sgemm.cu:69-96``; a row-major
+    sweep here would regenerate ~O(n^2) host RNG draws per row). The
+    table still prints row-major for output parity; per-size progress
+    goes to stderr.
+    """
+    sizes = list(range(start_size, end_size + 1, gap_size))
+    row_ids = [kid for kid in PERF_ROW_IDS if st_kernel <= kid <= end_kernel]
+
+    cells = {}
+    for size in sizes:
+        print(f"ft_sgemm: measuring size {size} "
+              f"({len(row_ids)} kernel rows)...", file=sys.stderr, flush=True)
+        ah, bh, ch = _host_inputs(size)
+        a, b, c = map(jax.device_put, (ah, bh, ch))
+        for kernel_id in row_ids:
             fn = _build_callable(kernel_id, size, inject_ft=True,
                                  in_dtype=in_dtype, strategy=strategy)
             sec_per_rep = bench_seconds_per_call(
                 fn, a, b, c, min_device_time=min_device_time)
-            gf = 2.0 * size**3 / 1e9 / sec_per_rep
-            row.append(gf)
-            print(f"{gf:8.0f}|", end="", file=out, flush=True)
-        print(file=out)
-        results[name] = dict(zip(sizes, row))
+            cells[(kernel_id, size)] = 2.0 * size**3 / 1e9 / sec_per_rep
+
+    print("################## Performance (GFLOPS) ########################",
+          file=out)
+    print("Matrix Size         |" + "".join(f"{s:8d}|" for s in sizes),
+          file=out)
+    results = {}
+    for kernel_id in row_ids:
+        name, _, _ = kernel_for_id(kernel_id)
+        print(f"{name:<20s}|"
+              + "".join(f"{cells[(kernel_id, s)]:8.0f}|" for s in sizes),
+              file=out, flush=True)
+        results[name] = {s: cells[(kernel_id, s)] for s in sizes}
     return results
 
 

@@ -41,11 +41,20 @@ class InjectionSpec:
 
     ``enabled=False`` compiles to a no-op branch — the clean path the
     reference lacks.
+
+    ``col_stride`` sets how far the target COLUMN advances per scheduled
+    fault. The default 61 is coprime to every legal tile width, so
+    consecutive faults land in distinct columns (the property the
+    column-localized correcting strategies rely on). ``col_stride=0`` pins
+    every fault to one column — the adversarial schedule that defeats
+    per-column localization and exercises the kernels'
+    residual-after-correct re-check (``FtSgemmResult.uncorrectable``).
     """
 
     enabled: bool = False
     every: int = 1  # inject at every k-step where k % every == 0
     magnitude: float = REFERENCE_MAGNITUDE
+    col_stride: int = 61  # column advance per fault; 0 = same column always
 
     def __post_init__(self):
         if self.every < 1:
@@ -54,6 +63,9 @@ class InjectionSpec:
             raise ValueError(
                 f"InjectionSpec.magnitude={self.magnitude} not finite in f32"
             )
+        if self.col_stride < 0:
+            raise ValueError(
+                f"InjectionSpec.col_stride={self.col_stride} must be >= 0")
 
     @staticmethod
     def none() -> "InjectionSpec":
@@ -73,10 +85,11 @@ class InjectionSpec:
         return InjectionSpec(enabled=True, every=every, magnitude=magnitude)
 
     def as_operand(self) -> np.ndarray:
-        """Pack into the (3,) f32 scalar operand consumed by the kernels:
-        [enabled, every, magnitude]."""
+        """Pack into the (4,) f32 scalar operand consumed by the kernels:
+        [enabled, every, magnitude, col_stride]."""
         return np.asarray(
-            [1.0 if self.enabled else 0.0, float(self.every), float(self.magnitude)],
+            [1.0 if self.enabled else 0.0, float(self.every),
+             float(self.magnitude), float(self.col_stride)],
             dtype=np.float32,
         )
 

@@ -61,11 +61,16 @@ class FtAttentionResult(NamedTuple):
     ``detections`` counts corrected accumulator faults across both GEMMs;
     ``softmax_flags`` counts rows whose softmax normalization invariant
     (rowsum == 1) broke — detect-only, 0 on clean runs.
+    ``uncorrectable`` aggregates the GEMMs' residual-after-correct
+    re-checks (``FtSgemmResult.uncorrectable``): nonzero means a
+    correction assumption broke inside a protected GEMM and the output may
+    still carry the fault — reported, never silent.
     """
 
     out: jax.Array            # (L, dv)
     detections: jax.Array     # scalar int32 — corrected GEMM faults
     softmax_flags: jax.Array  # scalar int32 — flagged softmax rows
+    uncorrectable: jax.Array  # scalar int32 — unverified GEMM intervals
 
     @property
     def num_detected(self):
@@ -95,6 +100,33 @@ def causal_mask_bias(lq: int, lk: int) -> jax.Array:
     qpos = jnp.arange(lq)[:, None] + (lk - lq)
     kpos = jnp.arange(lk)[None, :]
     return jnp.where(kpos <= qpos, 0.0, -jnp.inf).astype(jnp.float32)
+
+
+def _ft_attention_forward(qk, pv, q, k, v, inject, scale, causal,
+                          softmax_threshold):
+    """The ONE protected-attention forward, shared by the plain and
+    differentiable factories: QK kernel -> scale -> (causal mask) ->
+    softmax + rowsum invariant -> PV kernel. Returns
+    ``(FtAttentionResult, p, sc)`` — callers that don't need the counts or
+    the probabilities just drop them (XLA prunes unused outputs)."""
+    if causal:
+        # Validate BEFORE launching any kernel work.
+        _check_causal_lengths(q.shape[0], k.shape[0])
+    sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
+    s = qk(q, k, zs, inject)
+    logits = sc * s.c
+    if causal:
+        logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
+    p = jax.nn.softmax(logits, axis=-1)
+    flags = jnp.sum(
+        (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
+        .astype(jnp.int32))
+    zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
+    o = pv(p, jnp.swapaxes(v, 0, 1), zo, inject)
+    det = (jnp.sum(s.detections) + jnp.sum(o.detections)).astype(jnp.int32)
+    unc = jnp.sum(s.uncorrectable) + jnp.sum(o.uncorrectable)
+    return FtAttentionResult(o.c, det, flags, unc), p, sc
 
 
 def make_ft_attention(
@@ -129,23 +161,9 @@ def make_ft_attention(
                        interpret=interpret)
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
-        if causal:
-            # Validate BEFORE launching any kernel work.
-            _check_causal_lengths(q.shape[0], k.shape[0])
-        sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-        zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
-        s = qk(q, k, zs, inject)
-        logits = sc * s.c
-        if causal:
-            logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
-        p = jax.nn.softmax(logits, axis=-1)
-        flags = jnp.sum(
-            (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
-            .astype(jnp.int32))
-        zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
-        o = pv(p, jnp.swapaxes(v, 0, 1), zo, inject)
-        det = jnp.sum(s.detections) + jnp.sum(o.detections)
-        return FtAttentionResult(o.c, det, flags)
+        res, _, _ = _ft_attention_forward(
+            qk, pv, q, k, v, inject, scale, causal, softmax_threshold)
+        return res
 
     fn.strategy = strategy
     fn.in_dtype = in_dtype
@@ -171,6 +189,8 @@ def make_ft_attention_diff(
     pv_shape: KernelShape = PV_SHAPE,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    with_counts: bool = False,
+    softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
 ):
     """Differentiable FT attention: ABFT on all six GEMMs of fwd + bwd.
 
@@ -182,22 +202,30 @@ def make_ft_attention_diff(
         dS = P ⊙ (dP − rowsum(dP ⊙ P)) · scale     (softmax bwd, VPU)
         dQ = dS K      dK = dSᵀ Q
 
-    The elementwise softmax forward/backward stages are the only
-    unprotected compute — and unlike :func:`make_ft_attention`, this path
-    computes NO softmax rowsum invariant either (a custom_vjp primal is
-    just the output array, so there is no channel for flags): softmax-stage
-    SDC is undetected here. Where softmax detection or fault counts
-    matter, use :func:`make_ft_attention`. ``bwd_threshold`` tightens the
-    gradient GEMMs' detection threshold — cotangents usually live far
-    below activation scale (see ops/autodiff.py). ``inject`` is static at
-    build time and drives all six GEMMs.
+    ``with_counts=True`` makes the function return the full
+    :class:`FtAttentionResult` pytree instead of the bare output array:
+    gradients flow through ``.out`` while the int32 ``detections`` (both
+    forward GEMMs) and ``softmax_flags`` (normalization-stage rowsum
+    invariant, same as :func:`make_ft_attention`) leaves take zero
+    cotangents — so a training loop can log fault activity every step.
+    The four backward GEMMs are still ABFT-corrected in-kernel (this
+    factory requires a correcting strategy for exactly that reason — a
+    custom_vjp backward has no primal output to carry their counts, so
+    detect-only would be silent there); the elementwise softmax
+    forward/backward stages remain the only unprotected compute.
+    ``bwd_threshold`` tightens the gradient GEMMs' detection threshold —
+    cotangents usually live far below activation scale (see
+    ops/autodiff.py). ``inject`` is static at build time and drives all
+    six GEMMs.
     """
     if strategy == "global":
         raise ValueError(
             "make_ft_attention_diff requires a CORRECTING strategy: "
-            "'global' only detects, and the differentiable API discards "
-            "detection counts — faults would pass silently. Pick 'rowcol' "
-            "or 'weighted', or use make_ft_attention for detect-only runs.")
+            "'global' only detects, and the backward GEMMs' detection "
+            "counts have no output channel under custom_vjp (with_counts "
+            "covers the forward GEMMs only) — backward faults would pass "
+            "silently. Pick 'rowcol' or 'weighted', or use "
+            "make_ft_attention for detect-only runs.")
     inj = inject or InjectionSpec.none()
     bthr = threshold if bwd_threshold is None else bwd_threshold
     mk = lambda shp, thr: make_ft_sgemm(  # noqa: E731
@@ -212,17 +240,9 @@ def make_ft_attention_diff(
     b_short = qk if bthr == threshold else mk(qk_shape, bthr)
 
     def _fwd_parts(q, k, v):
-        if causal:
-            _check_causal_lengths(q.shape[0], k.shape[0])
-        sc = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-        zs = jnp.zeros((q.shape[0], k.shape[0]), jnp.float32)
-        logits = sc * qk(q, k, zs, inj).c
-        if causal:
-            logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
-        p = jax.nn.softmax(logits, axis=-1)
-        zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
-        o = pv(p, jnp.swapaxes(v, 0, 1), zo, inj).c
-        return o, p, sc
+        res, p, sc = _ft_attention_forward(
+            qk, pv, q, k, v, inj, scale, causal, softmax_threshold)
+        return (res if with_counts else res.out), p, sc
 
     @jax.custom_vjp
     def att(q, k, v):
@@ -234,6 +254,11 @@ def make_ft_attention_diff(
 
     def bwd_fn(res, g):
         q, k, v, p, sc = res
+        if with_counts:
+            # Cotangent mirrors the FtAttentionResult pytree; the integer
+            # counts leaves carry zero (float0) cotangents. Index
+            # positionally: the container may arrive as a plain tuple.
+            g = g[0]
         lq, lk = p.shape
         dv_z = jnp.zeros((lk, v.shape[1]), jnp.float32)
         dp_z = jnp.zeros((lq, lk), jnp.float32)

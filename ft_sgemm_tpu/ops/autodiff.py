@@ -15,9 +15,18 @@ the framework's native GEMM orientation (a linear layer with stored weight
   dA = g @ B      -> kernel(a=g (M, N), b=Bᵀ (K, N))
   dB = gᵀ @ A     -> kernel(a=gᵀ (N, M), b=Aᵀ (K, M))
 
-Detection counts are not part of the differentiable value (a custom_vjp
-primal must be the array the cotangent flows against); use
-:func:`ft_sgemm_tpu.ft_sgemm` directly where counts must be observable.
+Detection counts ARE observable in training loops: build with
+``with_counts=True`` and the function returns the
+:class:`FtMatmulResult` pytree ``(out, detections, uncorrectable)`` —
+``jax.custom_vjp`` supports pytree primals, and the int32 counting leaves
+take zero (float0) cotangents, so ``jax.grad(..., has_aux=True)`` style
+losses can log corrected-fault counts (and the residual-after-correct
+re-check's uncorrectable-interval count) every step while gradients flow
+through ``out`` untouched. *Knowing* SDC happened is half the value of
+ABFT in a training run. The counts cover the forward GEMM; the two
+backward GEMMs are still ABFT-corrected in-kernel (the factories require
+a correcting strategy for exactly this reason) but a custom_vjp backward
+has no primal output to carry their counts through.
 
 **Threshold scale caveat.** ABFT detection compares checksum residuals
 against an ABSOLUTE threshold. Gradients are usually orders of magnitude
@@ -32,13 +41,28 @@ gradient GEMMs' detection as tight as the forward one's.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+
+class FtMatmulResult(NamedTuple):
+    """``with_counts=True`` output of the differentiable FT matmul.
+
+    A ``jax.custom_vjp`` primal pytree: gradients flow through ``out``;
+    the int32 leaves take zero cotangents. ``uncorrectable`` is the
+    forward GEMM's residual-after-correct re-check
+    (``FtSgemmResult.uncorrectable``) — nonzero means REPORTED possible
+    corruption, never silent.
+    """
+
+    out: jax.Array            # (M, N)
+    detections: jax.Array     # scalar int32 — corrected fwd-GEMM faults
+    uncorrectable: jax.Array  # scalar int32 — unverified fwd intervals
 
 
 @functools.lru_cache(maxsize=64)
@@ -58,6 +82,7 @@ def make_ft_matmul(
     inject: Optional[InjectionSpec] = None,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    with_counts: bool = False,
 ):
     """Build a differentiable ``fn(a, b) = a @ b.T`` with FT fwd + bwd.
 
@@ -68,13 +93,23 @@ def make_ft_matmul(
     so a tighter backward threshold catches SDC the forward-calibrated one
     would miss (module docstring). The returned function is a
     ``jax.custom_vjp``: compose freely with ``jit``/``grad``/``vmap``.
+
+    ``with_counts=True`` changes the return value to the
+    :class:`FtMatmulResult` pytree (zero cotangents on the counting
+    leaves; see module docstring). The detect-only ``'global'`` strategy
+    stays rejected even then: the BACKWARD GEMMs' counts have no primal
+    channel, so a detect-only backward fault would be neither corrected
+    nor observable — the silent configuration this guard exists to
+    prevent.
     """
     if strategy == "global":
         raise ValueError(
             "make_ft_matmul requires a CORRECTING strategy: 'global' only "
-            "detects, and the differentiable API discards detection counts "
-            "— faults would pass silently. Pick 'rowcol' or 'weighted', or "
-            "use ft_sgemm directly for detect-only runs.")
+            "detects, and the backward GEMMs' detection counts have no "
+            "output channel under custom_vjp (with_counts covers the "
+            "forward GEMM only) — backward faults would pass silently. "
+            "Pick 'rowcol' or 'weighted', or use ft_sgemm directly for "
+            "detect-only runs.")
     inj = inject or InjectionSpec.none()
     kern = _kernels(shape, strategy, threshold, in_dtype, interpret)
     bwd_kern = _kernels(
@@ -85,19 +120,27 @@ def make_ft_matmul(
     @jax.custom_vjp
     def ft_mm(a, b):
         z = jnp.zeros((a.shape[0], b.shape[0]), jnp.float32)
-        return kern(a, b, z, inj).c
+        r = kern(a, b, z, inj)
+        if with_counts:
+            return FtMatmulResult(
+                r.c, jnp.sum(r.detections).astype(jnp.int32),
+                jnp.sum(r.uncorrectable).astype(jnp.int32))
+        return r.c
 
     def fwd(a, b):
         return ft_mm(a, b), (a, b)
 
     def bwd(res, g):
         a, b = res
-        zk_a = jnp.zeros((g.shape[0], a.shape[1]), jnp.float32)
-        zk_b = jnp.zeros((g.shape[1], a.shape[1]), jnp.float32)
+        # Under with_counts the cotangent mirrors the (out, counts) pytree;
+        # the int32 counts leaf carries a zero (float0) cotangent.
+        gc = g[0] if with_counts else g
+        zk_a = jnp.zeros((gc.shape[0], a.shape[1]), jnp.float32)
+        zk_b = jnp.zeros((gc.shape[1], a.shape[1]), jnp.float32)
         # dA = g @ B: kernel contracts over the second axis of both args.
-        da = bwd_kern(g, jnp.swapaxes(b, 0, 1), zk_a, inj).c
+        da = bwd_kern(gc, jnp.swapaxes(b, 0, 1), zk_a, inj).c
         # dB = g^T @ A.
-        db = bwd_kern(jnp.swapaxes(g, 0, 1), jnp.swapaxes(a, 0, 1),
+        db = bwd_kern(jnp.swapaxes(gc, 0, 1), jnp.swapaxes(a, 0, 1),
                       zk_b, inj).c
         return da.astype(a.dtype), db.astype(b.dtype)
 
@@ -110,4 +153,4 @@ def ft_matmul(a, b, **kwargs):
     return make_ft_matmul(**kwargs)(a, b)
 
 
-__all__ = ["ft_matmul", "make_ft_matmul"]
+__all__ = ["FtMatmulResult", "ft_matmul", "make_ft_matmul"]

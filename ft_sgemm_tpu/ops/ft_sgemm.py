@@ -58,6 +58,7 @@ Three checksum strategies mirror the reference's three preserved designs:
 from __future__ import annotations
 
 import functools
+import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -92,14 +93,41 @@ class FtSgemmResult(NamedTuple):
         previous check). The strategy never corrects, so this equals the
         injected fault count when at most one fault lands per interval;
         multiple same-interval faults collapse into one event.
+
+    ``uncorrectable`` is the residual-after-correct re-check: the
+    correcting strategies recompute their checksum residuals AFTER
+    applying corrections and count residuals still above threshold — the
+    case where correction assumptions were violated (e.g. multiple faults
+    in one column of one check interval defeat per-column localization:
+    the column's total deficit lands on one rounded row). ``weighted``
+    re-checks three column moments (plain, w, w^2): a single point-mass
+    correction can match the first two moments of a multi-fault column
+    (equal faults at rows in arithmetic progression do), but never all
+    three when the faults share a sign; sign-mixed fault sets that match
+    all three moments exactly remain theoretically silent (measure-zero
+    for real SDC). ``rowcol`` additionally re-checks per-row residuals,
+    which flag any same-column multi-fault miscorrection directly.
+    The value is the post-FINAL-check state — the number of checksum
+    residuals still above threshold after every correction ran (residuals
+    are cumulative over K, so a broken interval anywhere in the run stays
+    visible at the last check; a per-check accumulation would re-count it
+    once per check and scale with cadence instead of damage). Nonzero
+    means the output may still be corrupted and the caller must re-run —
+    corruption is REPORTED, not silent. For the detect-only ``global``
+    strategy every detection is uncorrected, so it equals ``detections``.
     """
 
     c: jax.Array           # (M, N) corrected output
     detections: jax.Array  # (grid_m, grid_n) int32 — see class docstring
+    uncorrectable: jax.Array  # (grid_m, grid_n) int32 — see class docstring
 
     @property
     def num_detected(self):
         return jnp.sum(self.detections)
+
+    @property
+    def num_uncorrectable(self):
+        return jnp.sum(self.uncorrectable)
 
 
 def _inject(out_ref, inj_ref, k, i, j, bm, bn):
@@ -108,21 +136,24 @@ def _inject(out_ref, inj_ref, k, i, j, bm, bn):
     Models SDC in the f32 accumulator (reference rotates the target thread:
     ``if(tx == (k+8)/(K/20)) res[0] += error_inject``,
     ``include_code_gen/ft_sgemm_huge.cuh:324-327``). The target rotates with
-    the injection ordinal and the output-tile coordinates; the column
-    stride (61) is coprime to every legal bn, so consecutive faults land in
-    distinct columns for up to bn injections — the property multi-fault
-    correction relies on (see make_ft_sgemm).
+    the injection ordinal and the output-tile coordinates; the default
+    column stride (61) is coprime to every legal bn, so consecutive faults
+    land in distinct columns for up to bn injections — the property
+    multi-fault correction relies on (see make_ft_sgemm). A runtime
+    ``col_stride`` of 0 pins the column: the adversarial schedule for the
+    uncorrectable-interval re-check.
     """
     enabled = inj_ref[0] > 0.0
     every = jnp.maximum(inj_ref[1].astype(jnp.int32), 1)
     magnitude = inj_ref[2]
+    col_stride = inj_ref[3].astype(jnp.int32)
     do = enabled & (k % every == 0)
 
     @pl.when(do)
     def _():
         ordinal = k // every + 3 * i + 5 * j
         m0 = (ordinal * 131 + 7) % bm
-        n0 = (ordinal * 61 + 3) % bn
+        n0 = (ordinal * col_stride + 3) % bn
         # Read-modify-write one aligned (8, 128) subtile instead of masking
         # the whole (bm, bn) accumulator: a full-tile iota mask costs ~14%
         # of the kernel at bm=bn=512; this costs <1%. (Mosaic cannot load a
@@ -157,14 +188,14 @@ def _weighted_localize(res_c, res_cw, det_c, bm, bn):
 
 
 def _ft_kernel_rowcol(
-    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
     r_exp_ref, c_exp_ref, *rest,
     alpha, beta, nk, prec, threshold, check_every, bm, bn, multifault,
 ):
     if multifault:
-        cw_exp_ref, count_ref = rest
+        cw_exp_ref, count_ref, unc_count_ref = rest
     else:
-        (count_ref,) = rest
+        count_ref, unc_count_ref = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -177,6 +208,7 @@ def _ft_kernel_rowcol(
         if multifault:
             cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
         count_ref[0] = 0
+        unc_count_ref[0] = 0
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
@@ -250,17 +282,41 @@ def _ft_kernel_rowcol(
             hit = jnp.where(ambiguous, hit_w, hit)
             corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
                              corr)
-        out_ref[:] += jnp.where(hit, corr, 0.0)
+        delta = jnp.where(hit, corr, 0.0)
+        out_ref[:] += delta
         count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+        # Residual-after-correct re-check: residuals are linear in the
+        # accumulator, so the post-correction residuals are the pre-
+        # correction ones minus delta's row/col sums — no accumulator
+        # re-read. Anything still above threshold means a correction
+        # assumption broke (e.g. two same-column faults in the ambiguous
+        # >1-row/>1-col case): REPORT instead of staying silent.
+        res_r2 = res_r - jnp.sum(delta, axis=1, keepdims=True)
+        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
+        bad_c = jnp.abs(res_c2) > threshold
+        bad = (jnp.sum((jnp.abs(res_r2) > threshold).astype(jnp.int32))
+               + jnp.sum(bad_c.astype(jnp.int32)))
+        if multifault:
+            # The weighted residual exposes corrections that balanced the
+            # plain column sum on the WRONG row.
+            res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
+            bad += jnp.sum(((jnp.abs(res_cw2) > threshold) & ~bad_c)
+                           .astype(jnp.int32))
+        # LEVEL, not accumulation: residuals are cumulative over K, so a
+        # stale broken interval stays visible at every later check —
+        # accumulating would re-count it once per check and inflate with
+        # cadence. The value reported is the state after the FINAL check.
+        unc_count_ref[0] = bad
 
     @pl.when(k == nk - 1)
     def _epilogue():
         out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
+        unc_ref[i, j] = unc_count_ref[0]
 
 
 def _ft_kernel_global(
-    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
     t_exp_ref, prev_ref, count_ref,
     *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
 ):
@@ -309,11 +365,14 @@ def _ft_kernel_global(
     def _epilogue():
         out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
+        # Detect-only strategy: every detection is by definition
+        # uncorrected (FtSgemmResult docstring).
+        unc_ref[i, j] = count_ref[0]
 
 
 def _ft_kernel_weighted(
-    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref,
-    c_exp_ref, cw_exp_ref, count_ref,
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
+    c_exp_ref, cw_exp_ref, cw2_exp_ref, count_ref, unc_count_ref,
     *, alpha, beta, nk, prec, threshold, check_every, bm, bn,
 ):
     """Weighted-checksum variant with fault *localization*.
@@ -336,7 +395,9 @@ def _ft_kernel_weighted(
         out_ref[:] = jnp.zeros_like(out_ref)
         c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
         cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
+        cw2_exp_ref[:] = jnp.zeros_like(cw2_exp_ref)
         count_ref[0] = 0
+        unc_count_ref[0] = 0
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
@@ -352,8 +413,15 @@ def _ft_kernel_weighted(
     bf = b_blk.astype(jnp.float32)
     s_a = jnp.sum(af, axis=0, keepdims=True)                 # (1, bk)
     s_aw = jnp.sum(af * w_col, axis=0, keepdims=True)        # (1, bk)
+    # Second-moment (w^2) stream: consumed only by the after-correct
+    # re-check — a point-mass correction can match the 0th and 1st column
+    # moments of a multi-fault column (equal faults at rows in arithmetic
+    # progression do exactly that) but never all three for same-sign
+    # faults (strict convexity of w^2).
+    s_aw2 = jnp.sum(af * (w_col * w_col), axis=0, keepdims=True)  # (1, bk)
     c_exp_ref[:] += jnp.sum(bf * s_a, axis=1, keepdims=True)       # (bn, 1)
     cw_exp_ref[:] += jnp.sum(bf * s_aw, axis=1, keepdims=True)     # (bn, 1)
+    cw2_exp_ref[:] += jnp.sum(bf * s_aw2, axis=1, keepdims=True)   # (bn, 1)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -366,17 +434,35 @@ def _ft_kernel_weighted(
         res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
         det_c = jnp.abs(res_c) > threshold
         hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
-        out_ref[:] += jnp.where(hit, res_c, 0.0)
+        delta = jnp.where(hit, res_c, 0.0)
+        out_ref[:] += delta
         count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+        # Residual-after-correct re-check (see _ft_kernel_rowcol): multiple
+        # same-column faults defeat per-column localization. The 0th/1st
+        # moment residuals catch most miscorrections; the 2nd-moment (w^2)
+        # residual catches the rest for same-sign fault sets (a point mass
+        # cannot match three moments of >= 2 distinct rows — equal faults
+        # at rows in arithmetic progression zero the first two moments but
+        # never this one). All REPORT via the uncorrectable counter.
+        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
+        res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
+        csw2 = jnp.sum(acc * (w_col * w_col), axis=0, keepdims=True)
+        res_cm2 = (jnp.swapaxes(cw2_exp_ref[:], 0, 1) - csw2
+                   - jnp.sum(delta * (w_col * w_col), axis=0, keepdims=True))
+        # LEVEL, not accumulation (see _ft_kernel_rowcol's re-check).
+        unc_count_ref[0] = jnp.sum(
+            ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > threshold)
+             | (jnp.abs(res_cm2) > threshold)).astype(jnp.int32))
 
     @pl.when(k == nk - 1)
     def _epilogue():
         out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
+        unc_ref[i, j] = unc_count_ref[0]
 
 
 def _ft_kernel_weighted_precomp(
-    inj_ref, a_ref, b_ref, c_ref, exp_ref, out_ref, det_ref,
+    inj_ref, a_ref, b_ref, c_ref, exp_ref, out_ref, det_ref, unc_ref,
     count_ref,
     *, alpha, beta, nk, prec, threshold, bm, bn,
 ):
@@ -432,8 +518,21 @@ def _ft_kernel_weighted_precomp(
         res_cw = exp_ref[1:2, :] - csw                       # (1, bn)
         det_c = jnp.abs(res_c) > threshold
         hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
-        corrected = acc + jnp.where(hit, res_c, 0.0)
+        delta = jnp.where(hit, res_c, 0.0)
+        corrected = acc + delta
         count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+        # Residual-after-correct re-check across all three column moments
+        # (single final check — write the count straight to the output;
+        # rationale in _ft_kernel_weighted).
+        w2 = w_col * w_col
+        csw2 = jnp.sum(acc * w2, axis=0, keepdims=True)      # (1, bn)
+        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
+        res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
+        res_cm2 = (exp_ref[2:3, :] - csw2
+                   - jnp.sum(delta * w2, axis=0, keepdims=True))
+        unc_ref[i, j] = jnp.sum(
+            ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > threshold)
+             | (jnp.abs(res_cm2) > threshold)).astype(jnp.int32))
         out_ref[:] = alpha * corrected + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
 
@@ -444,29 +543,36 @@ def _expected_col_checksums(ap, bp, bm, prec):
     ``ap`` is the padded (M, K) input in the kernel's consumption dtype
     (checksums must see the same rounded values the MXU consumes). Returns
     one (8 * M/bm, N) f32 array: within each 8-row group i, row 0 holds
-    ``1^T A_i @ B^T`` and row 1 ``w^T A_i @ B^T`` (weights {1..bm}), rows
-    2-7 are zero — an (8, bn)-blockable layout (Mosaic requires sublane
-    dims divisible by 8).
+    ``1^T A_i @ B^T``, row 1 ``w^T A_i @ B^T`` (weights {1..bm}), and row
+    2 ``(w^2)^T A_i @ B^T`` (the re-check's second moment); rows 3-7 are
+    zero — an (8, bn)-blockable layout (Mosaic requires sublane dims
+    divisible by 8).
 
-    For bf16 inputs the checksum rows are carried as hi+lo bf16 pairs
-    (``x ~= bf16(x) + bf16(x - bf16(x))``) and the halves summed after the
-    dot: a single bf16 cast of ``w^T A_i`` (magnitudes up to ~1e4) leaves
-    ~0.3-1.4 of residual noise that the correction would deposit INTO the
-    corrected elements, failing the 0.01/0.01 verify tolerance; the split
-    brings expectation error down to the f32 accumulation-noise class at
-    unchanged MXU cost (4 sublanes instead of 2 in the same tile row).
+    For bf16 inputs the checksum rows are carried as hi+lo+lo2 bf16
+    triples (``x ~= bf16(x) + bf16(x - hi) + bf16(x - hi - lo)``) and the
+    parts summed after the dot: a single bf16 cast of ``w^T A_i``
+    (magnitudes up to ~1e4) leaves ~0.3-1.4 of residual noise that the
+    correction would deposit INTO the corrected elements, failing the
+    0.01/0.01 verify tolerance — and the w^2 row reaches ~bm^2-scale
+    magnitudes where even a 2-term split's noise could graze the 9500
+    detection threshold at K=6144. Three terms put every row's expectation
+    error in the f32 accumulation-noise class at negligible MXU cost
+    (9 sublanes instead of 3 in the same stacked dot).
     """
     m, kdim = ap.shape
     gm = m // bm
     af = ap.astype(jnp.float32).reshape(gm, bm, kdim)
     w = (jnp.arange(bm, dtype=jnp.float32) + 1.0)[None, :, None]
-    sa = jnp.sum(af, axis=1)            # (gm, K)
-    swa = jnp.sum(af * w, axis=1)       # (gm, K)
-    stacked_f32 = jnp.concatenate([sa, swa], axis=0)
+    sa = jnp.sum(af, axis=1)             # (gm, K)
+    swa = jnp.sum(af * w, axis=1)        # (gm, K)
+    sw2a = jnp.sum(af * (w * w), axis=1)  # (gm, K)
+    stacked_f32 = jnp.concatenate([sa, swa, sw2a], axis=0)
     if ap.dtype == jnp.bfloat16:
         hi = stacked_f32.astype(jnp.bfloat16)
-        lo = (stacked_f32 - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        stacked = jnp.concatenate([hi, lo], axis=0)   # (4*gm, K)
+        rem = stacked_f32 - hi.astype(jnp.float32)
+        lo = rem.astype(jnp.bfloat16)
+        lo2 = (rem - lo.astype(jnp.float32)).astype(jnp.bfloat16)
+        stacked = jnp.concatenate([hi, lo, lo2], axis=0)   # (9*gm, K)
     else:
         stacked = stacked_f32
     exp = jax.lax.dot_general(
@@ -474,12 +580,13 @@ def _expected_col_checksums(ap, bp, bm, prec):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
-    )                                    # (2*gm or 4*gm, N) f32
+    )                                    # (3*gm or 9*gm, N) f32
     if ap.dtype == jnp.bfloat16:
-        exp = exp[: 2 * gm] + exp[2 * gm:]
+        exp = exp[: 3 * gm] + exp[3 * gm: 6 * gm] + exp[6 * gm:]
     grouped = jnp.zeros((gm, 8, exp.shape[1]), jnp.float32)
     grouped = grouped.at[:, 0, :].set(exp[:gm])
-    grouped = grouped.at[:, 1, :].set(exp[gm:])
+    grouped = grouped.at[:, 1, :].set(exp[gm:2 * gm])
+    grouped = grouped.at[:, 2, :].set(exp[2 * gm:])
     return grouped.reshape(8 * gm, exp.shape[1])
 
 
@@ -487,18 +594,20 @@ def _scratch_for(strategy, bm, bn, multifault):
     # No accumulator scratch: the kernels accumulate in the resident f32
     # output block (see _matmul_kernel in ops/sgemm.py for the rationale).
     count = pltpu.SMEM((1,), jnp.int32)
+    unc = pltpu.SMEM((1,), jnp.int32)
     if strategy == "rowcol":
         vecs = [pltpu.VMEM((bm, 1), jnp.float32),
                 pltpu.VMEM((bn, 1), jnp.float32)]
         if multifault:
             vecs.append(pltpu.VMEM((bn, 1), jnp.float32))  # cw_exp
-        return [*vecs, count]
+        return [*vecs, count, unc]
     if strategy == "global":
         return [pltpu.SMEM((1,), jnp.float32),
                 pltpu.SMEM((1,), jnp.float32), count]
     if strategy == "weighted":
         return [pltpu.VMEM((bn, 1), jnp.float32),
-                pltpu.VMEM((bn, 1), jnp.float32), count]
+                pltpu.VMEM((bn, 1), jnp.float32),
+                pltpu.VMEM((bn, 1), jnp.float32), count, unc]
     raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
 
 
@@ -536,7 +645,7 @@ def _ft_sgemm_padded(
     precomp = strategy == "weighted" and check_every >= nk
 
     in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (3,)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (4,)
         pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
         pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
         pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
@@ -562,18 +671,20 @@ def _ft_sgemm_padded(
         )
         scratch = _scratch_for(strategy, bm, bn, multifault)
 
-    out, det = pl.pallas_call(
+    out, det, unc = pl.pallas_call(
         kernel,
         grid=(gm, gn, nk),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-            # Full-array SMEM block: each (i, j) program writes its own cell
+            # Full-array SMEM blocks: each (i, j) program writes its own cell
             # (grid-blocked SMEM outputs must match the array shape).
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), jnp.float32),
+            jax.ShapeDtypeStruct((gm, gn), jnp.int32),
             jax.ShapeDtypeStruct((gm, gn), jnp.int32),
         ],
         scratch_shapes=scratch,
@@ -583,7 +694,7 @@ def _ft_sgemm_padded(
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
     )(*operands)
-    return out, det
+    return out, det, unc
 
 
 def make_ft_sgemm(
@@ -660,12 +771,16 @@ def make_ft_sgemm(
             # don't overshoot (nk=32: every-other-step = 16 checks, vs 32
             # checks with floor — the reference does 20 regardless).
             ce = max(1, round(nk / 20))
-        if inject.enabled and strategy in ("rowcol", "weighted"):
+        if (inject.enabled and strategy in ("rowcol", "weighted")
+                and math.gcd(inject.col_stride, bn) == 1):
             # Column-localized correction needs the interval's faults in
-            # DISTINCT columns. The rotating target advances the column
-            # ordinal by 1 per scheduled injection (gcd(61, bn) = 1), so up
-            # to bn faults per interval stay distinct; only clamp for K
-            # deep enough to wrap the column cycle.
+            # DISTINCT columns. A column stride coprime to bn advances the
+            # column by a full cycle only after bn injections, so up to bn
+            # faults per interval stay distinct; only clamp for K deep
+            # enough to wrap the cycle. Non-coprime strides (e.g. the
+            # adversarial col_stride=0) can collide regardless of cadence —
+            # no clamp helps; the in-kernel residual-after-correct re-check
+            # reports those intervals via FtSgemmResult.uncorrectable.
             ce = min(ce, bn * max(1, inject.every))
         if strategy != "rowcol":
             mf = False  # only rowcol reads the flag; keep jit keys stable
@@ -675,13 +790,13 @@ def make_ft_sgemm(
             mf = not (inject.enabled and ce <= max(1, inject.every))
         else:
             mf = multifault
-        out, det = _ft_sgemm_padded(
+        out, det, unc = _ft_sgemm_padded(
             ap, bp, cp, jnp.asarray(inject.as_operand()),
             shape=eff, alpha=alpha, beta=beta, precision=precision,
             threshold=threshold, check_every=ce, strategy=strategy,
             multifault=mf, interpret=_should_interpret(interpret),
         )
-        return FtSgemmResult(out[:m, :n], det)
+        return FtSgemmResult(out[:m, :n], det, unc)
 
     fn.__name__ = f"ft_sgemm_{shape.name}_{strategy}" + _dtype_suffix(in_dtype)
     fn.shape_config = shape

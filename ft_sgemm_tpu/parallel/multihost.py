@@ -176,10 +176,10 @@ def multihost_ft_sgemm(
         step,
         mesh=mesh,
         in_specs=(rows, P(None, "y"), c_spec),
-        out_specs=(c_spec, P(None, None)),
+        out_specs=(c_spec, P(None, None), P(None, None)),
     )
-    out, det = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det)
+    out, det, unc = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det, unc)
 
 
 __all__ = ["initialize", "make_multihost_mesh", "multihost_ft_sgemm"]

@@ -106,7 +106,7 @@ def ring_ft_sgemm(
         zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
 
         def hop(t, carry):
-            out, b_vis, det = carry
+            out, b_vis, det, unc = carry
             res = local_ft(a_loc, b_vis, zeros, inject)
             # perm shifts shards UP the ring, so after t rotations a device
             # holds the shard that started at position my - t => that
@@ -114,26 +114,28 @@ def ring_ft_sgemm(
             col0 = jnp.mod(my - t, d) * nb
             out = jax.lax.dynamic_update_slice(out, res.c, (0, col0))
             det = det + jnp.sum(res.detections)
+            unc = unc + jnp.sum(res.uncorrectable)
             # Rotate AFTER computing so hop t uses the t-shifted shard; the
             # final rotation returns shards to their owners.
             b_vis = jax.lax.ppermute(b_vis, "x", perm)
-            return out, b_vis, det
+            return out, b_vis, det, unc
 
         out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
-        out, _, det = jax.lax.fori_loop(
-            0, d, hop, (out0, b_loc, jnp.int32(0)))
+        out, _, det, unc = jax.lax.fori_loop(
+            0, d, hop, (out0, b_loc, jnp.int32(0), jnp.int32(0)))
         out = alpha * out + beta * c_loc
         det = jax.lax.psum(det, "x")
-        return out, det.reshape(1, 1)
+        unc = jax.lax.psum(unc, "x")
+        return out, det.reshape(1, 1), unc.reshape(1, 1)
 
     fn = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(P("x", None), P("x", None), P("x", None)),
-        out_specs=(P("x", None), P(None, None)),
+        out_specs=(P("x", None), P(None, None), P(None, None)),
     )
-    out, det = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det)
+    out, det, unc = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det, unc)
 
 
 def ring_sgemm(

@@ -105,7 +105,7 @@ def ring_ft_attention(
         qpos = (my * nq + jnp.arange(nq) + (lk - lq))[:, None]
 
         def hop(t, carry):
-            m, l, o, k_vis, vt_vis, det = carry
+            m, l, o, k_vis, vt_vis, det, unc = carry
             s_res = qk(q_loc, k_vis, zs, inject)
             s_t = sc * s_res.c
             if causal:
@@ -125,14 +125,17 @@ def ring_ft_attention(
             o = a * o + o_res.c
             l = a * l + jnp.sum(p_t, axis=1, keepdims=True)
             det = det + jnp.sum(s_res.detections) + jnp.sum(o_res.detections)
+            unc = unc + jnp.sum(s_res.uncorrectable) + jnp.sum(
+                o_res.uncorrectable)
             k_vis = jax.lax.ppermute(k_vis, "x", perm)
             vt_vis = jax.lax.ppermute(vt_vis, "x", perm)
-            return m_new, l, o, k_vis, vt_vis, det
+            return m_new, l, o, k_vis, vt_vis, det, unc
 
         m0 = jnp.full((nq, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((nq, 1), jnp.float32)
-        m, l, o, _, _, det = jax.lax.fori_loop(
-            0, dnum, hop, (m0, l0, zo, k_loc, vt_loc, jnp.int32(0)))
+        m, l, o, _, _, det, unc = jax.lax.fori_loop(
+            0, dnum, hop,
+            (m0, l0, zo, k_loc, vt_loc, jnp.int32(0), jnp.int32(0)))
         # Normalization invariant of the streaming softmax: l aggregates
         # exp(s - m) > 0 over all Lk keys; non-finite or non-positive rows
         # mean corrupted softmax state (detect-only, like the single-device
@@ -142,18 +145,20 @@ def ring_ft_attention(
         out = o / l
         det = jax.lax.psum(det, "x")
         flags = jax.lax.psum(flags, "x")
-        return out, det.reshape(1, 1), flags.reshape(1, 1)
+        unc = jax.lax.psum(unc, "x")
+        return out, det.reshape(1, 1), flags.reshape(1, 1), unc.reshape(1, 1)
 
     fn = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(P("x", None), P("x", None), P(None, "x")),
-        out_specs=(P("x", None), P(None, None), P(None, None)),
+        out_specs=(P("x", None), P(None, None), P(None, None),
+                   P(None, None)),
     )
     # V rides the ring pre-transposed: the PV kernel consumes B = V^T and a
     # (dv, Lk/D) shard halves nothing but avoids a per-hop transpose.
-    out, det, flags = jax.jit(fn)(q, k, jnp.swapaxes(v, 0, 1))
-    return FtAttentionResult(out, det[0, 0], flags[0, 0])
+    out, det, flags, unc = jax.jit(fn)(q, k, jnp.swapaxes(v, 0, 1))
+    return FtAttentionResult(out, det[0, 0], flags[0, 0], unc[0, 0])
 
 
 __all__ = ["make_ring_mesh", "ring_ft_attention"]

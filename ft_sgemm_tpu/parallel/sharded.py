@@ -76,8 +76,8 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes):
 
     Runs the local fused-ABFT kernel on the device's shard (corrects BEFORE
     any collective), combines K-partials over mesh axis "y" with psum or
-    psum_scatter, applies alpha/beta once, and psums detection counts over
-    ``det_axes``.
+    psum_scatter, applies alpha/beta once, and psums detection and
+    uncorrectable-interval counts over ``det_axes``.
     """
 
     def step(a_loc, b_loc, c_loc):
@@ -90,7 +90,8 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes):
             partial = jax.lax.psum(res.c, "y")
         out = alpha * partial + beta * c_loc
         det = jax.lax.psum(res.detections, det_axes)
-        return out, det
+        unc = jax.lax.psum(res.uncorrectable, det_axes)
+        return out, det, unc
 
     return step
 
@@ -159,10 +160,10 @@ def sharded_ft_sgemm(
         step,
         mesh=mesh,
         in_specs=(P("x", "y"), P(None, "y"), c_spec),
-        out_specs=(c_spec, P(None, None)),
+        out_specs=(c_spec, P(None, None), P(None, None)),
     )
-    out, det = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det)
+    out, det, unc = jax.jit(fn)(a, b, c)
+    return FtSgemmResult(out, det, unc)
 
 
 def sharded_sgemm(

@@ -83,14 +83,88 @@ def test_bwd_threshold_catches_small_faults():
 
 
 def test_detect_only_strategy_rejected():
-    """The differentiable APIs discard detection counts, so a detect-only
-    strategy would provide zero protection — both factories refuse it."""
+    """Both factories refuse detect-only 'global' even with
+    with_counts=True: counts cover the FORWARD GEMMs only — a custom_vjp
+    backward has no primal channel, so detect-only backward faults would
+    be neither corrected nor observable."""
     from ft_sgemm_tpu import make_ft_attention_diff
 
     with pytest.raises(ValueError, match="CORRECTING"):
         make_ft_matmul(TILE, strategy="global")
     with pytest.raises(ValueError, match="CORRECTING"):
         make_ft_attention_diff(strategy="global")
+    with pytest.raises(ValueError, match="CORRECTING"):
+        make_ft_matmul(TILE, strategy="global", with_counts=True)
+    with pytest.raises(ValueError, match="CORRECTING"):
+        make_ft_attention_diff(strategy="global", with_counts=True)
+
+
+def test_with_counts_observable_under_grad():
+    """with_counts=True returns (out, counts): gradients flow through out
+    (unchanged vs the reference), while the int32 counts leaf reports the
+    forward GEMM's corrected faults every step — including under jit."""
+    a, b = _ab(256, 128, 256, seed=4)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    mm = make_ft_matmul(TILE, inject=inj, with_counts=True)
+
+    def loss(a, b):
+        r = mm(a, b)
+        return jnp.sum(jnp.tanh(r.out)), (r.detections, r.uncorrectable)
+
+    (val, (counts, unc)), (ga, gb) = jax.jit(
+        jax.value_and_grad(loss, argnums=(0, 1), has_aux=True))(a, b)
+    _, loss_ref = _loss_pair(None, a, b)
+    ra, rb = jax.grad(loss_ref, argnums=(0, 1))(a, b)
+    assert int(counts) > 0, "injected faults must be counted"
+    assert int(unc) == 0, "rotating injector must stay correctable"
+    np.testing.assert_allclose(float(val), float(loss_ref(a, b)),
+                               rtol=1e-4)
+    for got, want in ((ga, ra), (gb, rb)):
+        ok, nbad, _ = verify_matrix(np.asarray(want), np.asarray(got),
+                                    verbose=False)
+        assert ok, f"{nbad} corrupted gradient elements survived"
+
+    # Clean build: counts must be exactly zero.
+    mm_clean = make_ft_matmul(TILE, with_counts=True)
+    res = mm_clean(a, b)
+    assert int(res.detections) == 0 and int(res.uncorrectable) == 0
+
+
+def test_attention_diff_with_counts():
+    """with_counts=True on the differentiable attention returns the full
+    FtAttentionResult pytree: detections cover both forward GEMMs, the
+    softmax rowsum invariant is restored, and grads still match."""
+    from ft_sgemm_tpu import (attention_reference, make_ft_attention_diff)
+    from ft_sgemm_tpu.ops.attention import FtAttentionResult
+
+    rng = np.random.default_rng(13)
+    l, d = 256, 128
+    q, k, v = (generate_random_matrix(l, d, rng=rng) for _ in range(3))
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    att = make_ft_attention_diff(inject=inj, with_counts=True)
+
+    res = att(q, k, v)
+    assert isinstance(res, FtAttentionResult)
+    assert int(res.detections) > 0
+    assert int(res.softmax_flags) == 0
+    assert int(res.uncorrectable) == 0
+
+    def loss(q, k, v):
+        r = att(q, k, v)
+        return jnp.sum(jnp.tanh(r.out)), (r.detections, r.softmax_flags)
+
+    (val, (det, flags)), grads = jax.jit(jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+    assert int(det) > 0 and int(flags) == 0
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(attention_reference(q, k, v)))
+
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(grads, want, ("dQ", "dK", "dV")):
+        ok, nbad, _ = verify_matrix(np.asarray(w), np.asarray(g),
+                                    verbose=False)
+        assert ok, f"{name}: {nbad} corrupted elements survived"
 
 
 def test_composes_with_jit_and_vmap():

@@ -94,3 +94,17 @@ def test_device_info_header():
     buf = io.StringIO()
     cli.print_device_info(out=buf)
     assert buf.getvalue().startswith("Device: ")
+
+
+def test_perf_sweep_generates_host_inputs_once_per_size():
+    """The sweep is size-major: N sizes x M kernel rows must cost exactly
+    N host-input generations (round-2 finding: the row-major loop with
+    lru_cache(2) regenerated every size for every row)."""
+    cli._host_inputs.cache_clear()
+    buf = io.StringIO()
+    cli.run_perf_table(
+        start_size=128, end_size=256, gap_size=128,
+        st_kernel=0, end_kernel=2, min_device_time=0.02, out=buf,
+    )
+    info = cli._host_inputs.cache_info()
+    assert info.misses == 2  # exactly one generation per size, ever

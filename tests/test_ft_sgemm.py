@@ -16,7 +16,7 @@ from ft_sgemm_tpu import (
     make_sgemm,
     sgemm_reference,
 )
-from ft_sgemm_tpu.configs import SHAPE_ORDER
+from ft_sgemm_tpu.configs import KernelShape, SHAPE_ORDER
 from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
 
 ALPHA, BETA = 1.0, -1.5
@@ -382,3 +382,131 @@ def test_rectangular_with_padding_and_injection():
     ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
     assert ok, f"{nbad} corrupted elements survived"
     assert int(res.num_detected) > 0
+
+
+# ---------------------------------------------------------------------------
+# Residual-after-correct re-check: two+ faults in ONE column of one check
+# interval defeat per-column localization; the kernels must report the
+# interval via FtSgemmResult.uncorrectable instead of silently miscorrecting
+# (the round-2 documented limit, now closed).
+# ---------------------------------------------------------------------------
+
+# Small explicit tile for the adversarial-schedule tests: nk = K/128 check
+# steps, fast in interpret mode (explicit KernelShape objects never shrink).
+ADV_TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+
+
+def _same_column_spec():
+    """col_stride=0 pins every fault to one column: the adversarial
+    schedule the rotating default (coprime stride 61) can never produce."""
+    return InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                         col_stride=0)
+
+
+def _assert_reported_or_corrected(res, a, b, c, label):
+    """The contract: either the output verifies clean, or uncorrectable is
+    nonzero — corruption is never silent."""
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    if not ok:
+        assert int(res.num_uncorrectable) > 0, (
+            f"{label}: {nbad} corrupted elements survived with NO "
+            f"uncorrectable report — silent corruption")
+
+
+@pytest.mark.parametrize("check_every", [None, 2])
+def test_weighted_same_column_faults_reported(check_every):
+    """Weighted localization (precomp at default cadence, in-kernel encode
+    at cadence 2) sees 4 same-column faults: per-column localization is
+    defeated and the weighted residual re-check must flag it."""
+    a, b, c = _inputs(128, 128, 512, seed=8)
+    ft = make_ft_sgemm(
+        ADV_TILE, alpha=ALPHA, beta=BETA, strategy="weighted",
+        check_every=check_every)
+    res = ft(a, b, c, inject=_same_column_spec())
+    _assert_reported_or_corrected(res, a, b, c, f"weighted/{check_every}")
+    # This schedule (2+ faults per interval in one column) is known
+    # miscorrectable: the report must actually fire.
+    assert int(res.num_uncorrectable) > 0
+
+
+def test_rowcol_same_column_faults_corrected_exactly():
+    """Plain row/col intersection handles same-column faults on DISTINCT
+    rows exactly (each flagged row carries its own residual) — corrected,
+    zero uncorrectable."""
+    a, b, c = _inputs(128, 128, 512, seed=8)
+    ft = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                       strategy="rowcol")
+    res = ft(a, b, c, inject=_same_column_spec())
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"rowcol: {nbad} corrupted elements survived"
+    assert int(res.num_uncorrectable) == 0
+    assert int(res.num_detected) == 4  # nk=4 at bk=128, every=1
+
+
+def test_rowcol_ambiguous_with_doubled_column_reported():
+    """>=2 rows AND >=2 cols flagged routes rowcol-multifault to weighted
+    localization; a column holding TWO of the faults breaks its 1-fault
+    assumption. The row-residual re-check must flag the interval."""
+    a, b, c = _inputs(128, 128, 512, seed=8)
+    # Stride 64 over bn=128: faults alternate between two columns, so one
+    # check interval covering all 4 faults sees 2 faults in EACH column.
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                        col_stride=64)
+    ft = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                       strategy="rowcol", check_every=4, multifault=True)
+    res = ft(a, b, c, inject=inj)
+    _assert_reported_or_corrected(res, a, b, c, "rowcol/ambiguous")
+    assert int(res.num_uncorrectable) > 0
+
+
+def test_clean_runs_report_zero_uncorrectable():
+    """No injection -> both counters exactly zero, every strategy."""
+    a, b, c = _inputs(256, 128, 512, seed=2)
+    for strategy in ("rowcol", "weighted"):
+        res = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA,
+                            strategy=strategy)(a, b, c)
+        assert int(res.num_detected) == 0, strategy
+        assert int(res.num_uncorrectable) == 0, strategy
+
+
+def test_reference_like_injection_zero_uncorrectable():
+    """The rotating (coprime-stride) injector keeps every interval
+    correctable: corrections verified, uncorrectable == 0."""
+    a, b, c = _inputs(256, 256, 1024, seed=6)
+    inj = InjectionSpec.reference_like(1024, SHAPES["huge"].bk, num_faults=8)
+    for strategy in ("rowcol", "weighted"):
+        res = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA,
+                            strategy=strategy)(a, b, c, inject=inj)
+        want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"{strategy}: {nbad} corrupted"
+        assert int(res.num_uncorrectable) == 0, strategy
+
+
+def test_global_uncorrectable_equals_detections():
+    """Detect-only strategy: every detection is by definition uncorrected."""
+    a, b, c = _inputs(128, 128, 512, seed=3)
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    res = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="global",
+                        check_every=2)(a, b, c, inject=inj)
+    assert int(res.num_detected) > 0
+    assert int(res.num_uncorrectable) == int(res.num_detected)
+
+
+@pytest.mark.parametrize("check_every", [None, 3])
+def test_weighted_arithmetic_progression_faults_reported(check_every):
+    """Equal-magnitude faults at rows in arithmetic progression (the
+    rotating row stride makes col_stride=0 produce exactly this) zero BOTH
+    the plain and first-moment residuals after the point-mass correction
+    lands on the mean row — only the second-moment (w^2) re-check can see
+    it. Round-3 review repro: K=384, rows 7/10/13 of one column."""
+    a, b, c = _inputs(128, 128, 384, seed=8)  # nk=3 at bk=128
+    ft = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                       strategy="weighted", check_every=check_every)
+    res = ft(a, b, c, inject=_same_column_spec())
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, _, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert not ok, "3 same-column faults should defeat localization here"
+    assert int(res.num_uncorrectable) > 0, "silent corruption"
