@@ -100,7 +100,7 @@ def _read_records(path):
 # Context stages the worker wants beyond the headline; _worker_rc derives
 # the supervisor-facing exit status from the records alone.
 WANTED_STAGES = ("backend", "xla_dot", "plain_huge", "ft_rowcol",
-                 "bf16_abft", "bf16_plain", "bf16_xla")
+                 "ft_fused", "bf16_abft", "bf16_plain", "bf16_xla")
 
 
 def _worker_rc(rec):
@@ -256,6 +256,7 @@ def _emit_locked(values, errors, extra_errors=None):
         "xla_dot": "xla_dot_gflops",
         "plain_huge": "kernel_sgemm_huge_gflops",
         "ft_rowcol": "abft_rowcol_gflops",
+        "ft_fused": "abft_fused_gflops",
         "bf16_abft": "bf16_abft_huge_gflops",
         "bf16_plain": "bf16_sgemm_huge_gflops",
         "bf16_xla": "bf16_xla_dot_gflops",
@@ -580,6 +581,13 @@ def _worker_stages(rec):
         return gf(lambda a, b, x: ft_rc(a, b, x, inj).c, a, b, c)
 
     record_retry("ft_rowcol", rowcol_fn, attempts=2)
+
+    def fused_fn():
+        ft_fu = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                              strategy="fused")
+        return gf(lambda a, b, x: ft_fu(a, b, x, inj).c, a, b, c)
+
+    record_retry("ft_fused", fused_fn, attempts=2)
 
     # TPU-native bf16 input mode (f32 accumulation + checksums): the MXU's
     # full-rate path — context only; the headline stays f32 for reference

@@ -26,7 +26,7 @@ has no tunnel overhead to cancel).
 Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
-        [--dtype=bfloat16] [--strategy=rowcol|weighted|global]
+        [--dtype=bfloat16] [--strategy=rowcol|weighted|global|fused]
 
 ``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
@@ -35,9 +35,10 @@ then diffs against the XLA dot over the same bf16-rounded inputs.
 
 ``--strategy`` picks the fused-ABFT checksum design for the FT rows:
 ``rowcol`` (default, reference parity), ``weighted`` (deferred
-localization — fastest correcting design), or ``global`` (detect-only; its
+localization — fastest correcting design), ``global`` (detect-only; its
 rows are excluded from the verification gate since corruption is left in
-the output by design).
+the output by design), or ``fused`` (checksum moments ride extra A rows
+through the same MXU dot — the warp-level design's TPU analog).
 
 ``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
 analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5

@@ -27,7 +27,7 @@ correct — no second pass over C:
   4. **Fault injection** is a runtime :class:`InjectionSpec` lowered through
      SMEM scalars (the reference hardcodes it, ``ft_sgemm_huge.cuh:49-51``).
 
-Three checksum strategies mirror the reference's three preserved designs:
+Four checksum strategies mirror the reference's preserved designs:
 
   - ``"rowcol"`` (default): row+column checksums, residual-intersection
     correction — the shipped generated kernels
@@ -53,6 +53,20 @@ Three checksum strategies mirror the reference's three preserved designs:
     long as each corrupted column holds a single fault — so its default
     cadence is a single final check, making per-step overhead ~encode-only
     (~3-4% at 4096 vs the reference flagship's 16.4%, BASELINE.md).
+  - ``"fused"``: the warp-level design's TPU analog
+    (``include/ft_sgemm_huge_warp.cuh:139-207``). The reference fuses its
+    checksum dot-products INTO the kk-loop using per-warp smem-cached
+    input checksums; here the same fusion is **operand augmentation** —
+    each A row-tile carries its three checksum-moment rows (``1^T A_i``,
+    ``w^T A_i``, ``(w^2)^T A_i``), so the SAME MXU dot that accumulates
+    the C tile accumulates the expected column moments as extra output
+    rows. Zero VPU encode work, zero separate checksum pass; the encode
+    cost is 8/bm extra MXU rows (~1.6% FLOPs at bm=512) for f32, 16/bm
+    (~3.1%) for bf16, whose moment rows ride as hi/lo/lo2 triples
+    (``_tile_moments``). Correction
+    semantics match ``weighted`` (per-column localization + three-moment
+    re-check) at ANY cadence — intermediate checks cost no extra encode,
+    unlike weighted's running-sum variant.
 """
 
 from __future__ import annotations
@@ -77,7 +91,7 @@ from ft_sgemm_tpu.ops.common import (
     shrink_block as _shrink_block,
 )
 
-STRATEGIES = ("rowcol", "global", "weighted")
+STRATEGIES = ("rowcol", "global", "weighted", "fused")
 
 
 class FtSgemmResult(NamedTuple):
@@ -85,9 +99,12 @@ class FtSgemmResult(NamedTuple):
 
     ``detections`` counts distinct fault events per C tile, uniformly
     across strategies:
-      - ``rowcol``/``weighted``: number of corrected accumulator elements —
-        one per injected fault whenever each corrupted column holds at most
-        one fault per check interval (guaranteed for the rotating injector).
+      - ``rowcol``/``weighted``/``fused``: number of corrected accumulator
+        elements — one per injected fault whenever each corrupted column
+        holds at most one fault per check interval (guaranteed for the
+        rotating injector). ``fused`` shares ``weighted``'s correction and
+        three-moment re-check exactly (both call ``_moment_detect_correct``);
+        only the encode path differs.
       - ``global``: number of check intervals in which NEW corruption
         appeared (the residual moved by more than the threshold since the
         previous check). The strategy never corrects, so this equals the
@@ -167,6 +184,43 @@ def _inject(out_ref, inj_ref, k, i, j, bm, bn):
         hit = (rows == m0 - m0a) & (cols == n0 - n0a)
         out_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)] = sub + jnp.where(
             hit, magnitude, 0.0)
+
+
+def _moment_detect_correct(acc, exp_c, exp_cw, exp_cw2, threshold, bm, bn):
+    """Shared three-moment detect / localize / correct / re-check.
+
+    The weighted, weighted-precomp, and fused kernels differ ONLY in where
+    their expected column moments come from (running VMEM accumulation, a
+    precomputed XLA dot, or augmented MXU output rows); everything from
+    residual formation through the residual-after-correct re-check is this
+    one function, so their correction and reporting behavior stays in
+    lockstep (LEVEL semantics for the uncorrectable count — see
+    FtSgemmResult). Returns ``(corrected_acc, n_hit, n_unc)``.
+    """
+    w_col = jax.lax.broadcasted_iota(
+        jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+    w2 = w_col * w_col
+    cs = jnp.sum(acc, axis=0, keepdims=True)             # (1, bn)
+    csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
+    csw2 = jnp.sum(acc * w2, axis=0, keepdims=True)      # (1, bn)
+    res_c = exp_c - cs
+    res_cw = exp_cw - csw
+    det_c = jnp.abs(res_c) > threshold
+    hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
+    delta = jnp.where(hit, res_c, 0.0)
+    # Residual-after-correct re-check: residuals are linear in the
+    # accumulator, so post-correction residuals are the pre-correction
+    # ones minus delta's moment sums. A point-mass correction can match
+    # the first two moments of a multi-fault column (equal faults at rows
+    # in arithmetic progression do) but never all three for same-sign
+    # faults — anything still above threshold is REPORTED, not silent.
+    res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
+    res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
+    res_cm2 = exp_cw2 - csw2 - jnp.sum(delta * w2, axis=0, keepdims=True)
+    n_unc = jnp.sum(
+        ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > threshold)
+         | (jnp.abs(res_cm2) > threshold)).astype(jnp.int32))
+    return acc + delta, jnp.sum(hit.astype(jnp.int32)), n_unc
 
 
 def _weighted_localize(res_c, res_cw, det_c, bm, bn):
@@ -427,32 +481,13 @@ def _ft_kernel_weighted(
 
     @pl.when(do_check)
     def _detect_correct():
-        acc = out_ref[:]
-        cs = jnp.sum(acc, axis=0, keepdims=True)             # (1, bn)
-        csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
-        res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs        # (1, bn)
-        res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
-        det_c = jnp.abs(res_c) > threshold
-        hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
-        delta = jnp.where(hit, res_c, 0.0)
-        out_ref[:] += delta
-        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
-        # Residual-after-correct re-check (see _ft_kernel_rowcol): multiple
-        # same-column faults defeat per-column localization. The 0th/1st
-        # moment residuals catch most miscorrections; the 2nd-moment (w^2)
-        # residual catches the rest for same-sign fault sets (a point mass
-        # cannot match three moments of >= 2 distinct rows — equal faults
-        # at rows in arithmetic progression zero the first two moments but
-        # never this one). All REPORT via the uncorrectable counter.
-        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
-        res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
-        csw2 = jnp.sum(acc * (w_col * w_col), axis=0, keepdims=True)
-        res_cm2 = (jnp.swapaxes(cw2_exp_ref[:], 0, 1) - csw2
-                   - jnp.sum(delta * (w_col * w_col), axis=0, keepdims=True))
-        # LEVEL, not accumulation (see _ft_kernel_rowcol's re-check).
-        unc_count_ref[0] = jnp.sum(
-            ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > threshold)
-             | (jnp.abs(res_cm2) > threshold)).astype(jnp.int32))
+        corrected, n_hit, n_unc = _moment_detect_correct(
+            out_ref[:], jnp.swapaxes(c_exp_ref[:], 0, 1),
+            jnp.swapaxes(cw_exp_ref[:], 0, 1),
+            jnp.swapaxes(cw2_exp_ref[:], 0, 1), threshold, bm, bn)
+        out_ref[:] = corrected
+        count_ref[0] += n_hit
+        unc_count_ref[0] = n_unc  # LEVEL semantics (helper docstring)
 
     @pl.when(k == nk - 1)
     def _epilogue():
@@ -509,85 +544,152 @@ def _ft_kernel_weighted_precomp(
 
     @pl.when(k == nk - 1)
     def _detect_correct_epilogue():
-        w_col = jax.lax.broadcasted_iota(
-            jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
-        acc = out_ref[:]
-        cs = jnp.sum(acc, axis=0, keepdims=True)             # (1, bn)
-        csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
-        res_c = exp_ref[0:1, :] - cs                         # (1, bn)
-        res_cw = exp_ref[1:2, :] - csw                       # (1, bn)
-        det_c = jnp.abs(res_c) > threshold
-        hit = _weighted_localize(res_c, res_cw, det_c, bm, bn)
-        delta = jnp.where(hit, res_c, 0.0)
-        corrected = acc + delta
-        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
-        # Residual-after-correct re-check across all three column moments
-        # (single final check — write the count straight to the output;
-        # rationale in _ft_kernel_weighted).
-        w2 = w_col * w_col
-        csw2 = jnp.sum(acc * w2, axis=0, keepdims=True)      # (1, bn)
-        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
-        res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
-        res_cm2 = (exp_ref[2:3, :] - csw2
-                   - jnp.sum(delta * w2, axis=0, keepdims=True))
-        unc_ref[i, j] = jnp.sum(
-            ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > threshold)
-             | (jnp.abs(res_cm2) > threshold)).astype(jnp.int32))
+        corrected, n_hit, n_unc = _moment_detect_correct(
+            out_ref[:], exp_ref[0:1, :], exp_ref[1:2, :], exp_ref[2:3, :],
+            threshold, bm, bn)
+        count_ref[0] += n_hit
+        unc_ref[i, j] = n_unc
         out_ref[:] = alpha * corrected + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
 
 
-def _expected_col_checksums(ap, bp, bm, prec):
-    """Per-tile expected (plain, weighted) column checksums, via XLA.
+def _ft_kernel_fused(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
+    exp_ref, count_ref, unc_count_ref,
+    *, alpha, beta, nk, prec, threshold, check_every, bm, bn, n_terms,
+):
+    """MXU-fused checksum variant (warp-level analog — module docstring).
 
-    ``ap`` is the padded (M, K) input in the kernel's consumption dtype
-    (checksums must see the same rounded values the MXU consumes). Returns
-    one (8 * M/bm, N) f32 array: within each 8-row group i, row 0 holds
-    ``1^T A_i @ B^T``, row 1 ``w^T A_i @ B^T`` (weights {1..bm}), and row
-    2 ``(w^2)^T A_i @ B^T`` (the re-check's second moment); rows 3-7 are
-    zero — an (8, bn)-blockable layout (Mosaic requires sublane dims
-    divisible by 8).
-
-    For bf16 inputs the checksum rows are carried as hi+lo+lo2 bf16
-    triples (``x ~= bf16(x) + bf16(x - hi) + bf16(x - hi - lo)``) and the
-    parts summed after the dot: a single bf16 cast of ``w^T A_i``
-    (magnitudes up to ~1e4) leaves ~0.3-1.4 of residual noise that the
-    correction would deposit INTO the corrected elements, failing the
-    0.01/0.01 verify tolerance — and the w^2 row reaches ~bm^2-scale
-    magnitudes where even a 2-term split's noise could graze the 9500
-    detection threshold at K=6144. Three terms put every row's expectation
-    error in the f32 accumulation-noise class at negligible MXU cost
-    (9 sublanes instead of 3 in the same stacked dot).
+    ``a_ref`` blocks are (bm + aug, bk): the augmented tail rows hold the
+    input checksum moments (``_augment_a`` layout: for term t and moment
+    mi, tail row ``3*t + mi``), so the very same MXU dot that accumulates
+    the C tile produces the EXPECTED column-moment rows — there is no
+    separate encode path to corrupt independently. The moment rows
+    accumulate in the ``exp_ref`` VMEM scratch while the C rows accumulate
+    in the resident output block, keeping the output array (M, N) with no
+    de-augmentation pass over HBM. SDC landing in a checksum row itself
+    shows up as a residual with no localizable source row: the correction
+    misses, the re-check flags, and the interval is reported uncorrectable
+    (never applied to C, which those rows never touch).
     """
-    m, kdim = ap.shape
-    gm = m // bm
-    af = ap.astype(jnp.float32).reshape(gm, bm, kdim)
-    w = (jnp.arange(bm, dtype=jnp.float32) + 1.0)[None, :, None]
-    sa = jnp.sum(af, axis=1)             # (gm, K)
-    swa = jnp.sum(af * w, axis=1)        # (gm, K)
-    sw2a = jnp.sum(af * (w * w), axis=1)  # (gm, K)
-    stacked_f32 = jnp.concatenate([sa, swa, sw2a], axis=0)
-    if ap.dtype == jnp.bfloat16:
-        hi = stacked_f32.astype(jnp.bfloat16)
-        rem = stacked_f32 - hi.astype(jnp.float32)
-        lo = rem.astype(jnp.bfloat16)
-        lo2 = (rem - lo.astype(jnp.float32)).astype(jnp.bfloat16)
-        stacked = jnp.concatenate([hi, lo, lo2], axis=0)   # (9*gm, K)
-    else:
-        stacked = stacked_f32
-    exp = jax.lax.dot_general(
-        stacked, bp,
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        exp_ref[:] = jnp.zeros_like(exp_ref)
+        count_ref[0] = 0
+        unc_count_ref[0] = 0
+
+    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+
+    prod = jax.lax.dot_general(
+        a_ref[:], b_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
-    )                                    # (3*gm or 9*gm, N) f32
+    )                                   # (bm + aug, bn): C rows + moments
+    out_ref[:] += prod[:bm, :]
+    exp_ref[:] += prod[bm:, :]
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect_correct():
+        # Expected moments: sum the per-term scratch rows (1 term f32, 3
+        # for bf16 hi/lo/lo2 — _augment_a).
+        exp = [exp_ref[mi:mi + 1, :] for mi in range(3)]
+        for t in range(1, n_terms):
+            exp = [e + exp_ref[3 * t + mi:3 * t + mi + 1, :]
+                   for mi, e in enumerate(exp)]
+        corrected, n_hit, n_unc = _moment_detect_correct(
+            out_ref[:], exp[0], exp[1], exp[2], threshold, bm, bn)
+        out_ref[:] = corrected
+        count_ref[0] += n_hit
+        unc_count_ref[0] = n_unc  # LEVEL semantics (helper docstring)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+        unc_ref[i, j] = unc_count_ref[0]
+
+
+def _tile_moments(ap, bm):
+    """Per-row-tile checksum-moment rows of A, in ``ap``'s dtype.
+
+    Returns (gm, R, K): for f32 inputs R=3 rows — the plain / w / w^2
+    column moments (weights {1..bm}) of each (bm, K) row tile; for bf16
+    R=9 — each moment expanded to bf16 hi+lo+lo2 terms at row ``3*t + mi``
+    (term t, moment mi). The 3-term split matters because a single bf16
+    cast of ``w^T A_i`` (magnitudes ~1e4) leaves ~0.3-1.4 of expectation
+    noise — deposited INTO corrected elements, failing the 0.01/0.01
+    verify tolerance — and the w^2 row reaches ~bm^2-scale magnitudes
+    where even a 2-term split's noise could graze the 9500 detection
+    threshold at K=6144; three terms put every row's error in the f32
+    accumulation-noise class. Shared by ``_augment_a`` (fused strategy)
+    and ``_expected_col_checksums`` (weighted precomp) so the encode
+    numerics of both MXU-side checksum paths stay in lockstep.
+    """
+    m, kdim = ap.shape
+    gm = m // bm
+    af = ap.reshape(gm, bm, kdim).astype(jnp.float32)
+    w = (jnp.arange(bm, dtype=jnp.float32) + 1.0)[None, :, None]
+    moments = jnp.stack(
+        [jnp.sum(af, axis=1), jnp.sum(af * w, axis=1),
+         jnp.sum(af * (w * w), axis=1)], axis=1)          # (gm, 3, K)
     if ap.dtype == jnp.bfloat16:
-        exp = exp[: 3 * gm] + exp[3 * gm: 6 * gm] + exp[6 * gm:]
-    grouped = jnp.zeros((gm, 8, exp.shape[1]), jnp.float32)
-    grouped = grouped.at[:, 0, :].set(exp[:gm])
-    grouped = grouped.at[:, 1, :].set(exp[gm:2 * gm])
-    grouped = grouped.at[:, 2, :].set(exp[2 * gm:])
-    return grouped.reshape(8 * gm, exp.shape[1])
+        hi = moments.astype(jnp.bfloat16)
+        rem = moments - hi.astype(jnp.float32)
+        lo = rem.astype(jnp.bfloat16)
+        lo2 = (rem - lo.astype(jnp.float32)).astype(jnp.bfloat16)
+        return jnp.concatenate([hi, lo, lo2], axis=1)     # (gm, 9, K) bf16
+    return moments                                        # (gm, 3, K) f32
+
+
+def _augment_a(ap, bm, aug):
+    """Append per-row-tile checksum-moment rows to A (``fused`` strategy).
+
+    Returns (gm * (bm + aug), K) in ``ap``'s dtype: each tile's tail
+    ``aug`` rows hold the ``_tile_moments`` rows (3 for f32, 9 for bf16),
+    zero-padded to the sublane-aligned ``aug``.
+    """
+    m, kdim = ap.shape
+    gm = m // bm
+    rows = _tile_moments(ap, bm)
+    tail = jnp.zeros((gm, aug, kdim), ap.dtype)
+    tail = tail.at[:, :rows.shape[1], :].set(rows.astype(ap.dtype))
+    return jnp.concatenate(
+        [ap.reshape(gm, bm, kdim), tail], axis=1).reshape(
+            gm * (bm + aug), kdim)
+
+
+def _expected_col_checksums(ap, bp, bm, prec):
+    """Per-tile expected (plain, weighted, w^2) column checksums, via XLA.
+
+    ``ap`` is the padded (M, K) input in the kernel's consumption dtype
+    (checksums must see the same rounded values the MXU consumes — moment
+    rows and bf16 term-splitting come from ``_tile_moments``). Returns
+    one (8 * M/bm, N) f32 array: within each 8-row group i, rows 0-2 hold
+    ``1^T A_i @ B^T``, ``w^T A_i @ B^T``, ``(w^2)^T A_i @ B^T``; rows 3-7
+    are zero — an (8, bn)-blockable layout (Mosaic requires sublane dims
+    divisible by 8).
+    """
+    rows = _tile_moments(ap, bm)                     # (gm, R, K)
+    gm, r, kdim = rows.shape
+    exp = jax.lax.dot_general(
+        rows.reshape(gm * r, kdim), bp,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    ).reshape(gm, r, -1)                             # (gm, R, N) f32
+    if r == 9:  # bf16: sum the hi/lo/lo2 term rows per moment
+        exp = exp[:, 0:3] + exp[:, 3:6] + exp[:, 6:9]
+    grouped = jnp.zeros((gm, 8, exp.shape[2]), jnp.float32)
+    grouped = grouped.at[:, :3, :].set(exp)
+    return grouped.reshape(8 * gm, exp.shape[2])
 
 
 def _scratch_for(strategy, bm, bn, multifault):
@@ -644,9 +746,10 @@ def _ft_sgemm_padded(
     # the running in-kernel encode.
     precomp = strategy == "weighted" and check_every >= nk
 
+    a_rows = bm  # A block / output block row count (augmented for "fused")
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # injection spec (4,)
-        pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+        None,  # A spec placed below once a_rows is final
         pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
         pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
     ]
@@ -661,6 +764,19 @@ def _ft_sgemm_padded(
         in_specs += [pl.BlockSpec((8, bn), lambda i, j, kk: (i, j))]
         operands += [exp]
         scratch = [pltpu.SMEM((1,), jnp.int32)]
+    elif strategy == "fused":
+        n_terms = 3 if a.dtype == jnp.bfloat16 else 1
+        aug = 16 if n_terms == 3 else 8
+        a_rows = bm + aug
+        operands[1] = _augment_a(a, bm, aug)
+        kernel = functools.partial(
+            _ft_kernel_fused,
+            alpha=alpha, beta=beta, nk=nk, prec=prec,
+            threshold=threshold, check_every=check_every, bm=bm, bn=bn,
+            n_terms=n_terms,
+        )
+        scratch = [pltpu.VMEM((aug, bn), jnp.float32),
+                   pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
     else:
         extra = {"multifault": multifault} if strategy == "rowcol" else {}
         kernel = functools.partial(
@@ -670,6 +786,7 @@ def _ft_sgemm_padded(
             **extra,
         )
         scratch = _scratch_for(strategy, bm, bn, multifault)
+    in_specs[1] = pl.BlockSpec((a_rows, bk), lambda i, j, kk: (i, kk))
 
     out, det, unc = pl.pallas_call(
         kernel,
@@ -727,16 +844,21 @@ def make_ft_sgemm(
     injection period), where the plain intersection is already exact —
     matching the reference's by-construction guarantee
     (``code_gen.py:333-337``) at zero extra encode cost; enabled otherwise
-    (including clean runs, where real SDC counts are unknown). For
-    ``rowcol``/``weighted``, the cadence is clamped to ``bn *
-    inject.every`` so the rotating injector cannot wrap two faults into
-    the same column of one interval.
+    (including clean runs, where real SDC counts are unknown). For the
+    column-localized correcting strategies (``rowcol``/``weighted``/
+    ``fused``), the cadence is clamped to ``bn * inject.every`` (when the
+    injector's column stride is coprime to bn) so the rotating injector
+    cannot wrap two faults into the same column of one interval.
 
     ``in_dtype="bfloat16"`` feeds A/B to the MXU at its full-rate bf16 input
     format; the accumulator, checksums, and detect/correct math all stay
     f32. Checksums are computed on the bf16-rounded values the MXU actually
     consumes, so the residual noise floor is unchanged from the f32 path and
     the same thresholds apply.
+
+    ``strategy="fused"`` runs the MXU-augmented variant (module docstring):
+    checksum moments ride extra A rows through the same dot — weighted-
+    class correction at any cadence with zero per-panel encode work.
     """
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
@@ -763,7 +885,7 @@ def make_ft_sgemm(
         nk = ap.shape[1] // bk
         if check_every is not None:
             ce = check_every
-        elif strategy == "weighted":
+        elif strategy in ("weighted", "fused"):
             ce = nk  # single final check: localization absorbs fault backlog
         else:
             # ~20 checks per run like the reference's K/20-column cadence
@@ -771,7 +893,7 @@ def make_ft_sgemm(
             # don't overshoot (nk=32: every-other-step = 16 checks, vs 32
             # checks with floor — the reference does 20 regardless).
             ce = max(1, round(nk / 20))
-        if (inject.enabled and strategy in ("rowcol", "weighted")
+        if (inject.enabled and strategy in ("rowcol", "weighted", "fused")
                 and math.gcd(inject.col_stride, bn) == 1):
             # Column-localized correction needs the interval's faults in
             # DISTINCT columns. A column stride coprime to bn advances the

@@ -510,3 +510,71 @@ def test_weighted_arithmetic_progression_faults_reported(check_every):
     ok, _, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
     assert not ok, "3 same-column faults should defeat localization here"
     assert int(res.num_uncorrectable) > 0, "silent corruption"
+
+
+# ---------------------------------------------------------------------------
+# "fused" strategy (warp-level analog): checksum moments ride extra A rows
+# through the SAME MXU dot — weighted-class correction at any cadence with
+# zero per-panel encode work (reference include/ft_sgemm_huge_warp.cuh).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("check_every", [None, 2])
+def test_fused_strategy_corrects(check_every):
+    a, b, c = _inputs(256, 128, 512, seed=4)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA, strategy="fused",
+                       check_every=check_every)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"fused/{check_every}: {nbad} corrupted elements survived"
+    assert int(res.num_detected) == 4 * 2  # nk faults x (gm*gn)=2 tiles
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_fused_clean_matches_plain():
+    a, b, c = _inputs(256, 256, 384, seed=1)
+    res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                        strategy="fused")(a, b, c)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok and int(res.num_detected) == 0
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_fused_same_column_faults_reported():
+    """Same-column multi-fault intervals defeat per-column localization in
+    the fused design too — the three-moment re-check must report."""
+    a, b, c = _inputs(128, 128, 512, seed=8)
+    res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                        strategy="fused")(a, b, c,
+                                          inject=_same_column_spec())
+    _assert_reported_or_corrected(res, a, b, c, "fused/same-col")
+    assert int(res.num_uncorrectable) > 0
+
+
+def test_fused_bf16_corrects():
+    """bf16 fused: moment rows ride as hi/lo/lo2 bf16 triples in a 16-row
+    augmented tail; corrections must stay within the bf16 verify
+    tolerance and the re-check must stay quiet."""
+    a, b, c = _inputs(256, 128, 512, seed=9)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA, strategy="fused",
+                        in_dtype="bfloat16")(a, b, c, inject=inj)
+    want = np.asarray(
+        sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"bf16 fused: {nbad} corrupted elements survived"
+    assert int(res.num_detected) > 0
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_fused_rectangular_with_padding():
+    a, b, c = _inputs(200, 130, 300, seed=12)  # every dim pads
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                        strategy="fused")(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"fused/rect: {nbad} corrupted elements survived"
+    assert int(res.num_uncorrectable) == 0
