@@ -60,10 +60,13 @@ Four checksum strategies mirror the reference's preserved designs:
     each A row-tile carries its three checksum-moment rows (``1^T A_i``,
     ``w^T A_i``, ``(w^2)^T A_i``), so the SAME MXU dot that accumulates
     the C tile accumulates the expected column moments as extra output
-    rows. Zero VPU encode work, zero separate checksum pass; the encode
-    cost is 8/bm extra MXU rows (~1.6% FLOPs at bm=512) for f32, 16/bm
-    (~3.1%) for bf16, whose moment rows ride as hi/lo/lo2 triples
-    (``_tile_moments``). Correction
+    rows. Zero per-panel VPU encode work INSIDE the kernel; the costs are
+    8/bm extra MXU rows (~1.6% FLOPs at bm=512) for f32 or 16/bm (~3.1%)
+    for bf16 (moment rows ride as hi/lo/lo2 triples, ``_tile_moments``),
+    plus a per-call wrapper prep: ``_augment_a`` reduces A's moments
+    (O(M*K) VPU) and materializes the augmented A copy in HBM (~one extra
+    read+write of A) — cheap next to the GEMM at large K but, unlike the
+    in-kernel encode strategies, not free; bench rows time it. Correction
     semantics match ``weighted`` (per-column localization + three-moment
     re-check) at ANY cadence — intermediate checks cost no extra encode,
     unlike weighted's running-sum variant.

@@ -73,8 +73,8 @@ def main():
 
     # "weighted" always runs: its default cadence routes to the
     # precomputed-checksum kernel, which must Mosaic-compile every round.
-    for strategy in (("rowcol", "global", "weighted") if full
-                     else ("rowcol", "weighted")):
+    for strategy in (("rowcol", "global", "weighted", "fused") if full
+                     else ("rowcol", "weighted", "fused")):
         for name in shapes:
             shape = SHAPES[name]
             inj = InjectionSpec.reference_like(size, shape.bk)
@@ -86,10 +86,57 @@ def main():
                 ok_str = f"detect-only det={int(res.num_detected)}"
             else:
                 ok_str = (f"verify={'OK' if ok else f'FAIL({nbad})'} "
-                          f"det={int(res.num_detected)}")
+                          f"det={int(res.num_detected)}"
+                          f" unc={int(res.num_uncorrectable)}")
             gf = _gf(lambda a, b, x: fn(a, b, x, inject=inj).c, a, b, c, size)
             print(f"{'ft_sgemm_' + name + ':' + strategy:28s} {gf:9.1f} GFLOPS  "
                   f"{ok_str}  ({gf / xla_gf * 100:5.1f}% of XLA)")
+
+    # Multi-fault rowcol (forced): the weighted-column-checksum variant
+    # whose kernel body differs from the auto-skipped path; must Mosaic-
+    # compile and correct a coarse-cadence fault backlog on hardware.
+    inj_mf = InjectionSpec.reference_like(size, SHAPES["huge"].bk)
+    fn_mf = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, strategy="rowcol",
+                          multifault=True)
+    res = fn_mf(a, b, c, inject=inj_mf)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    gf = _gf(lambda a, b, x: fn_mf(a, b, x, inject=inj_mf).c, a, b, c, size)
+    print(f"{'ft_sgemm_huge:rowcol-mf':28s} {gf:9.1f} GFLOPS  "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(res.num_detected)} unc={int(res.num_uncorrectable)}  "
+          f"({gf / xla_gf * 100:5.1f}% of XLA)")
+
+    # Differentiable paths (never hardware-compiled before round 3):
+    # fwd+bwd FT matmul under jax.grad, and diff attention, tiny shapes.
+    import jax.numpy as jnp  # noqa: E402
+
+    from ft_sgemm_tpu import make_ft_attention_diff, make_ft_matmul  # noqa: E402
+
+    inj1s = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    mm = make_ft_matmul("huge", inject=inj1s, with_counts=True)
+    sa = min(size, 1024)
+    xs = jax.device_put(generate_random_matrix(sa, sa, rng=rng))
+    ws = jax.device_put(generate_random_matrix(sa, sa, rng=rng))
+
+    def loss(w):
+        r = mm(xs, w)
+        return jnp.sum(jnp.tanh(r.out)), (r.detections, r.uncorrectable)
+
+    (lv, (dct, unc)), gw = jax.jit(
+        jax.value_and_grad(loss, has_aux=True))(ws)
+    want_g = jax.grad(
+        lambda w: jnp.sum(jnp.tanh(xs @ w.T)))(ws)
+    ok, nbad, _ = verify_matrix(np.asarray(want_g), np.asarray(gw),
+                                verbose=False)
+    print(f"{'ft_matmul grad (with_counts)':28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(dct)} unc={int(unc)}")
+
+    attd = make_ft_attention_diff(inject=inj1s, with_counts=True)
+    qd = jax.device_put(generate_random_matrix(1024, 128, rng=rng))
+    dq = jax.jit(jax.grad(lambda q: jnp.sum(jnp.tanh(attd(q, qd, qd).out))))(qd)
+    print(f"{'ft_attention_diff grad':28s}            "
+          f"finite={bool(np.isfinite(np.asarray(dq)).all())}")
 
     # Parallel paths on the live chip (1x1 mesh, d=1 ring): Pallas-under-
     # shard_map must Mosaic-compile at least once per round — the pytest
@@ -155,7 +202,8 @@ def main():
             print(f"{'sgemm_' + name + ':bf16':28s} {gf:9.1f} GFLOPS  "
                   f"verify={'OK' if ok else f'FAIL({nbad})'}  "
                   f"({gf / xla16_gf * 100:5.1f}% of XLA bf16)")
-        for strategy in (("rowcol", "weighted") if full else ("weighted",)):
+        for strategy in (("rowcol", "weighted", "fused") if full
+                         else ("weighted", "fused")):
             for name in shapes:
                 fn = make_ft_sgemm(name, alpha=ALPHA, beta=BETA,
                                    strategy=strategy, in_dtype="bfloat16")
