@@ -37,8 +37,11 @@ well under any plausible driver window), ``FT_SGEMM_BENCH_WORKER_MAX`` per
 attempt (default 480), ``FT_SGEMM_BENCH_MARGIN`` reserved for final
 assembly (default 30), ``FT_SGEMM_BENCH_GRACE`` SIGTERM->SIGKILL (default
 5), ``FT_SGEMM_BENCH_MIN_ATTEMPT`` smallest budget worth launching a
-worker for (default 90), ``FT_SGEMM_BENCH_RECORDS`` records path (default
-a fresh temp file; point at an existing file to resume).
+worker for (default 90), ``FT_SGEMM_BENCH_RECORDS`` records path (default:
+a repo-local ``.bench/`` file keyed by the code version, so runs of the
+same code share measurements — an earlier monitoring run's stages resume
+into the scoring run; an flock serializes concurrent runs, and different
+code can never inherit stale numbers).
 """
 
 import json
@@ -158,6 +161,21 @@ class Recorder:
         self.errors[name] = error
         self._write({"name": name, "ok": False, "error": str(error)})
 
+    def reset(self):
+        """Discard all records (truncate the file, clear state) — used
+        when existing records are invalid for this run (wrong backend).
+        Writes a fresh _reset_token: the supervisor treats a token it did
+        NOT see in its pre-run snapshot as proof that nothing resumed,
+        even for stages whose remeasured values happen to coincide (e.g.
+        backend-independent constants)."""
+        self.values, self.errors = {}, {}
+        try:
+            with open(self.path, "w"):
+                pass
+        except OSError:
+            pass
+        self.ok("_reset_token", os.urandom(8).hex())
+
 
 # --------------------------------------------------------------------------
 # Supervisor
@@ -168,6 +186,8 @@ _EMITTED = False
 _FINAL_RC = None
 _RECORDS_PATH = None
 _ATTEMPTS = 0
+_PRE_VALUES = {}     # stage records that pre-dated this run (transparency)
+_LOCK_FH = None      # held for process lifetime (see _acquire_run_lock)
 
 
 def _worker_output():
@@ -281,6 +301,22 @@ def _emit_locked(values, errors, extra_errors=None):
         context["bf16_plain_vs_xla"] = round(bf_plain / bf_xla, 3)
 
     context["bench_attempts"] = _ATTEMPTS
+    # Honest provenance: count pre-existing stage records whose values
+    # survived unchanged into the final set — i.e. stages this run
+    # actually inherited rather than measured. A _reset_token that was
+    # not in the pre-run snapshot proves the worker discarded the old
+    # records mid-run: nothing resumed, coincidentally-equal remeasured
+    # values notwithstanding.
+    reset_this_run = (values.get("_reset_token") is not None
+                      and values.get("_reset_token")
+                      != _PRE_VALUES.get("_reset_token"))
+    # "backend" is always re-probed live (never served from cache), so
+    # it's excluded like the token: only MEASURED stages count.
+    resumed = 0 if reset_this_run else sum(
+        1 for k, v in _PRE_VALUES.items()
+        if k not in ("_reset_token", "backend") and values.get(k) == v)
+    if resumed:
+        context["resumed_stages"] = resumed
     context["errors"] = errors
     print(json.dumps({
         "metric": "abft_kernel_huge_gflops_4096",
@@ -327,15 +363,174 @@ def _worker_preexec():
         pass
 
 
-def main():
-    global _CHILD, _RECORDS_PATH, _ATTEMPTS
-    _RECORDS_PATH = os.environ.get("FT_SGEMM_BENCH_RECORDS")
-    if not _RECORDS_PATH:
-        fd, _RECORDS_PATH = tempfile.mkstemp(prefix="ft_sgemm_bench_",
-                                             suffix=".jsonl")
+def _code_version_key():
+    """Content key of the code under measurement: commit hash, plus a hash
+    of the tracked diff and of untracked files' (path, size, mtime) when
+    the tree is dirty — so distinct code states map to distinct keys (a
+    boolean dirty flag would let two different edits of the same commit
+    share records; ignoring untracked files would let a new module attach
+    stale numbers). mtime+size for untracked content is a cheap proxy —
+    it can over-split keys, never under-split in practice."""
+    import hashlib
+
+    base = os.path.dirname(os.path.abspath(__file__))
+
+    # Only CODE can invalidate records: artifact/log/doc files the round
+    # produces or edits (BENCH_*.json, RESULTS.md, CHANGELOG.md, records)
+    # must not silently defeat the resume this key exists to enable.
+    code_globs = ["*.py", "*.cpp", "*.cc", "*.c", "*.h", "*.sh", "*.toml"]
+    code_exts = tuple(g[1:] for g in code_globs)
+
+    def git(*args):
+        # check=True: a failed git call (e.g. another process holding
+        # .git/index.lock) must invalidate the key entirely, never
+        # silently collapse a dirty tree onto the clean-HEAD key.
+        return subprocess.run(["git", "-C", base, *args],
+                              capture_output=True, text=True,
+                              timeout=10, check=True).stdout
+
+    try:
+        head = git("rev-parse", "--short", "HEAD").strip()
+        if not head:
+            return None
+        state = git("diff", "HEAD", "--", *code_globs)
+        for rel in git("ls-files", "--others",
+                       "--exclude-standard").splitlines():
+            if not rel.endswith(code_exts):
+                continue
+            try:
+                st = os.stat(os.path.join(base, rel))
+                state += f"\n{rel} {st.st_size} {st.st_mtime_ns}"
+            except OSError:
+                state += f"\n{rel} gone"
+        if state:
+            head += "-" + hashlib.sha1(state.encode()).hexdigest()[:8]
+        return head
+    except Exception:  # noqa: BLE001 — any git failure means "no key"
+        return None
+
+
+def _default_records_path():
+    """A stable, code-version-keyed records path.
+
+    Keyed by :func:`_code_version_key` so independent bench runs of the
+    SAME code share stage records: a measurement window captured by a
+    monitoring run earlier in the round resumes — rather than re-pays or,
+    worse, loses — when the final scoring run executes after the tunnel
+    has died again. Different code gets a fresh file, so stale numbers can
+    never attach to changed kernels. Records live in a repo-local
+    ``.bench/`` directory (gitignored), NOT world-writable /tmp, so no
+    other user can pre-seed or lock out the records. Falls back to a
+    private mkstemp file when git is unavailable.
+    """
+    key = _code_version_key()
+    if key:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench")
+        try:
+            os.makedirs(d, mode=0o700, exist_ok=True)
+            # Prune RECORDS of old code states (every edit mints a new
+            # key; without this the directory grows without bound). Never
+            # touch .lock files — another live run may hold a flock on an
+            # old-mtime lock inode, and unlinking it would let two runs
+            # acquire "the" lock on different inodes — and never touch
+            # the current key's own records.
+            mine = f"records_{key}_{SIZE}.jsonl"
+            cutoff = time.time() - 3 * 86400
+            for name in os.listdir(d):
+                if not name.endswith(".jsonl") or name == mine:
+                    continue
+                fp = os.path.join(d, name)
+                try:
+                    if os.path.getmtime(fp) < cutoff:
+                        os.unlink(fp)
+                except OSError:
+                    pass
+            return os.path.join(d, mine)
+        except OSError:
+            pass
+    fd, path = tempfile.mkstemp(prefix="ft_sgemm_bench_", suffix=".jsonl")
+    os.close(fd)
+    return path
+
+
+def _acquire_run_lock():
+    """One live bench per records file.
+
+    Concurrent runs of the same code (e.g. a monitoring run overlapping
+    the scoring run) would both contend for the TPU and interleave record
+    appends; an exclusive flock makes the later run wait for the earlier
+    one (whose results it then inherits via resume). If the lock cannot
+    be had within a bounded wait, fall back to a private mkstemp records
+    file — isolated, measurement proceeds. The fd is held for process
+    lifetime; the OS releases it on ANY exit path including os._exit."""
+    global _RECORDS_PATH, _LOCK_FH
+    import fcntl
+
+    def isolate():
+        # Private mkstemp file seeded with a snapshot of the shared
+        # records: isolation must not discard stages (possibly the
+        # headline) already landed there — reading needs no lock, and
+        # _read_records skips torn lines. The global swaps LAST: a signal
+        # arriving mid-copy must still see a records path that holds the
+        # headline (the shared one), never a half-seeded empty file.
+        global _RECORDS_PATH
+        shared = _RECORDS_PATH
+        fd, private = tempfile.mkstemp(
+            prefix="ft_sgemm_bench_", suffix=".jsonl")
         os.close(fd)
+        try:
+            with open(shared, "rb") as src, open(private, "wb") as dst:
+                dst.write(src.read())
+        except OSError:
+            pass
+        _RECORDS_PATH = private
+
+    try:
+        _LOCK_FH = open(_RECORDS_PATH + ".lock", "a")
+    except OSError:
+        # Can't create the lock: sharing WITHOUT a lock is the one unsafe
+        # option (interleaved appends + TPU contention) — isolate instead.
+        isolate()
+        return
+    t0 = time.monotonic()
+    limit = min(240.0, _DEADLINE / 3.0)
+    while True:
+        try:
+            fcntl.flock(_LOCK_FH, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return
+        except OSError as e:
+            import errno
+
+            if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                               errno.EACCES):
+                # flock unsupported here (e.g. ENOLCK): waiting is
+                # pointless — isolate immediately instead of burning up
+                # to limit seconds of the measurement budget.
+                limit = -1.0
+            if time.monotonic() - t0 > limit:
+                isolate()
+                return
+            time.sleep(min(5.0, max(0.1, limit / 4.0)))
+
+
+def main():
+    global _CHILD, _RECORDS_PATH, _ATTEMPTS, _PRE_VALUES
+    _RECORDS_PATH = (os.environ.get("FT_SGEMM_BENCH_RECORDS")
+                     or _default_records_path())
+    # Provenance snapshot FIRST: even an emit from the SIGTERM handler
+    # during the lock wait below must know which stages predate this run.
+    _PRE_VALUES = _read_records(_RECORDS_PATH)[0]
+    # Handlers BEFORE the lock wait: a driver SIGTERM during the (up to
+    # ~4 min) lock acquisition must still flush a JSON line assembled from
+    # whatever records are readable (reading needs no lock).
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    _acquire_run_lock()
+    # Re-snapshot: the previous lock holder may have appended stages while
+    # we waited — those are resumed too (the worker never re-measures
+    # them), and isolate() may have swapped the records path.
+    _PRE_VALUES = _read_records(_RECORDS_PATH)[0]
 
     worker_rc = None
     extra = {}
@@ -347,6 +542,8 @@ def main():
             break
         if worker_rc == 0:
             break  # worker finished everything it wanted
+        if worker_rc == 4:
+            break  # deterministic environment failure (wrong backend)
         if "ft_headline" in values and remaining < 2 * _MIN_ATTEMPT:
             break  # headline safe; not enough budget to chase context stages
         if worker_rc == 3:
@@ -381,7 +578,8 @@ def main():
             _kill_child()
             worker_rc = "killed (per-attempt budget exhausted)"
         _CHILD = None
-        if worker_rc not in (0, 3) and time.monotonic() - attempt_t0 < 60:
+        if (worker_rc not in (0, 3, 4)
+                and time.monotonic() - attempt_t0 < 60):
             # A fast failure is a tunnel outage, not a slow measurement:
             # pace relaunches across the remaining budget (outages last
             # seconds to minutes) instead of burning the attempt cap in
@@ -396,6 +594,14 @@ def main():
     # not an error; the individual skipped stages carry their own records.
     if worker_rc not in (0, 3, None):
         extra["worker_rc"] = str(worker_rc)
+    values, _ = _read_records(_RECORDS_PATH)
+    if (_ATTEMPTS == 0 and worker_rc is None
+            and "worker_launch" not in extra
+            and "ft_headline" not in values):
+        extra["no_attempts"] = (
+            f"budget never allowed a worker launch (deadline "
+            f"{_DEADLINE:.0f}s, margin {_MARGIN:.0f}s, min attempt "
+            f"{_MIN_ATTEMPT:.0f}s)")
     return _emit_from_disk(extra)
 
 
@@ -458,6 +664,20 @@ def _worker_stages(rec):
         if os.environ.get("FT_SGEMM_BENCH_FAKE_HANG"):
             time.sleep(100000)
 
+    # TPU-only metric: records measured on a fallback backend (e.g. a
+    # CPU-only dev box) must never resume into — or short-circuit — a real
+    # scoring run of the same code version. (The supervisor's provenance
+    # field survives this: resumed stages are counted by value-comparing
+    # against the pre-run snapshot, so discarded-then-remeasured stages
+    # don't count as resumed.)
+    backend_rec = rec.values.get("backend")
+    if (isinstance(backend_rec, dict)
+            and backend_rec.get("backend") != "tpu"):
+        sys.stderr.write(
+            f"bench worker: discarding records measured on backend "
+            f"{backend_rec.get('backend')!r} (metric is TPU-only)\n")
+        rec.reset()
+
     if _worker_rc(rec) == 0:
         return 0  # resume of a finished run: skip jax init entirely
 
@@ -491,8 +711,30 @@ def _worker_stages(rec):
     # Short in-process retries only: a HANG here is bounded by the
     # supervisor's per-attempt kill, and a fresh worker process is the
     # better retry for tunnel outages.
-    if record_retry("backend", probe) is None:
+    # ALWAYS probe live — never serve the backend stage from cache: a
+    # resume on a different machine must not measure under a stale
+    # recorded identity (TPU-recorded cache on a CPU box would otherwise
+    # merge CPU stage numbers into a TPU-claiming artifact).
+    live = _retry("backend", probe, errors, attempts=3, base=2.0)
+    if live is None:
+        rec.fail("backend", errors.get("backend", "unknown"))
         return _worker_rc(rec)
+    if live.get("backend") != "tpu":
+        rec.fail("backend_guard",
+                 f"backend {live.get('backend')!r} is not TPU; refusing "
+                 f"to record stage measurements for the TPU-only headline "
+                 f"metric")
+        return 4  # deterministic: relaunching cannot change the backend
+    cached = rec.values.get("backend")
+    if isinstance(cached, dict) and cached != live:
+        # Same backend kind but a different device/topology (e.g. the
+        # tunnel reattached another chip): numbers measured there must
+        # not resume here under this device's identity.
+        sys.stderr.write(
+            f"bench worker: discarding records measured on {cached!r}; "
+            f"live device is {live!r}\n")
+        rec.reset()
+    rec.ok("backend", live)
 
     import jax.numpy as jnp
 

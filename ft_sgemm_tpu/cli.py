@@ -246,7 +246,15 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
                                  in_dtype=in_dtype, strategy=strategy)
             sec_per_rep = bench_seconds_per_call(
                 fn, a, b, c, min_device_time=min_device_time)
-            cells[(kernel_id, size)] = 2.0 * size**3 / 1e9 / sec_per_rep
+            gf = 2.0 * size**3 / 1e9 / sec_per_rep
+            cells[(kernel_id, size)] = gf
+            # Flush every measured cell immediately (stderr keeps stdout's
+            # table format intact): a tunnel death mid-sweep must not
+            # discard completed measurements — the exact failure mode of
+            # the round-1/2 bench artifacts.
+            name, _, _ = kernel_for_id(kernel_id)
+            print(f"ft_sgemm: {name} @ {size}: {gf:8.0f} GFLOPS",
+                  file=sys.stderr, flush=True)
 
     print("################## Performance (GFLOPS) ########################",
           file=out)

@@ -183,6 +183,48 @@ def test_preseeded_records_are_emitted_without_worker(tmp_path):
     assert abs(payload["context"]["abft_overhead"]
                - (1 - 30350.0 / 31000.0)) < 1e-3
     assert payload["context"]["errors"]["bf16_abft"] == "boom"
+    # Provenance: pre-existing stage records are declared, not hidden.
+    assert payload["context"]["resumed_stages"] == 3
+
+
+def test_default_records_path_is_code_version_keyed():
+    """Without FT_SGEMM_BENCH_RECORDS, runs of the same code version share
+    a stable, repo-local records path (monitoring runs earlier in a round
+    hand their measurements to the final scoring run), while a different
+    code version can never inherit stale numbers."""
+    import re
+    import shutil
+
+    bench = _load_bench()
+    if not (shutil.which("git") and bench._code_version_key()):
+        import pytest
+
+        pytest.skip("no git checkout: default falls back to private mkstemp")
+    p1 = bench._default_records_path()
+    p2 = bench._default_records_path()
+    assert p1 == p2, "same code version must map to the same path"
+    assert re.search(
+        r"\.bench/records_[0-9a-f]+(-[0-9a-f]{8})?_4096\.jsonl$", p1), p1
+    # Repo-local, not the shared world-writable temp dir (the repo itself
+    # may legitimately live under /tmp, so compare against bench's dir).
+    assert p1.startswith(os.path.join(str(BENCH.parent), ".bench")), p1
+
+
+def test_run_lock_isolates_concurrent_runs(tmp_path):
+    """A second bench against an already-locked records file must fall
+    back to a private file instead of racing the first run's appends."""
+    import fcntl
+
+    bench = _load_bench()
+    records = tmp_path / "records.jsonl"
+    holder = open(str(records) + ".lock", "a")
+    fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    bench._RECORDS_PATH = str(records)
+    bench._DEADLINE = 1.0  # bounds the wait loop to well under a second
+    bench._acquire_run_lock()
+    assert bench._RECORDS_PATH != str(records), (
+        "locked records file must not be shared")
+    holder.close()
 
 
 def test_records_merge_later_lines_win_and_torn_lines_skipped(tmp_path):
