@@ -516,16 +516,18 @@ def _acquire_run_lock():
 
 def main():
     global _CHILD, _RECORDS_PATH, _ATTEMPTS, _PRE_VALUES
-    _RECORDS_PATH = (os.environ.get("FT_SGEMM_BENCH_RECORDS")
-                     or _default_records_path())
-    # Provenance snapshot FIRST: even an emit from the SIGTERM handler
-    # during the lock wait below must know which stages predate this run.
-    _PRE_VALUES = _read_records(_RECORDS_PATH)[0]
-    # Handlers BEFORE the lock wait: a driver SIGTERM during the (up to
-    # ~4 min) lock acquisition must still flush a JSON line assembled from
-    # whatever records are readable (reading needs no lock).
+    # Handlers FIRST — before the git-keyed path computation (up to ~30s
+    # of git subprocesses) and the lock wait (up to ~4 min): a driver
+    # SIGTERM at ANY point must flush a JSON line assembled from whatever
+    # records are readable (reading needs no lock; a None records path
+    # emits an empty-context line).
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    _RECORDS_PATH = (os.environ.get("FT_SGEMM_BENCH_RECORDS")
+                     or _default_records_path())
+    # Provenance snapshot before the lock wait: even an emit from the
+    # SIGTERM handler during the wait must know which stages predate us.
+    _PRE_VALUES = _read_records(_RECORDS_PATH)[0]
     _acquire_run_lock()
     # Re-snapshot: the previous lock holder may have appended stages while
     # we waited — those are resumed too (the worker never re-measures
@@ -725,6 +727,13 @@ def _worker_stages(rec):
                  f"to record stage measurements for the TPU-only headline "
                  f"metric")
         return 4  # deterministic: relaunching cannot change the backend
+    # A live TPU probe supersedes one-shot diagnostics from earlier runs
+    # that shared this records file (e.g. a CPU monitoring box's
+    # backend_guard): an ok tombstone clears the stale error so it cannot
+    # pollute this run's final artifact.
+    for stale in ("backend_guard", "worker_crash"):
+        if stale in rec.errors:
+            rec.ok(stale, "cleared: superseded by a successful TPU probe")
     cached = rec.values.get("backend")
     if isinstance(cached, dict) and cached != live:
         # Same backend kind but a different device/topology (e.g. the

@@ -134,3 +134,35 @@ def test_estimate_noise_floor_bounds_measurement():
 
     with _pytest.raises(ValueError, match="beta"):
         estimate_noise_floor(a, b)
+
+
+def test_estimate_noise_floor_is_calibrated_not_folklore():
+    """The closed-form bound must DOMINATE the measured floor (safety) but
+    stay within ~20x of it for random-sign data (usefulness): the round-2
+    T^1.5 formula overshot by 4-6 orders of magnitude, making the
+    estimator useless for calibration. Also checks the biased-data regime
+    (same-sign inputs, where cancellation-based scaling would undershoot)
+    and the scaling exponent (floors grow ~linearly in size; a T^1.5 model
+    would grow the ratio by ~size^2 per doubling)."""
+    from ft_sgemm_tpu.analysis import estimate_noise_floor
+
+    rng = np.random.default_rng(21)
+    ratios = []
+    for size in (256, 512):
+        a, b, c = (generate_random_matrix(size, size, rng=rng)
+                   for _ in range(3))
+        est = estimate_noise_floor(a, b, c)
+        meas = measure_noise_floor(a, b, c)
+        assert meas <= est, (size, meas, est)
+        ratios.append(est / meas)
+        assert est / meas < 20.0, (size, est / meas)
+    # Scaling sanity: the bound/measured ratio must not explode with size
+    # (T^1.5 vs the true ~sqrt(T) would multiply it ~16x per doubling).
+    assert ratios[1] / ratios[0] < 4.0, ratios
+
+    # Biased (same-sign) inputs: the cancellation model alone would
+    # undershoot; the bias term must keep the bound dominant.
+    ab = np.abs(rng.standard_normal((256, 256))).astype(np.float32)
+    bb = np.abs(rng.standard_normal((256, 256))).astype(np.float32)
+    cb = np.abs(rng.standard_normal((256, 256))).astype(np.float32)
+    assert measure_noise_floor(ab, bb, cb) <= estimate_noise_floor(ab, bb, cb)
