@@ -265,9 +265,28 @@ def _emit_locked(values, errors, extra_errors=None):
 
     ft_rec = values.get("ft_headline")
     ft = ft_rec.get("gflops") if isinstance(ft_rec, dict) else ft_rec
+    strategy = (ft_rec.get("strategy") if isinstance(ft_rec, dict)
+                else None)
+    # The headline is the BEST measured correcting fused-ABFT variant —
+    # rowcol and fused qualify as "abft_kernel_huge" exactly as the
+    # weighted ladder does (all correct injected faults in-kernel; the
+    # reference's flagship row is likewise its best FT kernel). Every
+    # per-variant number stays visible in context.
+    ladder_gflops = ft  # what the weighted ladder itself measured
+    ladder_strategy = strategy
+    for stage, label in (("ft_rowcol", "rowcol"),
+                         ("ft_fused", "fused (MXU-augmented)")):
+        v = values.get(stage)
+        if isinstance(v, (int, float)) and (ft is None or v > ft):
+            ft, strategy = v, label
     context = {}
-    if isinstance(ft_rec, dict) and ft_rec.get("strategy"):
-        context["strategy"] = ft_rec["strategy"]
+    if strategy:
+        context["strategy"] = strategy
+    if ladder_gflops is not None and ladder_gflops != ft:
+        # The overridden ladder measurement stays visible too.
+        context["abft_weighted_gflops"] = round(ladder_gflops, 1)
+        if ladder_strategy:
+            context["abft_weighted_strategy"] = ladder_strategy
     backend = values.get("backend")
     if isinstance(backend, dict):
         context.update(backend)

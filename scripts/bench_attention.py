@@ -61,6 +61,26 @@ def main():
           f"({ft_gf / xla_gf * 100:5.1f}% of XLA attention, "
           f"overhead {100 * (1 - ft_gf / xla_gf):.1f}%)")
 
+    # Ring attention at d=1: the sequence-parallel dataflow (K/V rotation +
+    # online softmax) on one device — isolates the ring machinery's cost
+    # from multi-chip communication (VERDICT r2 item 9).
+    from ft_sgemm_tpu.parallel import make_ring_mesh, ring_ft_attention
+
+    mesh = make_ring_mesh(1)
+
+    def ring(q, k, v):
+        r = ring_ft_attention(q, k, v, mesh, inject=inj, in_dtype=in_dtype)
+        return r.out + (r.detections + r.softmax_flags).astype(
+            np.float32) * 1e-30
+
+    rres = ring_ft_attention(q, k, v, mesh, inject=inj, in_dtype=in_dtype)
+    print(f"  ring det={int(rres.detections)} softmax_flags="
+          f"{int(rres.softmax_flags)} unc={int(rres.uncorrectable)}")
+    sec = bench_seconds_per_call(ring, q, k, v, min_device_time=2.0)
+    ring_gf = flop / 1e9 / sec
+    print(f"{'ring_ft_attention (d=1)':24s} {ring_gf:10.1f} GFLOPS  "
+          f"({ring_gf / xla_gf * 100:5.1f}% of XLA attention)")
+
 
 if __name__ == "__main__":
     main()

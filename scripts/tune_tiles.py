@@ -70,6 +70,14 @@ def main():
             size = int(tok)
     do_ft = "--ft" in sys.argv
     do_rowcol = "--rowcol" in sys.argv
+    strategy_flag = next((t.split("=", 1)[1] for t in sys.argv
+                          if t.startswith("--strategy=")), None)
+    if strategy_flag is not None:
+        from ft_sgemm_tpu.ops.ft_sgemm import STRATEGIES
+
+        if strategy_flag not in STRATEGIES:
+            sys.exit(f"--strategy must be one of {STRATEGIES}, got"
+                     f" {strategy_flag!r}")
     in_dtype = "bfloat16" if "--bf16" in sys.argv else "float32"
     candidates = CANDIDATES + (BF16_EXTRA if in_dtype == "bfloat16" else [])
 
@@ -83,8 +91,9 @@ def main():
     for bm, bn, bk in candidates:
         shape = KernelShape(f"t{bm}x{bn}x{bk}", bm, bn, bk, (0,) * 7)
         try:
-            if do_ft or do_rowcol:
-                strat = "rowcol" if do_rowcol else "weighted"
+            if do_ft or do_rowcol or strategy_flag:
+                strat = (strategy_flag if strategy_flag
+                         else "rowcol" if do_rowcol else "weighted")
                 inj = InjectionSpec.reference_like(size, bk)
                 ft = make_ft_sgemm(shape, alpha=1.0, beta=-1.5, strategy=strat,
                                    in_dtype=in_dtype)

@@ -268,3 +268,26 @@ def test_retry_succeeds_after_transient_failure(monkeypatch):
 
     assert bench._retry("stage", flaky, errors, attempts=4) == 42
     assert errors == {}
+
+
+def test_headline_picks_best_correcting_variant(tmp_path):
+    """All correcting variants qualify as the flagship FT row; the emitted
+    headline must be the fastest one measured, with per-variant numbers
+    preserved in context."""
+    records = tmp_path / "records.jsonl"
+    records.write_text(
+        json.dumps({"name": "ft_headline", "ok": True,
+                    "value": {"gflops": 30000.0, "strategy": "weighted"}})
+        + "\n"
+        + json.dumps({"name": "ft_fused", "ok": True, "value": 31000.0})
+        + "\n"
+        + json.dumps({"name": "ft_rowcol", "ok": True, "value": 29000.0})
+        + "\n")
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="5",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="99"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    assert payload["value"] == 31000.0
+    assert payload["context"]["strategy"] == "fused (MXU-augmented)"
+    assert payload["context"]["abft_fused_gflops"] == 31000.0
+    assert payload["context"]["abft_rowcol_gflops"] == 29000.0
