@@ -62,8 +62,12 @@ def measure_noise_floor(a, b, c, *, alpha: float = 1.0, beta: float = -1.5,
 # inputs; implied values 10-14, stable across the grid). 32 is ~2.3x that
 # worst case; hardware validation happens live in
 # scripts/detection_study.py, which prints bound/measured each run.
-_NOISE_C_RAND = 32.0
-_NOISE_C_BIAS = 4.0
+# Defined in ops.common (single source shared with the traced estimator
+# behind make_ft_sgemm(threshold="auto")).
+from ft_sgemm_tpu.ops.common import (  # noqa: E402  (placed for context)
+    NOISE_C_BIAS as _NOISE_C_BIAS,
+    NOISE_C_RAND as _NOISE_C_RAND,
+)
 
 
 def estimate_noise_floor(a, b, c=None, *, alpha: float = 1.0,
@@ -107,30 +111,17 @@ def estimate_noise_floor(a, b, c=None, *, alpha: float = 1.0,
     reference's quantized +-{0..0.9} inputs at 4096 this lands orders of
     magnitude under the 9500 operating threshold, matching measurement.
     """
-    a = np.asarray(a)
-    b = np.asarray(b)
-    (m, k), (n, _) = a.shape, b.shape
-    tmax = float(max(m, n))
-    eps = float(np.finfo(np.float32).eps)
+    # ONE formula: this delegates to the traced estimator that
+    # make_ft_sgemm(threshold="auto") evaluates in-kernel-wrapper, so a
+    # model recalibration can never drift between the documented bound
+    # and the thresholds actually applied.
+    import jax.numpy as jnp
 
-    def rms(x):
-        return float(np.sqrt(np.mean(np.square(np.asarray(x, np.float64)))))
+    from ft_sgemm_tpu.ops.common import estimate_noise_floor_jnp
 
-    def term(t, sigma, mu):
-        return eps * (_NOISE_C_RAND * np.sqrt(t) * sigma
-                      + _NOISE_C_BIAS * np.log2(max(t, 2.0)) * t * abs(mu))
-
-    t_ab = float(k) * tmax
-    noise = abs(alpha) * term(t_ab, rms(a) * rms(b),
-                              float(np.mean(a)) * float(np.mean(b)))
-    if c is not None and beta != 0.0:
-        cc = np.asarray(c)
-        noise += abs(beta) * term(tmax, rms(cc), float(np.mean(cc)))
-    elif beta != 0.0:
-        raise ValueError(
-            "estimate_noise_floor: pass c (or beta=0) — the beta*C term"
-            " contributes residual noise the bound must include")
-    return float(noise)
+    return float(estimate_noise_floor_jnp(
+        jnp.asarray(a), jnp.asarray(b),
+        None if c is None else jnp.asarray(c), float(alpha), float(beta)))
 
 
 @dataclasses.dataclass(frozen=True)

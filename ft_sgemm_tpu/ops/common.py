@@ -4,8 +4,54 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
+
+# Calibrated constants of the clean-residual noise model — single source
+# for the numpy estimator (analysis.estimate_noise_floor, where the
+# calibration story is documented) and the traced one below.
+NOISE_C_RAND = 32.0
+NOISE_C_BIAS = 4.0
+
+
+def estimate_noise_floor_jnp(a, b, c, alpha: float, beta: float):
+    """Traced clean checksum-residual bound (see
+    ``analysis.estimate_noise_floor`` for the model and calibration).
+
+    jnp throughout, so it composes under ``jit`` — this is what
+    ``make_ft_sgemm(threshold="auto")`` evaluates per call (input moments
+    are O(n^2) reductions, fused by XLA, negligible next to the GEMM).
+    Shapes/log/sqrt factors are static; only the moments are traced.
+    """
+    (m, k), n = a.shape, b.shape[0]
+    tmax = float(max(m, n))
+    eps = float(np.finfo(np.float32).eps)
+
+    def rms(x):
+        return jnp.sqrt(jnp.mean(jnp.square(x.astype(jnp.float32))))
+
+    def term(t, sigma, mu):
+        return eps * (NOISE_C_RAND * float(np.sqrt(t)) * sigma
+                      + NOISE_C_BIAS * float(np.log2(max(t, 2.0))) * t
+                      * jnp.abs(mu))
+
+    t_ab = float(k) * tmax
+    noise = abs(alpha) * term(
+        t_ab, rms(a) * rms(b),
+        jnp.mean(a.astype(jnp.float32)) * jnp.mean(b.astype(jnp.float32)))
+    if c is not None and beta != 0.0:
+        cf = c.astype(jnp.float32)
+        noise += abs(beta) * term(tmax, rms(cf), jnp.mean(cf))
+    elif beta != 0.0:
+        # Mirror the numpy twin's contract exactly (see
+        # analysis.estimate_noise_floor): a silent undershoot here would
+        # put auto thresholds below the real floor when |C| dominates.
+        raise ValueError(
+            "estimate_noise_floor_jnp: pass c (or beta=0) — the beta*C"
+            " term contributes residual noise the bound must include")
+    return noise
 
 
 def should_interpret(interpret: Optional[bool]) -> bool:

@@ -166,3 +166,29 @@ def test_estimate_noise_floor_is_calibrated_not_folklore():
     bb = np.abs(rng.standard_normal((256, 256))).astype(np.float32)
     cb = np.abs(rng.standard_normal((256, 256))).astype(np.float32)
     assert measure_noise_floor(ab, bb, cb) <= estimate_noise_floor(ab, bb, cb)
+
+
+def test_traced_estimator_matches_numpy_estimator():
+    """The jnp estimator behind make_ft_sgemm(threshold='auto') and the
+    numpy one documented/calibrated in this module must be the same model:
+    a recalibration edit to one that misses the other would silently move
+    auto thresholds orders of magnitude off the validated bound."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from ft_sgemm_tpu.analysis import estimate_noise_floor
+    from ft_sgemm_tpu.ops.common import estimate_noise_floor_jnp
+
+    rng = np.random.default_rng(22)
+    a, b, c = (generate_random_matrix(320, 256, rng=rng) for _ in range(3))
+    a = a[:, :256]
+    v_np = estimate_noise_floor(a, b[:192], c[:, :192],
+                                alpha=2.0, beta=-0.5)
+    v_jnp = float(estimate_noise_floor_jnp(
+        jnp.asarray(a), jnp.asarray(b[:192]), jnp.asarray(c[:, :192]),
+        2.0, -0.5))
+    assert abs(v_np - v_jnp) / v_np < 1e-3, (v_np, v_jnp)
+    # Identical contracts: both refuse beta != 0 without c.
+    with _pytest.raises(ValueError, match="beta"):
+        estimate_noise_floor_jnp(jnp.asarray(a), jnp.asarray(b), None,
+                                 1.0, -1.5)

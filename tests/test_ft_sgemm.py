@@ -615,9 +615,10 @@ def test_moment_correction_never_silent_property():
         acc = base.copy()
         for r, c_, m_ in zip(rows, cols, mags):
             acc[r, c_] += np.float32(m_)
+        thr = REFERENCE_THRESHOLD
         got, n_hit, n_unc = _moment_detect_correct(
             jnp.asarray(acc), exp_c, exp_cw, exp_cw2,
-            REFERENCE_THRESHOLD, bm, bn)
+            (thr, thr, thr), bm, bn)
         ok = bool(np.allclose(np.asarray(got), base, atol=1.0))
         if ok and int(n_unc) == 0:
             corrected_n += 1
@@ -630,3 +631,95 @@ def test_moment_correction_never_silent_property():
         f"(corrected={corrected_n}, reported={reported})")
     # Sanity: both branches of the contract must actually occur.
     assert corrected_n > 50 and reported > 5, (corrected_n, reported)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive ("auto") thresholds: V-ABFT-style per-call data-dependent
+# detection thresholds, computed from input moments at zero recompile cost.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["rowcol", "weighted", "fused",
+                                      "global"])
+def test_auto_threshold_catches_tiny_faults(strategy):
+    """Faults of magnitude 5 sit five orders of magnitude under the
+    reference's 9500 threshold (designed misses there) but far above the
+    data's actual noise floor — auto mode must detect AND correct them."""
+    a, b, c = _inputs(128, 128, 512, seed=17)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+
+    # Reference threshold: the faults pass silently (the documented blind
+    # spot) and corrupt the output.
+    res_ref = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                            strategy=strategy)(a, b, c, inject=inj)
+    ok_ref, _, _ = verify_matrix(want, np.asarray(res_ref.c), verbose=False)
+    assert not ok_ref and int(res_ref.num_detected) == 0
+
+    res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                        threshold="auto")(a, b, c, inject=inj)
+    if strategy == "global":
+        # Detect-only (its auto threshold carries the sqrt(bn) whole-tile
+        # aggregation scale): every fault must be counted, none corrected.
+        assert int(res.num_detected) == 4
+        assert int(res.num_uncorrectable) == int(res.num_detected)
+        return
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{strategy}: {nbad} tiny faults survived auto threshold"
+    assert int(res.num_detected) == 4  # nk=4, every=1
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_auto_threshold_no_false_positives_clean():
+    """Clean runs under auto thresholds must report zero detections (the
+    margin over the calibrated bound absorbs reduction-order variance)."""
+    for seed in (1, 2, 3):
+        a, b, c = _inputs(256, 128, 512, seed=seed)
+        for strategy in ("rowcol", "weighted", "fused", "global"):
+            res = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                                strategy=strategy,
+                                threshold="auto")(a, b, c)
+            assert int(res.num_detected) == 0, (strategy, seed)
+            assert int(res.num_uncorrectable) == 0, (strategy, seed)
+
+
+def test_auto_threshold_composes_with_jit():
+    import jax
+    import jax.numpy as jnp
+
+    a, b, c = _inputs(128, 128, 256, seed=4)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    ft = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA,
+                       strategy="weighted", threshold="auto")
+    out = jax.jit(lambda a, b, c: ft(a, b, c, inj).c)(
+        jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(out), verbose=False)
+    assert ok, f"{nbad} faults survived under jit"
+
+
+def test_runtime_threshold_reuses_compilation():
+    """Thresholds are runtime scalars: changing the value must not mint a
+    new kernel compilation (the detection study sweeps magnitudes, users
+    sweep thresholds — recompiles would dominate)."""
+    import jax
+
+    a, b, c = _inputs(128, 128, 256, seed=5)
+    ft1 = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA, threshold=9500.0)
+    ft2 = make_ft_sgemm(ADV_TILE, alpha=ALPHA, beta=BETA, threshold=100.0)
+    with jax.log_compiles():
+        import io
+        import logging
+
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        logging.getLogger("jax._src.interpreters.pxla").addHandler(handler)
+        try:
+            ft1(a, b, c)
+            n1 = buf.getvalue().count("Compiling")
+            ft2(a, b, c)
+            n2 = buf.getvalue().count("Compiling")
+        finally:
+            logging.getLogger("jax._src.interpreters.pxla").removeHandler(
+                handler)
+    assert n1 > 0, "log capture broke (JAX logger/message changed?)"
+    assert n2 == n1, "threshold change must not recompile"
