@@ -181,7 +181,7 @@ def detection_rate_sweep(
     shape: KernelShape | str = "huge",
     *,
     strategy: str = "rowcol",
-    threshold: float = REFERENCE_THRESHOLD,
+    threshold: float | str = REFERENCE_THRESHOLD,
     alpha: float = 1.0,
     beta: float = -1.5,
     num_faults: int = 4,

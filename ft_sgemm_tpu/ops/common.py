@@ -14,6 +14,10 @@ import jax.numpy as jnp
 # calibration story is documented) and the traced one below.
 NOISE_C_RAND = 32.0
 NOISE_C_BIAS = 4.0
+# Default safety margin between the noise-floor bound and an adaptive
+# detection threshold (threshold="auto"); single source for the factory
+# default and the detection study's sweep filter.
+DEFAULT_THRESHOLD_MARGIN = 8.0
 
 
 def estimate_noise_floor_jnp(a, b, c, alpha: float, beta: float):
@@ -30,7 +34,12 @@ def estimate_noise_floor_jnp(a, b, c, alpha: float, beta: float):
     eps = float(np.finfo(np.float32).eps)
 
     def rms(x):
-        return jnp.sqrt(jnp.mean(jnp.square(x.astype(jnp.float32))))
+        # Scale-invariant: normalize by max|x| before squaring so inputs
+        # near f32's range cannot overflow the moment to inf (an inf
+        # bound would silently disable auto-threshold detection).
+        xf = x.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-30)
+        return scale * jnp.sqrt(jnp.mean(jnp.square(xf / scale)))
 
     def term(t, sigma, mu):
         return eps * (NOISE_C_RAND * float(np.sqrt(t)) * sigma
@@ -51,7 +60,12 @@ def estimate_noise_floor_jnp(a, b, c, alpha: float, beta: float):
         raise ValueError(
             "estimate_noise_floor_jnp: pass c (or beta=0) — the beta*C"
             " term contributes residual noise the bound must include")
-    return noise
+    # Never return inf: an inf bound would make an auto threshold that
+    # silently disables detection. rms() is scale-safe, but the PRODUCT of
+    # two near-f32-max rms values can still overflow; such inputs overflow
+    # the GEMM itself, so a saturated (finite, enormous) bound is the
+    # honest answer.
+    return jnp.minimum(noise, jnp.float32(np.finfo(np.float32).max) / 16.0)
 
 
 def should_interpret(interpret: Optional[bool]) -> bool:

@@ -88,6 +88,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
+    DEFAULT_THRESHOLD_MARGIN,
     dtype_suffix as _dtype_suffix,
     estimate_noise_floor_jnp as _estimate_noise_floor_jnp,
     gemm_cost_estimate as _gemm_cost_estimate,
@@ -866,7 +867,7 @@ def make_ft_sgemm(
     beta: float = -1.5,
     strategy: str = "rowcol",
     threshold: float | str = REFERENCE_THRESHOLD,
-    threshold_margin: float = 8.0,
+    threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
     check_every: Optional[int] = None,
     precision: str = "highest",
     in_dtype: str = "float32",
@@ -1012,13 +1013,16 @@ def make_ft_sgemm(
 
 def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              beta=-1.5, inject: Optional[InjectionSpec] = None,
-             strategy: str = "rowcol", threshold: float = REFERENCE_THRESHOLD,
+             strategy: str = "rowcol",
+             threshold: float | str = REFERENCE_THRESHOLD,
+             threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
              check_every: Optional[int] = None, precision: str = "highest",
              in_dtype: str = "float32", multifault: Optional[bool] = None,
              interpret: Optional[bool] = None) -> FtSgemmResult:
     """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
     return make_ft_sgemm(
         shape, alpha=alpha, beta=beta, strategy=strategy, threshold=threshold,
-        check_every=check_every, precision=precision, in_dtype=in_dtype,
+        threshold_margin=threshold_margin, check_every=check_every,
+        precision=precision, in_dtype=in_dtype,
         multifault=multifault, interpret=interpret,
     )(a, b, c, inject)

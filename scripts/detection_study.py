@@ -70,6 +70,25 @@ def main():
                   f" {'yes' if p.output_correct else 'no'} |")
         print()
 
+    # Adaptive thresholds (threshold="auto"): the same sweep at magnitudes
+    # the fixed 9500 threshold is blind to — live proof of the V-ABFT-style
+    # per-call calibration (detection floor ~= margin x data noise floor).
+    from ft_sgemm_tpu.ops.common import DEFAULT_THRESHOLD_MARGIN
+
+    tiny = [m for m in (0.01, 0.1, 1.0, 10.0, 100.0)
+            if m > 2.0 * DEFAULT_THRESHOLD_MARGIN * est]  # detectable ones
+    print('### strategy=weighted, threshold="auto" (fixed 9500 detects none'
+          ' of these)\n')
+    print("| magnitude | injected | detected | rate | output correct |")
+    print("|---|---|---|---|---|")
+    pts = detection_rate_sweep(a, b, c, tiny, "huge", strategy="weighted",
+                               threshold="auto")
+    for p in pts:
+        print(f"| {p.magnitude:g} | {p.expected_faults} | {p.detected} |"
+              f" {p.detection_rate:.2f} |"
+              f" {'yes' if p.output_correct else 'no'} |")
+    print()
+
 
 if __name__ == "__main__":
     main()
