@@ -783,9 +783,13 @@ def _ft_sgemm_padded(
     # weighted-moment re-check, second-moment re-check): per-call —
     # including traced, data-dependent "auto" — thresholds at zero
     # recompile cost.
+    # Each threshold saturates at a finite huge value: downstream moment
+    # scalings (bm, bm^2) could re-overflow an already-saturated bound to
+    # inf, which would silently disable the very check it parameterizes.
+    cap = jnp.float32(np.finfo(np.float32).max / 16.0)
     inj = jnp.concatenate([
         jnp.asarray(inj, jnp.float32),
-        jnp.stack([jnp.asarray(t, jnp.float32)
+        jnp.stack([jnp.minimum(jnp.asarray(t, jnp.float32), cap)
                    for t in threshold])])
 
     # Weighted strategy at its default single-final-check cadence: expected

@@ -30,6 +30,16 @@ from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
 MAGNITUDES = (1e2, 1e3, 5e3, 9e3, 9.4e3, 9.6e3, 1e4, 2e4, 1e5, 1e6)
 
 
+def _print_sweep(pts):
+    print("| magnitude | injected | detected | rate | output correct |")
+    print("|---|---|---|---|---|")
+    for p in pts:
+        print(f"| {p.magnitude:g} | {p.expected_faults} | {p.detected} |"
+              f" {p.detection_rate:.2f} |"
+              f" {'yes' if p.output_correct else 'no'} |")
+    print()
+
+
 def main():
     size = 4096
     strategies = ("rowcol", "weighted", "global")
@@ -60,15 +70,8 @@ def main():
 
     for strategy in strategies:
         print(f"### strategy={strategy}\n")
-        print("| magnitude | injected | detected | rate | output correct |")
-        print("|---|---|---|---|---|")
-        pts = detection_rate_sweep(
-            a, b, c, MAGNITUDES, "huge", strategy=strategy)
-        for p in pts:
-            print(f"| {p.magnitude:g} | {p.expected_faults} | {p.detected} |"
-                  f" {p.detection_rate:.2f} |"
-                  f" {'yes' if p.output_correct else 'no'} |")
-        print()
+        _print_sweep(detection_rate_sweep(
+            a, b, c, MAGNITUDES, "huge", strategy=strategy))
 
     # Adaptive thresholds (threshold="auto"): the same sweep at magnitudes
     # the fixed 9500 threshold is blind to — live proof of the V-ABFT-style
@@ -79,15 +82,8 @@ def main():
             if m > 2.0 * DEFAULT_THRESHOLD_MARGIN * est]  # detectable ones
     print('### strategy=weighted, threshold="auto" (fixed 9500 detects none'
           ' of these)\n')
-    print("| magnitude | injected | detected | rate | output correct |")
-    print("|---|---|---|---|---|")
-    pts = detection_rate_sweep(a, b, c, tiny, "huge", strategy="weighted",
-                               threshold="auto")
-    for p in pts:
-        print(f"| {p.magnitude:g} | {p.expected_faults} | {p.detected} |"
-              f" {p.detection_rate:.2f} |"
-              f" {'yes' if p.output_correct else 'no'} |")
-    print()
+    _print_sweep(detection_rate_sweep(
+        a, b, c, tiny, "huge", strategy="weighted", threshold="auto"))
 
 
 if __name__ == "__main__":

@@ -161,3 +161,25 @@ def test_bf16_tuned_tiles_stay_correct_with_injection():
     ok, nbad, _ = verify_matrix(want, np.asarray(plain(a, b, c)),
                                 verbose=False)
     assert ok, f"{nbad} bad on the bf16 plain tile"
+
+
+def test_auto_threshold_bf16_catches_small_faults():
+    """Adaptive thresholds compose with the bf16 input mode: the noise
+    bound is computed on the bf16-rounded values the MXU consumes, and
+    small faults (magnitude 5, invisible at the fixed 9500) are detected
+    and corrected within the bf16 verify tolerance."""
+    from ft_sgemm_tpu.configs import KernelShape
+
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    a, b, c = _inputs(128, 128, 512, seed=23)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    want = np.asarray(
+        sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+    for strategy in ("weighted", "fused"):
+        res = make_ft_sgemm(tile, alpha=ALPHA, beta=BETA, strategy=strategy,
+                            in_dtype="bfloat16",
+                            threshold="auto")(a, b, c, inject=inj)
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"bf16/{strategy}: {nbad} small faults survived"
+        assert int(res.num_detected) == 4
+        assert int(res.num_uncorrectable) == 0
