@@ -134,7 +134,7 @@ def make_ft_attention(
     scale: Optional[float] = None,
     causal: bool = False,
     strategy: str = "weighted",
-    threshold: float = REFERENCE_THRESHOLD,
+    threshold: float | str = REFERENCE_THRESHOLD,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
     qk_shape: KernelShape = QK_SHAPE,
     pv_shape: KernelShape = PV_SHAPE,
@@ -148,7 +148,9 @@ def make_ft_attention(
     mask (end-aligned positions) AFTER the QK kernel's detect/correct, so
     faults landing at masked positions are still corrected in-kernel before
     the mask zeroes their influence. ``inject`` drives BOTH protected GEMMs
-    (fault counts add). Default strategy is ``weighted``: at its deferred
+    (fault counts add). ``threshold="auto"`` calibrates each GEMM to its
+    own operands per call (P's probability-scale entries get their own
+    floor, far below Q/K's). Default strategy is ``weighted``: at its deferred
     single-check cadence the FT GEMM hot loop is identical to the plain
     kernel's (see ops/ft_sgemm.py), so protected attention costs ~one extra
     detect/correct pass per GEMM.
@@ -182,8 +184,8 @@ def make_ft_attention_diff(
     scale: Optional[float] = None,
     causal: bool = False,
     strategy: str = "weighted",
-    threshold: float = REFERENCE_THRESHOLD,
-    bwd_threshold: Optional[float] = None,
+    threshold: float | str = REFERENCE_THRESHOLD,
+    bwd_threshold: Optional[float | str] = None,
     inject: Optional[InjectionSpec] = None,
     qk_shape: KernelShape = QK_SHAPE,
     pv_shape: KernelShape = PV_SHAPE,

@@ -32,10 +32,13 @@ has no primal output to carry their counts through.
 against an ABSOLUTE threshold. Gradients are usually orders of magnitude
 smaller than forward activations (mean-reduced losses scale cotangents by
 1/batch), so an SDC large relative to gradient scale can still sit below
-the forward-calibrated threshold and pass undetected. ``bwd_threshold``
-exists for exactly this: set it near the backward pass's own noise floor
-(``analysis.estimate_noise_floor`` on (g, b) / (g, a) scales) to keep the
-gradient GEMMs' detection as tight as the forward one's.
+the forward-calibrated threshold and pass undetected. Two remedies:
+``bwd_threshold`` sets the gradient GEMMs' threshold by hand (near the
+backward pass's own noise floor), or — simpler — ``threshold="auto"``,
+under which EVERY GEMM calibrates to its own operands' moments at trace
+time: the backward kernels see cotangent-scale inputs and tighten
+automatically, no hand-tuning (tested in
+``test_auto_threshold_closes_gradient_scale_blind_spot``).
 """
 
 from __future__ import annotations
@@ -77,8 +80,8 @@ def make_ft_matmul(
     shape="huge",
     *,
     strategy: str = "weighted",
-    threshold: float = REFERENCE_THRESHOLD,
-    bwd_threshold: Optional[float] = None,
+    threshold: float | str = REFERENCE_THRESHOLD,
+    bwd_threshold: Optional[float | str] = None,
     inject: Optional[InjectionSpec] = None,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
@@ -91,7 +94,9 @@ def make_ft_matmul(
     (default: ``threshold``) sets the gradient GEMMs' detection threshold
     separately — gradients live at a much smaller scale than activations,
     so a tighter backward threshold catches SDC the forward-calibrated one
-    would miss (module docstring). The returned function is a
+    would miss (module docstring). ``threshold="auto"`` removes the
+    hand-tuning entirely: every GEMM (forward and backward) calibrates to
+    its own operands' moments per call. The returned function is a
     ``jax.custom_vjp``: compose freely with ``jit``/``grad``/``vmap``.
 
     ``with_counts=True`` changes the return value to the

@@ -237,3 +237,43 @@ def test_training_step_converges_under_injection():
         w = step(w)
     l1 = float(loss(w))
     assert l1 < 1e-2 * l0, (l0, l1)
+
+
+def test_auto_threshold_closes_gradient_scale_blind_spot():
+    """The documented blind spot: gradient-scale SDC sits below a
+    forward-calibrated fixed threshold (test_bwd_threshold_catches_small_
+    faults works around it by hand-picking 50.0). threshold='auto'
+    removes the hand-tuning: each GEMM's threshold is computed from ITS
+    OWN operands' moments — the backward GEMMs see cotangent-scale
+    inputs and calibrate to them automatically."""
+    a, b = _ab(256, 128, 256, seed=9)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=100.0)
+    _, loss_ref = _loss_pair(None, a, b)
+    ra, rb = jax.grad(loss_ref, argnums=(0, 1))(a, b)
+
+    mm = make_ft_matmul(TILE, inject=inj, threshold="auto")
+    ga, gb = jax.grad(_loss_pair(mm, a, b)[0], argnums=(0, 1))(a, b)
+    for got, want, name in ((ga, ra, "dA"), (gb, rb, "dB")):
+        ok, nbad, _ = verify_matrix(np.asarray(want), np.asarray(got),
+                                    verbose=False)
+        assert ok, f"{name}: {nbad} gradient-scale faults survived auto"
+
+
+def test_auto_threshold_ft_attention():
+    """Auto thresholds flow through the attention factory: both GEMMs
+    calibrate to their own operand scales (P's entries are probabilities
+    ~1/Lk — far below Q/K scale) and tiny faults are corrected."""
+    from ft_sgemm_tpu import attention_reference, make_ft_attention
+
+    rng = np.random.default_rng(15)
+    l, d = 256, 128
+    q, k, v = (generate_random_matrix(l, d, rng=rng) for _ in range(3))
+    inj = InjectionSpec(enabled=True, every=1, magnitude=1.0)
+    att = make_ft_attention(threshold="auto",
+                            qk_shape=TILE, pv_shape=TILE)
+    res = att(q, k, v, inject=inj)
+    want = np.asarray(attention_reference(q, k, v))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.out), verbose=False)
+    assert ok, f"{nbad} tiny faults survived auto-threshold attention"
+    assert int(res.detections) > 0
+    assert int(res.uncorrectable) == 0
