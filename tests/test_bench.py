@@ -291,3 +291,55 @@ def test_headline_picks_best_correcting_variant(tmp_path):
     assert payload["context"]["strategy"] == "fused (MXU-augmented)"
     assert payload["context"]["abft_fused_gflops"] == 31000.0
     assert payload["context"]["abft_rowcol_gflops"] == 29000.0
+
+
+def test_recorder_reset_writes_fresh_token(tmp_path):
+    bench = _load_bench()
+    path = str(tmp_path / "r.jsonl")
+    rec = bench.Recorder(path)
+    rec.ok("ft_headline", {"gflops": 1.0, "strategy": "weighted"})
+    rec.reset()
+    values, errors = bench._read_records(path)
+    assert "ft_headline" not in values, "reset must discard stages"
+    tok1 = values["_reset_token"]
+    rec.reset()
+    tok2 = bench._read_records(path)[0]["_reset_token"]
+    assert tok1 != tok2, "each reset must mint a fresh token"
+
+
+def test_resumed_stages_suppressed_after_reset(tmp_path):
+    """A fresh reset token proves the pre-run records were discarded:
+    resumed_stages must not be claimed even if remeasured values happen
+    to coincide with the snapshot."""
+    records = tmp_path / "records.jsonl"
+    # Pre-run snapshot: headline + a token from an OLD reset.
+    records.write_text(
+        json.dumps({"name": "_reset_token", "ok": True, "value": "old"})
+        + "\n"
+        + json.dumps({"name": "ft_headline", "ok": True,
+                      "value": {"gflops": 30000.0, "strategy": "w"}})
+        + "\n")
+    bench = _load_bench()
+    bench._PRE_VALUES = bench._read_records(str(records))[0]
+    import io
+    from contextlib import redirect_stdout
+
+    # Same token at emit -> the headline stage genuinely resumed.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench._emit(
+            {"_reset_token": "old",
+             "ft_headline": {"gflops": 30000.0, "strategy": "w"}}, {})
+    payload = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0
+    assert payload["context"]["resumed_stages"] == 1
+
+    bench2 = _load_bench()
+    bench2._PRE_VALUES = bench2._read_records(str(records))[0]
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench2._emit({"_reset_token": "NEW",  # fresh -> mid-run reset
+                      "ft_headline": {"gflops": 30000.0, "strategy": "w"}},
+                     {})
+    payload = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert "resumed_stages" not in payload["context"], payload["context"]
