@@ -1,0 +1,126 @@
+"""Training-framework integration: fault-tolerant flax modules.
+
+The reference is a standalone kernel study; a TPU framework's GEMMs live
+inside model code. This module packages the differentiable FT matmul
+(:mod:`ft_sgemm_tpu.ops.autodiff`) as drop-in `flax.linen`_ layers so a
+model gains ABFT protection by swapping ``nn.Dense`` for
+:class:`FtDense` — forward and both backward GEMMs run through the
+fused-ABFT Pallas kernels, and per-step fault counts are observable
+through flax's variable collections.
+
+.. _flax.linen: https://flax.readthedocs.io
+
+Example::
+
+    import flax.linen as nn
+    from ft_sgemm_tpu.nn import FtDense
+
+    class Model(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(FtDense(512, threshold="auto")(x))
+            return FtDense(10, threshold="auto")(x)
+
+    model = Model()
+    vars_ = model.init(key, x)
+    out, mutated = model.apply(vars_, x, mutable=["ft_counts"])
+    mutated["ft_counts"]  # per-layer detections / uncorrectable
+
+``mutable=["ft_counts"]`` is only needed when you want the counts; a
+plain ``model.apply(vars_, x)`` works and simply drops them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.autodiff import make_ft_matmul
+
+# Counts are written to this flax variable collection (pass
+# ``mutable=["ft_counts"]`` to ``apply`` to receive them).
+COUNTS_COLLECTION = "ft_counts"
+
+
+class FtDense(nn.Module):
+    """``nn.Dense`` with every GEMM ABFT-protected.
+
+    The layer computes ``x @ kernel + bias`` with ``x`` (..., in) flattened
+    to (batch, in): the forward product and both gradient products (dX,
+    dKernel) run through the fused-ABFT kernels of
+    :func:`ft_sgemm_tpu.make_ft_matmul` — SDC in any of them is detected
+    and corrected in-kernel before it can reach activations, gradients,
+    or optimizer state.
+
+    ``threshold`` defaults to ``"auto"``: each GEMM's detection
+    threshold calibrates to its own operands per call, so unit-scale
+    activations and cotangent-scale gradients both get correspondingly
+    tight thresholds (a fixed reference-style 9500 would be inert at
+    training magnitudes — ops/autodiff.py module docstring).
+
+    Detections and the residual-after-correct ``uncorrectable`` count of
+    the forward GEMM are stored in the ``ft_counts`` variable collection
+    under this module's scope — request them with
+    ``apply(..., mutable=["ft_counts"])``; nonzero ``uncorrectable``
+    means the step must be re-run (corruption reported, never silent).
+    """
+
+    features: int
+    use_bias: bool = True
+    strategy: str = "weighted"
+    # "auto" by default: training-scale activations and (smaller still)
+    # cotangents sit far below the reference's fixed 9500 operating
+    # point — a fixed default would leave detection inert at exactly the
+    # scales this layer exists to protect. Per-call calibration costs no
+    # recompiles (runtime SMEM thresholds).
+    threshold: Union[float, str] = "auto"
+    bwd_threshold: Optional[Union[float, str]] = None
+    shape: Union[KernelShape, str] = "huge"
+    # "bfloat16" feeds the GEMMs at the MXU's full-rate input format (f32
+    # accumulation and checksums); the layer's output then follows the
+    # input's dtype so downstream ops keep the model's precision.
+    in_dtype: str = "float32"
+    inject: Optional[InjectionSpec] = None  # self-test mode
+    kernel_init: nn.initializers.Initializer = (
+        nn.initializers.lecun_normal())
+    bias_init: nn.initializers.Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (in_features, self.features), jnp.float32)
+        batch_shape = x.shape[:-1]
+        x2 = x.reshape(-1, in_features)
+        mm = make_ft_matmul(
+            self.shape, strategy=self.strategy, threshold=self.threshold,
+            bwd_threshold=self.bwd_threshold, inject=self.inject,
+            in_dtype=self.in_dtype, with_counts=True)
+        # The FT kernels compute a @ b.T with b stored (out, in): pass the
+        # transposed kernel, matching a linear layer's stored weight.
+        res = mm(x2, jnp.swapaxes(kernel, 0, 1))
+        out = res.out
+        # Counts ride a variable collection via sow: flax's channel for
+        # non-differentiable per-call outputs. Integer values take no
+        # gradients; when the collection is not mutable (plain apply),
+        # sow drops the writes silently. reduce_fn keeps the latest value
+        # instead of sow's default tuple accumulation.
+        latest = lambda prev, new: new  # noqa: E731
+        self.sow(COUNTS_COLLECTION, "detections", res.detections,
+                 reduce_fn=latest)
+        self.sow(COUNTS_COLLECTION, "uncorrectable", res.uncorrectable,
+                 reduce_fn=latest)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,),
+                              jnp.float32)
+            out = out + bias
+        # Drop-in dtype behavior: the FT kernels accumulate and return
+        # f32; hand downstream ops the caller's activation dtype.
+        return out.astype(x.dtype).reshape(*batch_shape, self.features)
+
+
+__all__ = ["COUNTS_COLLECTION", "FtDense"]

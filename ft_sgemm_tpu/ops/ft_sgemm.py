@@ -242,10 +242,38 @@ def _moment_detect_correct(acc, exp_c, exp_cw, exp_cw2, thresholds,
     res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
     res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
     res_cm2 = exp_cw2 - csw2 - jnp.sum(delta * w2, axis=0, keepdims=True)
+    # A correction of magnitude |delta| cannot verify tighter than its own
+    # f32 rounding (~eps * |delta| deposited into the corrected element):
+    # widen each column's re-check threshold by that floor, amplified by
+    # the corrected rows' ACTUAL moment weights (worst-case bm/bm^2 would
+    # over-widen by up to (bm/w[loc])^2 and mask reportable
+    # miscorrections). Tiny auto thresholds would otherwise false-flag
+    # every large corrected fault; negligible at the static 9500 point.
+    pad, pad_w, pad_w2 = _correction_pads(delta, 0, w_col, w2)
     n_unc = jnp.sum(
-        ((jnp.abs(res_c2) > threshold) | (jnp.abs(res_cw2) > thr_m1)
-         | (jnp.abs(res_cm2) > thr_m2)).astype(jnp.int32))
+        ((jnp.abs(res_c2) > threshold + pad)
+         | (jnp.abs(res_cw2) > thr_m1 + pad_w)
+         | (jnp.abs(res_cm2) > thr_m2 + pad_w2))
+        .astype(jnp.int32))
     return acc + delta, jnp.sum(hit.astype(jnp.int32)), n_unc
+
+
+def _correction_pads(delta, axis, *weights):
+    """Correction-rounding floors for the residual-after-correct re-check.
+
+    A correction of magnitude |delta| leaves ~eps * |delta| of f32 remnant
+    in the corrected element, so a re-check along ``axis`` cannot verify
+    tighter than ``8 * eps * sum(|delta| [* weight])``. Returns one pad
+    per requested weighting (the plain pad first, then one per weight) —
+    the ONE implementation shared by every correcting kernel so the floor
+    model can never drift between them.
+    """
+    eps8 = 8.0 * float(np.finfo(np.float32).eps)
+    ad = jnp.abs(delta)
+    pads = [eps8 * jnp.sum(ad, axis=axis, keepdims=True)]
+    for w in weights:
+        pads.append(eps8 * jnp.sum(ad * w, axis=axis, keepdims=True))
+    return pads
 
 
 def _weighted_localize(res_c, res_cw, det_c, bm, bn):
@@ -374,16 +402,23 @@ def _ft_kernel_rowcol(
         # >1-row/>1-col case): REPORT instead of staying silent.
         res_r2 = res_r - jnp.sum(delta, axis=1, keepdims=True)
         res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
-        bad_c = jnp.abs(res_c2) > threshold
-        bad = (jnp.sum((jnp.abs(res_r2) > threshold).astype(jnp.int32))
+        # Correction-rounding floors shared with the moment kernels
+        # (_correction_pads): remnants of large corrected faults must not
+        # false-flag tiny auto thresholds.
+        (pad_r,) = _correction_pads(delta, 1)
+        (pad_c,) = _correction_pads(delta, 0)
+        bad_c = jnp.abs(res_c2) > threshold + pad_c
+        bad = (jnp.sum((jnp.abs(res_r2) > threshold + pad_r)
+                       .astype(jnp.int32))
                + jnp.sum(bad_c.astype(jnp.int32)))
         if multifault:
             # The weighted residual exposes corrections that balanced the
             # plain column sum on the WRONG row (its own noise-scaled
             # threshold: see _moment_detect_correct).
             res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
-            bad += jnp.sum(((jnp.abs(res_cw2) > thr_m1) & ~bad_c)
-                           .astype(jnp.int32))
+            _, pad_w = _correction_pads(delta, 0, w_col)
+            bad += jnp.sum(((jnp.abs(res_cw2) > thr_m1 + pad_w)
+                            & ~bad_c).astype(jnp.int32))
         # LEVEL, not accumulation: residuals are cumulative over K, so a
         # stale broken interval stays visible at every later check —
         # accumulating would re-count it once per check and inflate with

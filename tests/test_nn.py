@@ -1,0 +1,125 @@
+"""FtDense: ABFT-protected flax layer — training-framework integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+flax = pytest.importorskip("flax")
+optax = pytest.importorskip("optax")
+
+from ft_sgemm_tpu import InjectionSpec
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+
+
+def _data(batch=128, d_in=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(generate_random_matrix(batch, d_in, rng=rng))
+
+
+def test_forward_matches_plain_dense():
+    x = _data()
+    layer = FtDense(64, shape=TILE)
+    vars_ = layer.init(jax.random.key(0), x)
+    got = layer.apply(vars_, x)
+    kernel = vars_["params"]["kernel"]
+    bias = vars_["params"]["bias"]
+    want = np.asarray(x @ kernel + bias)
+    ok, nbad, _ = verify_matrix(want, np.asarray(got), verbose=False)
+    assert ok, f"{nbad} elements off vs plain dense"
+
+
+def test_counts_observable_and_faults_corrected():
+    x = _data(seed=3)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    layer = FtDense(128, shape=TILE, inject=inj)
+    vars_ = layer.init(jax.random.key(1), x)
+    out, mutated = layer.apply(vars_, x, mutable=[COUNTS_COLLECTION])
+    counts = mutated[COUNTS_COLLECTION]
+    assert int(counts["detections"]) > 0
+    assert int(counts["uncorrectable"]) == 0
+    clean = layer.apply(
+        {"params": vars_["params"]}, x)  # injection corrected away
+    kernel = vars_["params"]["kernel"]
+    want = np.asarray(x @ kernel + vars_["params"]["bias"])
+    for got in (out, clean):
+        ok, nbad, _ = verify_matrix(want, np.asarray(got), verbose=False)
+        assert ok, f"{nbad} injected faults survived"
+
+
+def test_counts_dropped_without_mutable():
+    x = _data(seed=4)
+    layer = FtDense(64, shape=TILE)
+    vars_ = layer.init(jax.random.key(2), x)
+    out = layer.apply(vars_, x)  # no mutable: counts silently dropped
+    assert out.shape == (128, 64)
+
+
+@pytest.mark.parametrize("threshold", [9500.0, "auto"])
+def test_training_step_under_injection(threshold):
+    """A jitted optax SGD step through two FtDense layers with every-step
+    injection: gradients flow, faults are corrected, loss decreases."""
+    import flax.linen as nn_
+
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+
+    class Model(nn_.Module):
+        @nn_.compact
+        def __call__(self, x):
+            h = jnp.tanh(FtDense(128, shape=TILE, inject=inj,
+                                 threshold=threshold)(x))
+            return FtDense(128, shape=TILE, inject=inj,
+                           threshold=threshold)(h)
+
+    x = _data(seed=5)
+    rngw = np.random.default_rng(6)
+    y = jnp.asarray(generate_random_matrix(128, 128, rng=rngw))
+    model = Model()
+    params = model.init(jax.random.key(3), x)["params"]
+    tx = optax.sgd(0.5)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, mut = model.apply({"params": p}, x,
+                                   mutable=[COUNTS_COLLECTION])
+            return jnp.mean((out - y) ** 2), mut[COUNTS_COLLECTION]
+
+        (loss, counts), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, counts
+
+    params1, opt_state, l0, counts = step(params, opt_state)
+    assert any(int(jax.tree_util.tree_leaves(c)[0]) > 0
+               for c in jax.tree_util.tree_leaves(counts)), (
+        "per-layer fault counts must be observable in the training step")
+    losses = [float(l0)]
+    for _ in range(12):
+        params1, opt_state, loss, _ = step(params1, opt_state)
+        losses.append(float(loss))
+    # Strict monotone decrease is the fault-freedom signature: a fault
+    # surviving into gradients or activations spikes the loss by orders
+    # of magnitude (observed 1e3-1e6 with correction disabled).
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses
+    assert losses[-1] < 0.95 * losses[0], losses
+
+
+def test_bf16_in_dtype_keeps_activation_dtype():
+    x = _data(seed=7).astype(jnp.bfloat16)
+    layer = FtDense(64, shape=TILE, in_dtype="bfloat16")
+    vars_ = layer.init(jax.random.key(4), x)
+    out = layer.apply(vars_, x)
+    assert out.dtype == jnp.bfloat16
+    kernel = vars_["params"]["kernel"]
+    want = np.asarray(x.astype(jnp.float32)
+                      @ np.asarray(kernel)).astype(np.float32)
+    got = np.asarray(out.astype(jnp.float32))
+    # bf16 rounding tolerance: inputs and output each round once.
+    assert np.allclose(got, want + np.asarray(vars_["params"]["bias"]),
+                       rtol=3e-2, atol=3e-2)
