@@ -1,0 +1,107 @@
+"""End-to-end fault-tolerant training example.
+
+Trains a two-layer MLP regression with EVERY GEMM (forward and backward)
+running through the fused-ABFT Pallas kernels while silent-data-corruption
+faults of magnitude 1e4 are injected into every kernel call — and logs the
+per-layer fault activity each step. The loss curve is indistinguishable
+from a fault-free run: that is the framework's end-to-end claim.
+
+The logged ``detected``/``uncorrectable`` columns (and the re-run gate)
+observe the FORWARD GEMMs: a ``jax.custom_vjp`` backward has no primal
+output to carry counts, so the backward GEMMs are corrected in-kernel by
+the same strategy but their counts are not per-step observable
+(ops/autodiff.py module docstring). The loss-curve comparison against
+``--no-inject`` is what demonstrates the backward path end to end.
+
+Runs anywhere (real TPU, or CPU interpret mode for a demo):
+
+    python examples/train_ft.py [--steps N] [--no-inject] [--cpu]
+
+With ``--no-inject`` the same model runs clean (detections must be 0);
+diff the two loss columns to see that injected-and-corrected training
+matches clean training to float noise.
+"""
+
+import argparse
+import os
+import sys
+
+# Runnable from any cwd: anchor the import path on the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--no-inject", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (interpret-mode kernels)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ft_sgemm_tpu import InjectionSpec
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense
+    from ft_sgemm_tpu.utils import generate_random_matrix
+
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    inject = (None if args.no_inject
+              else InjectionSpec(enabled=True, every=1, magnitude=10000.0))
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = jnp.tanh(FtDense(128, shape=tile, inject=inject)(x))
+            return FtDense(128, shape=tile, inject=inject)(h)
+
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(generate_random_matrix(256, 128, rng=rng))
+    w_true = jnp.asarray(generate_random_matrix(128, 128, rng=rng))
+    y = jnp.tanh(x @ w_true.T)
+
+    model = MLP()
+    params = model.init(jax.random.key(0), x)["params"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, mut = model.apply({"params": p}, x,
+                                   mutable=[COUNTS_COLLECTION])
+            counts = mut[COUNTS_COLLECTION]
+            return jnp.mean((out - y) ** 2), counts
+
+        (loss, counts), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, counts
+
+    print(f"backend={jax.default_backend()}  "
+          f"inject={'off' if args.no_inject else 'magnitude 1e4, every call'}")
+    print(f"{'step':>5} {'loss':>12} {'detected':>9} {'uncorrectable':>14}")
+    for i in range(args.steps):
+        params, opt_state, loss, counts = step(params, opt_state)
+        leaves = jax.tree_util.tree_leaves_with_path(counts)
+        det = sum(int(v) for p, v in leaves if "detections" in str(p))
+        unc = sum(int(v) for p, v in leaves if "uncorrectable" in str(p))
+        print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14}")
+        if unc:
+            # Forward-GEMM gate (see module docstring for scope).
+            print("uncorrectable interval reported: re-run the step",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
