@@ -92,6 +92,19 @@ def main():
             print(f"{'ft_sgemm_' + name + ':' + strategy:28s} {gf:9.1f} GFLOPS  "
                   f"{ok_str}  ({gf / xla_gf * 100:5.1f}% of XLA)")
 
+    # Adaptive thresholds: the traced noise-bound estimator + runtime SMEM
+    # threshold scalars must compile and catch tiny (magnitude-5) faults
+    # the fixed 9500 threshold is blind to.
+    inj_tiny = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    fn_auto = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA,
+                            strategy="weighted", threshold="auto")
+    res = fn_auto(a, b, c, inject=inj_tiny)
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    print(f"{'ft_huge:weighted:auto-thr':28s}            "
+          f"verify={'OK' if ok else f'FAIL({nbad})'} "
+          f"det={int(res.num_detected)} unc={int(res.num_uncorrectable)} "
+          f"(magnitude-5 faults)")
+
     # Multi-fault rowcol (forced): the weighted-column-checksum variant
     # whose kernel body differs from the auto-skipped path; must Mosaic-
     # compile and correct a coarse-cadence fault backlog on hardware.

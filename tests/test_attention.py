@@ -182,3 +182,20 @@ def test_ring_attention_corrects_injected_faults():
     assert ok, f"ring: {nbad} corrupted elements survived"
     assert int(res.detections) > 0
     assert int(res.softmax_flags) == 0
+
+
+def test_ring_attention_auto_threshold():
+    """Adaptive thresholds compose with ring attention: each hop's GEMMs
+    calibrate to their shard-local operands; tiny faults corrected."""
+    from ft_sgemm_tpu.configs import KernelShape
+
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    q, k, v = _qkv(512, 512, 128, 128, seed=31)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=1.0)
+    res = ring_ft_attention(q, k, v, make_ring_mesh(4), inject=inj,
+                            threshold="auto", qk_shape=tile, pv_shape=tile)
+    want = np.asarray(attention_reference(q, k, v))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.out), verbose=False)
+    assert ok, f"{nbad} tiny faults survived ring auto thresholds"
+    assert int(res.detections) > 0
+    assert int(res.uncorrectable) == 0
