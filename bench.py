@@ -42,6 +42,27 @@ a repo-local ``.bench/`` file keyed by the code version, so runs of the
 same code share measurements — an earlier monitoring run's stages resume
 into the scoring run; an flock serializes concurrent runs, and different
 code can never inherit stale numbers).
+
+Attempt budgeting (round-4 rework): BENCH_r03 lost its number because two
+fixed 480 s attempts were each killed while the backend was SLOWLY
+initializing (~9 min — the two xla_bridge warnings in the artifact tail
+are 8 minutes apart: progress, not a dead hang).  Two counters now:
+
+* The worker runs a daemon HEARTBEAT thread (started before any jax
+  import) that touches ``<records>.hb`` every few seconds.  The
+  supervisor, once an attempt exceeds its nominal budget, keeps extending
+  it while the heartbeat file stays fresh — a schedulable worker mid-init
+  is better odds than a fresh relaunch that re-pays init against the
+  same tunnel.  Extension is bounded: stale heartbeat (GIL wedged /
+  process dead) kills immediately, and a cap of ``EXTEND_MAX`` (default
+  one extra nominal budget) bounds how long mere liveness can hold an
+  attempt — a DEAD tunnel hang blocks in a GIL-releasing C read and
+  heartbeats forever, and must not forfeit every relaunch a large
+  deadline could still afford.  The hard deadline bounds everything.
+* When the remaining budget cannot fit two nominal attempts, the
+  supervisor sizes ONE attempt to all of it instead of launching two
+  doomed fixed-budget ones (900 s deadline => a single ~870 s attempt,
+  which survives a ~9-minute init with time to measure).
 """
 
 import json
@@ -61,10 +82,34 @@ _WORKER_MAX = float(os.environ.get("FT_SGEMM_BENCH_WORKER_MAX", 480.0))
 _MARGIN = float(os.environ.get("FT_SGEMM_BENCH_MARGIN", 30.0))
 _GRACE = float(os.environ.get("FT_SGEMM_BENCH_GRACE", 5.0))
 _MIN_ATTEMPT = float(os.environ.get("FT_SGEMM_BENCH_MIN_ATTEMPT", 90.0))
+# An attempt past its nominal budget survives while the worker's heartbeat
+# file is younger than this (3+ missed beats = stale).
+_HB_FRESH = float(os.environ.get("FT_SGEMM_BENCH_HB_FRESH", 45.0))
+# ...but extension is CAPPED: a heartbeat proves the worker is
+# schedulable, not that init progresses — a dead tunnel hang in a
+# GIL-releasing C read beats forever. Capping extension at one extra
+# nominal budget keeps the slow-init fix (480 s + 480 s covers a ~9-min
+# init with time to measure) without letting one wedged worker forfeit
+# every relaunch a large deadline could still afford. (Under the default
+# 900 s deadline the single-long-attempt sizing governs instead.)
+_EXTEND_MAX = float(os.environ.get("FT_SGEMM_BENCH_EXTEND_MAX",
+                                   _WORKER_MAX))
 
 
 def _time_left() -> float:
     return _DEADLINE - (time.monotonic() - _T0)
+
+
+def _attempt_budget(remaining):
+    """Nominal per-attempt budget given the remaining run budget.
+
+    When the remainder can't fit two nominal attempts, give ONE attempt
+    everything: two fixed 480 s attempts under a 900 s deadline guarantee
+    neither survives a ~9-minute backend init (the BENCH_r03 failure),
+    while one 870 s attempt does."""
+    if remaining < 2 * _WORKER_MAX:
+        return remaining
+    return _WORKER_MAX
 
 
 # --------------------------------------------------------------------------
@@ -200,6 +245,75 @@ def _worker_output():
         return subprocess.DEVNULL
 
 
+class _HbTracker:
+    """Heartbeat freshness from mtime CHANGE against the monotonic clock.
+
+    Comparing mtime to time.time() directly would let a forward NTP step
+    larger than _HB_FRESH make a live worker look stale — re-creating the
+    mid-init kill this machinery exists to prevent. Instead: fresh iff
+    the mtime advanced within the last _HB_FRESH monotonic seconds."""
+
+    ABSENT, FRESH, STALE = "absent", "fresh", "stale"
+
+    def __init__(self, hb_path):
+        self.hb_path = hb_path
+        self.mtime = None
+        self.seen = None
+        self.start = time.monotonic()
+
+    def status(self):
+        now = time.monotonic()
+        try:
+            mt = os.path.getmtime(self.hb_path)
+        except OSError:
+            if self.mtime is not None:
+                return self.STALE  # was beating, file vanished
+            # Startup grace: a loaded machine can take seconds to exec
+            # the worker before its first beat lands — absence only
+            # counts against the worker after a full freshness window.
+            return (self.FRESH if now - self.start < _HB_FRESH
+                    else self.ABSENT)
+        if mt != self.mtime:
+            self.mtime, self.seen = mt, now
+            return self.FRESH
+        return self.FRESH if now - self.seen < _HB_FRESH else self.STALE
+
+
+def _wait_with_heartbeat(attempt_t0, budget, hb_path):
+    """Wait out one worker attempt; returns its rc or a kill reason.
+
+    Past the nominal budget the attempt EXTENDS while the heartbeat file
+    stays fresh: a slowly-initializing backend is progress enough
+    (heartbeat proves the worker is at least schedulable), and
+    relaunching against the same tunnel only re-pays init. Extension is
+    bounded three ways: stale heartbeat, the _EXTEND_MAX cap (liveness
+    is not progress — a dead tunnel hang heartbeats forever and must not
+    forfeit every relaunch), and the supervisor's hard deadline."""
+    hb = _HbTracker(hb_path)
+    while True:
+        try:
+            return _CHILD.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            pass
+        # Poll every tick (not only past budget): freshness is defined by
+        # mtime CHANGE, so the tracker needs observations to change from.
+        status = hb.status()
+        if _time_left() <= _MARGIN:
+            _kill_child()
+            return "killed (supervisor deadline reached)"
+        over = time.monotonic() - attempt_t0 - budget
+        if over < 0:
+            continue
+        if over >= _EXTEND_MAX:
+            _kill_child()
+            return ("killed (per-attempt budget and heartbeat-extension "
+                    "cap exhausted)")
+        if status == hb.FRESH:
+            continue  # worker alive past budget: extend the attempt
+        _kill_child()
+        return f"killed (per-attempt budget exhausted, heartbeat {status})"
+
+
 def _kill_child():
     global _CHILD
     proc = _CHILD
@@ -330,10 +444,14 @@ def _emit_locked(values, errors, extra_errors=None):
                       and values.get("_reset_token")
                       != _PRE_VALUES.get("_reset_token"))
     # "backend" is always re-probed live (never served from cache), so
-    # it's excluded like the token: only MEASURED stages count.
+    # it's excluded like the token; "backend_guard"/"worker_crash" are
+    # diagnostic tombstones whose cleared-values are identical across runs
+    # and would inflate the count: only MEASURED stages count.
     resumed = 0 if reset_this_run else sum(
         1 for k, v in _PRE_VALUES.items()
-        if k not in ("_reset_token", "backend") and values.get(k) == v)
+        if k not in ("_reset_token", "backend", "backend_guard",
+                     "worker_crash")
+        and values.get(k) == v)
     if resumed:
         context["resumed_stages"] = resumed
     context["errors"] = errors
@@ -577,10 +695,22 @@ def main():
                 break
         if _ATTEMPTS >= 8:
             break
-        budget = min(_WORKER_MAX, remaining)
+        budget = _attempt_budget(remaining)
         attempt_t0 = time.monotonic()
         env = dict(os.environ)
-        env["FT_SGEMM_WORKER_DEADLINE"] = str(budget)
+        # The worker plans its stages against the attempt's TRUE wall
+        # allowance — nominal budget plus the heartbeat extension it can
+        # earn, clipped to the supervisor's hard remaining time — so a
+        # long init neither starves measurement (the allowance already
+        # prices extension in) nor lets the worker schedule past the
+        # deadline kill and lose the stage in flight.
+        env["FT_SGEMM_WORKER_DEADLINE"] = str(
+            min(budget + _EXTEND_MAX, remaining))
+        hb_path = _RECORDS_PATH + ".hb"
+        try:
+            os.unlink(hb_path)  # a stale file must not extend this attempt
+        except OSError:
+            pass
         out = _worker_output()
         try:
             _CHILD = subprocess.Popen(
@@ -593,11 +723,7 @@ def main():
             sys.stderr.write(traceback.format_exc())
             break
         _ATTEMPTS += 1
-        try:
-            worker_rc = _CHILD.wait(timeout=budget + _GRACE)
-        except subprocess.TimeoutExpired:
-            _kill_child()
-            worker_rc = "killed (per-attempt budget exhausted)"
+        worker_rc = _wait_with_heartbeat(attempt_t0, budget, hb_path)
         _CHILD = None
         if (worker_rc not in (0, 3, 4)
                 and time.monotonic() - attempt_t0 < 60):
@@ -650,7 +776,36 @@ def _retry(what, fn, errors, attempts=4, base=3.0):
     return None
 
 
+def _start_heartbeat(records_path):
+    """Touch ``<records>.hb`` every few seconds from a daemon thread.
+
+    Started BEFORE any jax import: the supervisor's budget-extension
+    policy reads this file's mtime. A slowly-initializing backend keeps
+    beating (init releases the GIL between steps — the BENCH_r03 tail
+    shows log lines landing mid-init); a wedged GIL or dead process goes
+    stale and the supervisor's nominal-budget kill fires."""
+    if (os.environ.get("PYTEST_CURRENT_TEST")
+            and os.environ.get("FT_SGEMM_BENCH_FAKE_NO_HB")):
+        return  # test hook: simulate a worker whose beats never start
+    import threading
+
+    hb = records_path + ".hb"
+
+    def beat():
+        while True:
+            try:
+                with open(hb, "w") as f:
+                    f.write(f"{os.getpid()} {time.time():.1f}\n")
+            except OSError:
+                pass
+            time.sleep(10.0)
+
+    threading.Thread(target=beat, daemon=True,
+                     name="bench-heartbeat").start()
+
+
 def worker_main(records_path):
+    _start_heartbeat(records_path)
     rec = Recorder(records_path)
     try:
         return _worker_stages(rec)
@@ -664,6 +819,10 @@ def worker_main(records_path):
 
 
 def _worker_stages(rec):
+    # The supervisor passes the attempt's full wall allowance (nominal
+    # budget + earnable heartbeat extension, clipped to its deadline), so
+    # stage skip thresholds track the REAL kill time — finish gracefully
+    # (rc=3 partial at worst) just before it, never mid-stage.
     deadline = float(os.environ.get("FT_SGEMM_WORKER_DEADLINE", _WORKER_MAX))
     t0 = time.monotonic()
 
@@ -676,6 +835,11 @@ def _worker_stages(rec):
     if os.environ.get("PYTEST_CURRENT_TEST"):
         fake = os.environ.get("FT_SGEMM_BENCH_FAKE_VALUE")
         if fake:
+            slow = os.environ.get("FT_SGEMM_BENCH_FAKE_SLOW")
+            if slow:
+                # Simulated slow backend init: sleeps past the nominal
+                # attempt budget while the heartbeat thread keeps beating.
+                time.sleep(float(slow))
             rec.ok("backend", {"backend": "fake", "device": "fake",
                                "num_devices": 1})
             rec.ok("ft_headline", {"gflops": float(fake),
@@ -730,8 +894,8 @@ def _worker_stages(rec):
                 "num_devices": len(devs)}
 
     # Short in-process retries only: a HANG here is bounded by the
-    # supervisor's per-attempt kill, and a fresh worker process is the
-    # better retry for tunnel outages.
+    # supervisor (nominal budget + the heartbeat-extension cap), and a
+    # fresh worker process is the better retry for tunnel outages.
     # ALWAYS probe live — never serve the backend stage from cache: a
     # resume on a different machine must not measure under a stale
     # recorded identity (TPU-recorded cache on a CPU box would otherwise

@@ -111,17 +111,45 @@ def estimate_noise_floor(a, b, c=None, *, alpha: float = 1.0,
     reference's quantized +-{0..0.9} inputs at 4096 this lands orders of
     magnitude under the 9500 operating threshold, matching measurement.
     """
-    # ONE formula: this delegates to the traced estimator that
-    # make_ft_sgemm(threshold="auto") evaluates in-kernel-wrapper, so a
-    # model recalibration can never drift between the documented bound
-    # and the thresholds actually applied.
-    import jax.numpy as jnp
+    # Pure-numpy evaluation of the SAME formula (constants shared from
+    # ops.common; tests/test_analysis.py pins twin agreement against the
+    # traced estimate_noise_floor_jnp that threshold="auto" evaluates).
+    # Numpy on purpose: this is documented as a cheap estimator needing
+    # no GEMM run, and a jnp delegate would trigger JAX backend init —
+    # on the axon-tunnel machines, the exact hang mode the bench
+    # supervisor exists to avoid (ADVICE.md r3).
+    a = np.asarray(a)
+    b = np.asarray(b)
+    (m, k), n = a.shape, b.shape[0]
+    tmax = float(max(m, n))
+    eps = float(np.finfo(np.float32).eps)
 
-    from ft_sgemm_tpu.ops.common import estimate_noise_floor_jnp
+    def rms(x):
+        # Scale-invariant, mirroring the traced twin: normalize by max|x|
+        # before squaring so near-f32-max inputs can't overflow to inf.
+        xf = np.asarray(x, np.float32)
+        scale = max(float(np.max(np.abs(xf))), 1e-30)
+        return scale * float(np.sqrt(np.mean(np.square(xf / scale))))
 
-    return float(estimate_noise_floor_jnp(
-        jnp.asarray(a), jnp.asarray(b),
-        None if c is None else jnp.asarray(c), float(alpha), float(beta)))
+    def term(t, sigma, mu):
+        return eps * (_NOISE_C_RAND * np.sqrt(t) * sigma
+                      + _NOISE_C_BIAS * np.log2(max(t, 2.0)) * t * abs(mu))
+
+    noise = abs(alpha) * term(
+        float(k) * tmax, rms(a) * rms(b),
+        float(np.mean(a, dtype=np.float64)) *
+        float(np.mean(b, dtype=np.float64)))
+    if c is not None and beta != 0.0:
+        cf = np.asarray(c, np.float32)
+        noise += abs(beta) * term(tmax, rms(cf),
+                                  float(np.mean(cf, dtype=np.float64)))
+    elif beta != 0.0:
+        raise ValueError(
+            "estimate_noise_floor: pass c (or beta=0) — the beta*C term"
+            " contributes residual noise the bound must include")
+    # Saturate instead of inf (inf would silently disable detection when
+    # used as a threshold) — same clamp as the traced twin.
+    return float(min(noise, float(np.finfo(np.float32).max) / 16.0))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,6 +28,14 @@ Example::
 
 ``mutable=["ft_counts"]`` is only needed when you want the counts; a
 plain ``model.apply(vars_, x)`` works and simply drops them.
+
+``ft_counts`` is a PER-APPLY output (like flax's ``intermediates``):
+read it from the mutated-variables return and act on it; never merge it
+back into the variables passed to the next apply — sow reduces onto
+carried-in values, so merging would accumulate counts across steps and
+permanently latch the ``uncorrectable`` re-run gate. Within one apply,
+counts DO sum across invocations of the same module instance (weight
+tying, ``nn.scan``), so no invocation's report can be overwritten.
 """
 
 from __future__ import annotations
@@ -107,13 +115,25 @@ class FtDense(nn.Module):
         # Counts ride a variable collection via sow: flax's channel for
         # non-differentiable per-call outputs. Integer values take no
         # gradients; when the collection is not mutable (plain apply),
-        # sow drops the writes silently. reduce_fn keeps the latest value
-        # instead of sow's default tuple accumulation.
-        latest = lambda prev, new: new  # noqa: E731
-        self.sow(COUNTS_COLLECTION, "detections", res.detections,
-                 reduce_fn=latest)
-        self.sow(COUNTS_COLLECTION, "uncorrectable", res.uncorrectable,
-                 reduce_fn=latest)
+        # sow drops the writes silently. reduce_fn SUMS across calls: a
+        # module instance applied more than once per step (weight tying,
+        # nn.scan) must not let a later clean call's 0 overwrite an
+        # earlier call's nonzero uncorrectable — every invocation's
+        # report survives into the step's re-run gate. sow also reduces
+        # onto any value already present in the PASSED-IN variables, so:
+        # (a) nothing is sown during the init trace (init's returned
+        # variables would otherwise pre-load the first real step), and
+        # (b) ``ft_counts`` is a per-apply output like flax's
+        # ``intermediates`` — read it from ``mutated``, do NOT merge it
+        # back into the variables you pass to the next apply (doing so
+        # would accumulate counts across steps and latch the re-run gate).
+        if not self.is_initializing():
+            accumulate = lambda prev, new: prev + new  # noqa: E731
+            zero = lambda: jnp.int32(0)  # noqa: E731
+            self.sow(COUNTS_COLLECTION, "detections", res.detections,
+                     reduce_fn=accumulate, init_fn=zero)
+            self.sow(COUNTS_COLLECTION, "uncorrectable", res.uncorrectable,
+                     reduce_fn=accumulate, init_fn=zero)
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,),
                               jnp.float32)

@@ -87,6 +87,77 @@ def test_hung_worker_is_killed_and_json_still_prints(tmp_path):
     assert time.monotonic() - t0 < 30
 
 
+def test_heartbeat_extends_attempt_past_nominal_budget(tmp_path):
+    """BENCH_r03 regression: a worker still alive (heartbeating) past its
+    nominal budget — e.g. a slowly-initializing backend — must be extended
+    to completion, not killed. Here the fake worker sleeps 3x its nominal
+    2 s budget before recording the headline."""
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="40",
+                     FT_SGEMM_BENCH_WORKER_MAX="2",
+                     FT_SGEMM_BENCH_EXTEND_MAX="30",
+                     FT_SGEMM_BENCH_FAKE_VALUE="28510.0",
+                     FT_SGEMM_BENCH_FAKE_SLOW="6"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    assert payload["value"] == 28510.0
+    assert payload["context"]["bench_attempts"] == 1, (
+        "the slow worker should survive its first attempt, not be killed "
+        "and relaunched")
+
+
+def test_extension_cap_bounds_a_heartbeating_hang(tmp_path):
+    """Liveness is not progress: a worker that heartbeats but never
+    completes (dead tunnel hang in a GIL-releasing read) is killed once
+    the extension cap is spent, preserving relaunch budget."""
+    t0 = time.monotonic()
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="16",
+                     FT_SGEMM_BENCH_WORKER_MAX="2",
+                     FT_SGEMM_BENCH_EXTEND_MAX="2",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="10",
+                     FT_SGEMM_BENCH_FAKE_HANG="1"))
+    payload = _payload(proc)
+    assert proc.returncode == 1
+    assert payload["value"] is None
+    assert ("heartbeat-extension cap exhausted"
+            in payload["context"]["errors"]["worker_rc"])
+    assert time.monotonic() - t0 < 35
+
+
+def test_stale_heartbeat_is_killed_at_nominal_budget(tmp_path):
+    """Extension requires a LIVE heartbeat: a worker whose beats never
+    start (wedged before the thread could run) is killed at its nominal
+    budget, preserving the round-3 kill guarantee."""
+    t0 = time.monotonic()
+    # MIN_ATTEMPT sized so the run ends after the first kill: the final
+    # worker_rc in the artifact is then the stale-heartbeat kill itself.
+    # HB_FRESH shrinks the startup-grace window below the extension cap
+    # (raised out of the way) so absence, not the cap, triggers the kill.
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="12",
+                     FT_SGEMM_BENCH_WORKER_MAX="2",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="8",
+                     FT_SGEMM_BENCH_HB_FRESH="3",
+                     FT_SGEMM_BENCH_EXTEND_MAX="60",
+                     FT_SGEMM_BENCH_FAKE_HANG="1",
+                     FT_SGEMM_BENCH_FAKE_NO_HB="1"))
+    payload = _payload(proc)
+    assert proc.returncode == 1
+    assert payload["value"] is None
+    assert "heartbeat absent" in payload["context"]["errors"]["worker_rc"]
+    assert time.monotonic() - t0 < 35
+
+
+def test_attempt_budget_sizes_one_long_attempt_when_short(monkeypatch):
+    """With less than two nominal attempts of budget left, all of it goes
+    to a single attempt (two doomed 480 s attempts can't survive a
+    ~9-minute init; one 870 s attempt can)."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_WORKER_MAX", 480.0)
+    assert bench._attempt_budget(870.0) == 870.0
+    assert bench._attempt_budget(959.9) == 959.9
+    assert bench._attempt_budget(960.0) == 480.0
+    assert bench._attempt_budget(2000.0) == 480.0
+
+
 def test_sigterm_flushes_json_before_exit(tmp_path):
     env = _env(tmp_path, FT_SGEMM_BENCH_DEADLINE="120",
                FT_SGEMM_BENCH_WORKER_MAX="100",

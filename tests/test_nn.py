@@ -110,6 +110,45 @@ def test_training_step_under_injection(threshold):
     assert losses[-1] < 0.95 * losses[0], losses
 
 
+def test_counts_accumulate_across_tied_invocations():
+    """ADVICE r3 (medium): a module instance applied more than once per
+    step (weight tying) must SUM its counts across invocations — a later
+    clean call's 0 must not overwrite an earlier call's nonzero report."""
+    import flax.linen as nn_
+
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+
+    class Tied(nn_.Module):
+        @nn_.compact
+        def __call__(self, x):
+            layer = FtDense(128, shape=TILE, inject=inj)
+            return layer(layer(x))  # same instance, two invocations
+
+    x = _data(seed=8)
+    model = Tied()
+    vars_ = model.init(jax.random.key(5), x)
+    # Counts are per-apply: the init trace must not pre-load them.
+    assert COUNTS_COLLECTION not in vars_, list(vars_)
+    _, mutated = model.apply(vars_, x, mutable=[COUNTS_COLLECTION])
+    counts = mutated[COUNTS_COLLECTION]
+    [det] = jax.tree_util.tree_leaves(counts["FtDense_0"]["detections"])
+    # Injection fires in BOTH invocations; a latest-wins reducer would
+    # report only the second call's count.
+    single = FtDense(128, shape=TILE, inject=inj)
+    svars = single.init(jax.random.key(5), x)
+    _, smut = single.apply(svars, x, mutable=[COUNTS_COLLECTION])
+    [sdet] = jax.tree_util.tree_leaves(
+        smut[COUNTS_COLLECTION]["detections"])
+    assert int(det) == 2 * int(sdet) > 0, (det, sdet)
+    # And per-apply means NOT cumulative across applies: a second apply
+    # from the same (params-only) variables reports the same counts.
+    _, mut2 = model.apply({"params": vars_["params"]}, x,
+                          mutable=[COUNTS_COLLECTION])
+    [det2] = jax.tree_util.tree_leaves(
+        mut2[COUNTS_COLLECTION]["FtDense_0"]["detections"])
+    assert int(det2) == int(det), (det2, det)
+
+
 def test_bf16_in_dtype_keeps_activation_dtype():
     x = _data(seed=7).astype(jnp.bfloat16)
     layer = FtDense(64, shape=TILE, in_dtype="bfloat16")
