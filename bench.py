@@ -703,9 +703,14 @@ def main():
         # earn, clipped to the supervisor's hard remaining time — so a
         # long init neither starves measurement (the allowance already
         # prices extension in) nor lets the worker schedule past the
-        # deadline kill and lose the stage in flight.
-        env["FT_SGEMM_WORKER_DEADLINE"] = str(
-            min(budget + _EXTEND_MAX, remaining))
+        # deadline kill and lose the stage in flight. Minus a slack: the
+        # supervisor's kill timers start HERE (pre-exec) while the
+        # worker's clock starts post-exec, so without slack a loaded
+        # machine's exec lag would put the kill BEFORE the worker's own
+        # expiry — mid-stage, losing the record in flight. (Relative
+        # floor keeps tiny test budgets positive.)
+        alw = min(budget + _EXTEND_MAX, remaining)
+        env["FT_SGEMM_WORKER_DEADLINE"] = str(max(alw - 10.0, alw * 0.75))
         hb_path = _RECORDS_PATH + ".hb"
         try:
             os.unlink(hb_path)  # a stale file must not extend this attempt

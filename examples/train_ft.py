@@ -7,11 +7,15 @@ per-layer fault activity each step. The loss curve is indistinguishable
 from a fault-free run: that is the framework's end-to-end claim.
 
 The logged ``detected``/``uncorrectable`` columns (and the re-run gate)
-observe the FORWARD GEMMs: a ``jax.custom_vjp`` backward has no primal
-output to carry counts, so the backward GEMMs are corrected in-kernel by
-the same strategy but their counts are not per-step observable
-(ops/autodiff.py module docstring). The loss-curve comparison against
-``--no-inject`` is what demonstrates the backward path end to end.
+observe the FORWARD GEMMs through the ``ft_counts`` flax collection. The
+BACKWARD GEMMs report through the gradient side-channel: one ``(2,)``
+``bwd_sink`` array threads through every ``FtDense`` and the step takes
+``jax.grad`` with respect to it — the sink's "gradient" is
+``[detections, uncorrectable]`` summed over all backward GEMMs
+(ops/autodiff.py module docstring), logged here as the ``bwd_det`` /
+``bwd_unc`` columns and folded into the same re-run gate. Corruption in
+any of the six GEMMs of this MLP's step is corrected or reported —
+never silent.
 
 Runs anywhere (real TPU, or CPU interpret mode for a demo):
 
@@ -59,9 +63,10 @@ def main():
 
     class MLP(nn.Module):
         @nn.compact
-        def __call__(self, x):
-            h = jnp.tanh(FtDense(128, shape=tile, inject=inject)(x))
-            return FtDense(128, shape=tile, inject=inject)(h)
+        def __call__(self, x, bwd_sink):
+            h = jnp.tanh(FtDense(128, shape=tile, inject=inject)(x,
+                                                                 bwd_sink))
+            return FtDense(128, shape=tile, inject=inject)(h, bwd_sink)
 
     rng = np.random.default_rng(10)
     x = jnp.asarray(generate_random_matrix(256, 128, rng=rng))
@@ -69,34 +74,39 @@ def main():
     y = jnp.tanh(x @ w_true.T)
 
     model = MLP()
-    params = model.init(jax.random.key(0), x)["params"]
+    params = model.init(jax.random.key(0), x, jnp.zeros(2))["params"]
     tx = optax.adam(1e-2)
     opt_state = tx.init(params)
 
     @jax.jit
     def step(params, opt_state):
-        def loss_fn(p):
-            out, mut = model.apply({"params": p}, x,
+        def loss_fn(p, sink):
+            out, mut = model.apply({"params": p}, x, sink,
                                    mutable=[COUNTS_COLLECTION])
             counts = mut[COUNTS_COLLECTION]
             return jnp.mean((out - y) ** 2), counts
 
-        (loss, counts), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+        (loss, counts), (grads, bwd_counts) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, jnp.zeros(2))
         updates, opt_state = tx.update(grads, opt_state)
-        return optax.apply_updates(params, updates), opt_state, loss, counts
+        return (optax.apply_updates(params, updates), opt_state, loss,
+                counts, bwd_counts)
 
     print(f"backend={jax.default_backend()}  "
           f"inject={'off' if args.no_inject else 'magnitude 1e4, every call'}")
-    print(f"{'step':>5} {'loss':>12} {'detected':>9} {'uncorrectable':>14}")
+    print(f"{'step':>5} {'loss':>12} {'detected':>9} {'uncorrectable':>14} "
+          f"{'bwd_det':>8} {'bwd_unc':>8}")
     for i in range(args.steps):
-        params, opt_state, loss, counts = step(params, opt_state)
+        params, opt_state, loss, counts, bwd = step(params, opt_state)
         leaves = jax.tree_util.tree_leaves_with_path(counts)
         det = sum(int(v) for p, v in leaves if "detections" in str(p))
         unc = sum(int(v) for p, v in leaves if "uncorrectable" in str(p))
-        print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14}")
-        if unc:
-            # Forward-GEMM gate (see module docstring for scope).
+        bwd_det, bwd_unc = int(bwd[0]), int(bwd[1])
+        print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14} "
+              f"{bwd_det:>8} {bwd_unc:>8}")
+        if unc or bwd_unc:
+            # Any GEMM of the step (forward or backward) with a violated
+            # correction assumption: the step must not be trusted.
             print("uncorrectable interval reported: re-run the step",
                   file=sys.stderr)
             return 1

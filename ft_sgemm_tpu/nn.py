@@ -93,12 +93,24 @@ class FtDense(nn.Module):
     # input's dtype so downstream ops keep the model's precision.
     in_dtype: str = "float32"
     inject: Optional[InjectionSpec] = None  # self-test mode
+    inject_bwd: Optional[InjectionSpec] = None  # bwd-only self-test mode
     kernel_init: nn.initializers.Initializer = (
         nn.initializers.lecun_normal())
     bias_init: nn.initializers.Initializer = nn.initializers.zeros_init()
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, bwd_sink=None):
+        """Apply the layer; optionally open the backward-counts channel.
+
+        ``bwd_sink`` (any (2,) f32 array, value ignored) opens the
+        gradient side-channel of :func:`ft_sgemm_tpu.make_ft_matmul`:
+        thread one sink through the model into each FtDense and
+        differentiate the loss with respect to it — the sink's "gradient"
+        is ``[detections, uncorrectable]`` summed over every backward
+        GEMM that consumed it, so a violated correction assumption in
+        dX/dKernel is reported to the training loop, never silent
+        (``examples/train_ft.py`` shows the step shape).
+        """
         in_features = x.shape[-1]
         kernel = self.param("kernel", self.kernel_init,
                             (in_features, self.features), jnp.float32)
@@ -107,10 +119,13 @@ class FtDense(nn.Module):
         mm = make_ft_matmul(
             self.shape, strategy=self.strategy, threshold=self.threshold,
             bwd_threshold=self.bwd_threshold, inject=self.inject,
-            in_dtype=self.in_dtype, with_counts=True)
+            inject_bwd=self.inject_bwd, in_dtype=self.in_dtype,
+            with_counts=True, with_bwd_counts=bwd_sink is not None)
         # The FT kernels compute a @ b.T with b stored (out, in): pass the
         # transposed kernel, matching a linear layer's stored weight.
-        res = mm(x2, jnp.swapaxes(kernel, 0, 1))
+        kt = jnp.swapaxes(kernel, 0, 1)
+        res = (mm(x2, kt) if bwd_sink is None
+               else mm(x2, kt, bwd_sink))
         out = res.out
         # Counts ride a variable collection via sow: flax's channel for
         # non-differentiable per-call outputs. Integer values take no

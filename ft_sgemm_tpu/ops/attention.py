@@ -187,11 +187,13 @@ def make_ft_attention_diff(
     threshold: float | str = REFERENCE_THRESHOLD,
     bwd_threshold: Optional[float | str] = None,
     inject: Optional[InjectionSpec] = None,
+    inject_bwd: Optional[InjectionSpec] = None,
     qk_shape: KernelShape = QK_SHAPE,
     pv_shape: KernelShape = PV_SHAPE,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     with_counts: bool = False,
+    with_bwd_counts: bool = False,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
 ):
     """Differentiable FT attention: ABFT on all six GEMMs of fwd + bwd.
@@ -210,25 +212,32 @@ def make_ft_attention_diff(
     forward GEMMs) and ``softmax_flags`` (normalization-stage rowsum
     invariant, same as :func:`make_ft_attention`) leaves take zero
     cotangents — so a training loop can log fault activity every step.
-    The four backward GEMMs are still ABFT-corrected in-kernel (this
-    factory requires a correcting strategy for exactly that reason — a
-    custom_vjp backward has no primal output to carry their counts, so
-    detect-only would be silent there); the elementwise softmax
-    forward/backward stages remain the only unprotected compute.
+
+    ``with_bwd_counts=True`` adds a trailing ``bwd_sink`` argument —
+    ``fn(q, k, v, bwd_sink)``, any (2,) f32 array — whose GRADIENT is
+    ``[detections, uncorrectable]`` summed over the four backward GEMMs:
+    the gradient side-channel of ``ops.autodiff`` (its module docstring
+    has the mechanism), surfacing the backward pass's fault report to
+    the caller of ``jax.grad``. The four backward GEMMs are
+    ABFT-corrected in-kernel either way (this factory requires a
+    correcting strategy); the elementwise softmax forward/backward
+    stages remain the only unprotected compute.
+
     ``bwd_threshold`` tightens the gradient GEMMs' detection threshold —
     cotangents usually live far below activation scale (see
     ops/autodiff.py). ``inject`` is static at build time and drives all
-    six GEMMs.
+    six GEMMs; ``inject_bwd`` overrides the schedule for the four
+    backward GEMMs alone (tests can corrupt exactly the backward pass).
     """
     if strategy == "global":
         raise ValueError(
             "make_ft_attention_diff requires a CORRECTING strategy: "
-            "'global' only detects, and the backward GEMMs' detection "
-            "counts have no output channel under custom_vjp (with_counts "
-            "covers the forward GEMMs only) — backward faults would pass "
-            "silently. Pick 'rowcol' or 'weighted', or use "
-            "make_ft_attention for detect-only runs.")
+            "'global' only detects — a detect-only backward fault would "
+            "be shipped into gradients/optimizer state (with_bwd_counts "
+            "can report it but nothing corrects it). Pick 'rowcol' or "
+            "'weighted', or use make_ft_attention for detect-only runs.")
     inj = inject or InjectionSpec.none()
+    inj_b = inj if inject_bwd is None else inject_bwd
     bthr = threshold if bwd_threshold is None else bwd_threshold
     mk = lambda shp, thr: make_ft_sgemm(  # noqa: E731
         shp, alpha=1.0, beta=0.0, strategy=strategy, threshold=thr,
@@ -246,15 +255,7 @@ def make_ft_attention_diff(
             qk, pv, q, k, v, inj, scale, causal, softmax_threshold)
         return (res if with_counts else res.out), p, sc
 
-    @jax.custom_vjp
-    def att(q, k, v):
-        return _fwd_parts(q, k, v)[0]
-
-    def fwd_fn(q, k, v):
-        o, p, sc = _fwd_parts(q, k, v)
-        return o, (q, k, v, p, sc)
-
-    def bwd_fn(res, g):
+    def _bwd_products(res, g):
         q, k, v, p, sc = res
         if with_counts:
             # Cotangent mirrors the FtAttentionResult pytree; the integer
@@ -268,20 +269,55 @@ def make_ft_attention_diff(
         dk_z = jnp.zeros((lk, k.shape[1]), jnp.float32)
         pt = jnp.swapaxes(p, 0, 1)
         # dV = P^T g: contract over L_q -> kernel(a=P^T (Lk, L), b=g^T).
-        dv = b_long(pt, jnp.swapaxes(g, 0, 1), dv_z, inj).c
+        rv = b_long(pt, jnp.swapaxes(g, 0, 1), dv_z, inj_b)
         # dP = g V^T: contract over dv -> kernel(a=g, b=V (Lk, dv)).
-        dp = b_short(g, v, dp_z, inj).c
+        rp = b_short(g, v, dp_z, inj_b)
         # Softmax backward (elementwise; masked entries have p == 0).
-        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True)) * sc
+        ds = p * (rp.c - jnp.sum(rp.c * p, axis=-1, keepdims=True)) * sc
         # dQ = dS K: contract over L_k -> kernel(a=dS, b=K^T (d, Lk)).
-        dq = b_long(ds, jnp.swapaxes(k, 0, 1), dq_z, inj).c
+        rq = b_long(ds, jnp.swapaxes(k, 0, 1), dq_z, inj_b)
         # dK = dS^T Q: contract over L_q.
-        dk = b_long(jnp.swapaxes(ds, 0, 1), jnp.swapaxes(q, 0, 1),
-                    dk_z, inj).c
-        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+        rk = b_long(jnp.swapaxes(ds, 0, 1), jnp.swapaxes(q, 0, 1),
+                    dk_z, inj_b)
+        grads = (rq.c.astype(q.dtype), rk.c.astype(k.dtype),
+                 rv.c.astype(v.dtype))
+        return grads, (rv, rp, rq, rk)
 
-    att.defvjp(fwd_fn, bwd_fn)
-    return att
+    if not with_bwd_counts:
+        @jax.custom_vjp
+        def att(q, k, v):
+            return _fwd_parts(q, k, v)[0]
+
+        def fwd_fn(q, k, v):
+            o, p, sc = _fwd_parts(q, k, v)
+            return o, (q, k, v, p, sc)
+
+        def bwd_fn(res, g):
+            return _bwd_products(res, g)[0]
+
+        att.defvjp(fwd_fn, bwd_fn)
+        return att
+
+    @jax.custom_vjp
+    def att_sink(q, k, v, bwd_sink):
+        # Sink VALUE unused; only its custom gradient carries information.
+        return _fwd_parts(q, k, v)[0]
+
+    def fwd_s(q, k, v, bwd_sink):
+        o, p, sc = _fwd_parts(q, k, v)
+        return o, (q, k, v, p, sc)
+
+    def bwd_s(res, g):
+        grads, (rv, rp, rq, rk) = _bwd_products(res, g)
+        dsink = jnp.stack([
+            sum(jnp.sum(r.detections) for r in (rv, rp, rq, rk))
+            .astype(jnp.float32),
+            sum(jnp.sum(r.uncorrectable) for r in (rv, rp, rq, rk))
+            .astype(jnp.float32)])
+        return grads + (dsink,)
+
+    att_sink.defvjp(fwd_s, bwd_s)
+    return att_sink
 
 
 def attention_reference(q, k, v, *, scale: Optional[float] = None,
