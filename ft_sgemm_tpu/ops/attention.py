@@ -10,11 +10,26 @@ faults in either accumulator are detected and corrected in-kernel, so they
 never reach the softmax or the output.
 
 The softmax stage itself is elementwise VPU work that linear checksums cannot
-cover. It carries its own *algebraic invariant* instead: every row of
-``P = softmax(S)`` sums to exactly 1, so ``max_i |1 - sum_j P[i, j]|`` is a
-zero-FLOP detection residual for the normalization stage (detect-only — a
-flagged row has no redundancy to reconstruct from; re-run the row). This is
-the attention analog of the reference's checksum residual test.
+cover. Two detect-only checks guard it (a flagged row has no redundancy to
+reconstruct from; re-run the step):
+
+1. **Normalization invariant** — softmax is computed HERE in its decomposed
+   form (``m = rowmax(S)``, ``e = exp(S - m)``, ``l = rowsum(e)``,
+   ``P = e / l``), so every row of ``P`` sums to 1 only if the divide saw
+   the same ``e`` and ``l`` the reductions produced:
+   ``max_i |1 - sum_j P[i, j]|`` flags faults striking ``e`` after the
+   denominator, the denominator itself, or ``P`` post-normalization. (A
+   library ``jax.nn.softmax`` over corrupted logits would renormalize
+   consistently and hide exactly these — the round-3 review's point.)
+2. **Sampled dual recompute** — on a static row sample, ``rowsum(exp(s-m))``
+   is recomputed from the logits behind ``lax.optimization_barrier`` (the
+   barrier stops XLA from CSE-ing the duplicate into the primary chain —
+   without it the "recompute" would be the same registers and the check
+   vacuous) and compared to the saved denominator: flags exp-/max-/sum-stage
+   faults that renormalization would launder, at sampled-row coverage
+   (``softmax_recheck_rows``, default 16 rows; the GEMM checksums remain
+   the deterministic full-coverage layer — this stage's redundancy is
+   necessarily duplication, so coverage is bought row-by-row).
 
 GEMM shape mapping (the framework's kernels compute ``A @ B^T``):
 
@@ -51,8 +66,13 @@ PV_SHAPE = KernelShape("attn_pv", 256, 128, 512, (0,) * 7)
 
 # Clean-run |1 - rowsum(softmax)| is a few f32 ulps (observed < 1e-6 at
 # Lk = 4096); 1e-3 sits ~3 orders above the noise floor and far below any
-# fault that could meaningfully skew a probability row.
+# fault that could meaningfully skew a probability row. The same relative
+# tolerance guards the sampled denominator recompute (reduction-order
+# noise there is also ulp-scale).
 SOFTMAX_RESIDUAL_THRESHOLD = 1e-3
+# Rows per call re-verified by the dual softmax recompute (static stride
+# sample). 0 disables the recompute, leaving only the invariant check.
+SOFTMAX_RECHECK_ROWS = 16
 
 
 class FtAttentionResult(NamedTuple):
@@ -102,13 +122,57 @@ def causal_mask_bias(lq: int, lk: int) -> jax.Array:
     return jnp.where(kpos <= qpos, 0.0, -jnp.inf).astype(jnp.float32)
 
 
+def _checked_softmax(logits, softmax_threshold, recheck_rows,
+                     softmax_fault=None):
+    """Decomposed softmax with its two detect-only checks (module
+    docstring). Returns ``(p, flags)``.
+
+    ``softmax_fault`` is the stage's self-test hook (the analog of the
+    GEMMs' ``InjectionSpec``): ``(stage, row, col, magnitude)`` adds
+    ``magnitude`` at one point of the stage — ``"exp"`` corrupts ``e``
+    BEFORE the denominator (renormalization launders it; only the dual
+    recompute can see it), ``"denom"`` corrupts ``l``, ``"post"``
+    corrupts ``P`` after normalization (both break the invariant)."""
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    if softmax_fault is not None and softmax_fault[0] == "exp":
+        _, r, c, mag = softmax_fault
+        e = e.at[r, c].add(mag)
+    el = jnp.sum(e, axis=-1, keepdims=True)
+    if softmax_fault is not None and softmax_fault[0] == "denom":
+        _, r, _, mag = softmax_fault
+        el = el.at[r, 0].add(mag)
+    p = e / el
+    if softmax_fault is not None and softmax_fault[0] == "post":
+        _, r, c, mag = softmax_fault
+        p = p.at[r, c].add(mag)
+    flags = jnp.sum(
+        (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
+        .astype(jnp.int32))
+    if recheck_rows > 0:
+        lq = logits.shape[0]
+        stride = max(1, lq // min(recheck_rows, lq))
+        # The barrier makes the duplicate chain formally distinct inputs:
+        # XLA cannot CSE it into the primary max/exp/sum nodes, so this
+        # is a genuine second computation of the sampled denominators.
+        sl = jax.lax.optimization_barrier(logits[::stride])
+        m2 = jnp.max(sl, axis=-1, keepdims=True)
+        l2 = jnp.sum(jnp.exp(sl - m2), axis=-1, keepdims=True)
+        rel = jnp.abs(el[::stride] - l2) / jnp.maximum(l2, 1e-30)
+        flags = flags + jnp.sum((rel > softmax_threshold).astype(jnp.int32))
+    return p, flags
+
+
 def _ft_attention_forward(qk, pv, q, k, v, inject, scale, causal,
-                          softmax_threshold):
+                          softmax_threshold,
+                          recheck_rows=SOFTMAX_RECHECK_ROWS,
+                          softmax_fault=None):
     """The ONE protected-attention forward, shared by the plain and
     differentiable factories: QK kernel -> scale -> (causal mask) ->
-    softmax + rowsum invariant -> PV kernel. Returns
-    ``(FtAttentionResult, p, sc)`` — callers that don't need the counts or
-    the probabilities just drop them (XLA prunes unused outputs)."""
+    checked softmax (decomposed; invariant + sampled dual recompute) ->
+    PV kernel. Returns ``(FtAttentionResult, p, sc)`` — callers that
+    don't need the counts or the probabilities just drop them (XLA prunes
+    unused outputs)."""
     if causal:
         # Validate BEFORE launching any kernel work.
         _check_causal_lengths(q.shape[0], k.shape[0])
@@ -118,10 +182,8 @@ def _ft_attention_forward(qk, pv, q, k, v, inject, scale, causal,
     logits = sc * s.c
     if causal:
         logits = logits + causal_mask_bias(q.shape[0], k.shape[0])
-    p = jax.nn.softmax(logits, axis=-1)
-    flags = jnp.sum(
-        (jnp.abs(1.0 - jnp.sum(p, axis=-1)) > softmax_threshold)
-        .astype(jnp.int32))
+    p, flags = _checked_softmax(logits, softmax_threshold, recheck_rows,
+                                softmax_fault)
     zo = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
     o = pv(p, jnp.swapaxes(v, 0, 1), zo, inject)
     det = (jnp.sum(s.detections) + jnp.sum(o.detections)).astype(jnp.int32)
@@ -136,6 +198,8 @@ def make_ft_attention(
     strategy: str = "weighted",
     threshold: float | str = REFERENCE_THRESHOLD,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
+    softmax_recheck_rows: int = SOFTMAX_RECHECK_ROWS,
+    softmax_fault=None,
     qk_shape: KernelShape = QK_SHAPE,
     pv_shape: KernelShape = PV_SHAPE,
     in_dtype: str = "float32",
@@ -154,6 +218,11 @@ def make_ft_attention(
     single-check cadence the FT GEMM hot loop is identical to the plain
     kernel's (see ops/ft_sgemm.py), so protected attention costs ~one extra
     detect/correct pass per GEMM.
+
+    ``softmax_recheck_rows`` sizes the softmax stage's sampled dual
+    recompute (0 disables, leaving only the rowsum invariant);
+    ``softmax_fault`` is that stage's self-test hook — see
+    :func:`_checked_softmax`.
     """
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
                        threshold=threshold, in_dtype=in_dtype,
@@ -164,7 +233,8 @@ def make_ft_attention(
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
         res, _, _ = _ft_attention_forward(
-            qk, pv, q, k, v, inject, scale, causal, softmax_threshold)
+            qk, pv, q, k, v, inject, scale, causal, softmax_threshold,
+            softmax_recheck_rows, softmax_fault)
         return res
 
     fn.strategy = strategy
@@ -195,6 +265,8 @@ def make_ft_attention_diff(
     with_counts: bool = False,
     with_bwd_counts: bool = False,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
+    softmax_recheck_rows: int = SOFTMAX_RECHECK_ROWS,
+    softmax_fault=None,
 ):
     """Differentiable FT attention: ABFT on all six GEMMs of fwd + bwd.
 
@@ -252,7 +324,8 @@ def make_ft_attention_diff(
 
     def _fwd_parts(q, k, v):
         res, p, sc = _ft_attention_forward(
-            qk, pv, q, k, v, inj, scale, causal, softmax_threshold)
+            qk, pv, q, k, v, inj, scale, causal, softmax_threshold,
+            softmax_recheck_rows, softmax_fault)
         return (res if with_counts else res.out), p, sc
 
     def _bwd_products(res, g):
@@ -283,41 +356,22 @@ def make_ft_attention_diff(
                  rv.c.astype(v.dtype))
         return grads, (rv, rp, rq, rk)
 
-    if not with_bwd_counts:
-        @jax.custom_vjp
-        def att(q, k, v):
-            return _fwd_parts(q, k, v)[0]
+    from ft_sgemm_tpu.ops.autodiff import sink_vjp
 
-        def fwd_fn(q, k, v):
-            o, p, sc = _fwd_parts(q, k, v)
-            return o, (q, k, v, p, sc)
-
-        def bwd_fn(res, g):
-            return _bwd_products(res, g)[0]
-
-        att.defvjp(fwd_fn, bwd_fn)
-        return att
-
-    @jax.custom_vjp
-    def att_sink(q, k, v, bwd_sink):
-        # Sink VALUE unused; only its custom gradient carries information.
+    def primal(q, k, v):
         return _fwd_parts(q, k, v)[0]
 
-    def fwd_s(q, k, v, bwd_sink):
+    def fwd_fn(q, k, v):
         o, p, sc = _fwd_parts(q, k, v)
         return o, (q, k, v, p, sc)
 
-    def bwd_s(res, g):
-        grads, (rv, rp, rq, rk) = _bwd_products(res, g)
-        dsink = jnp.stack([
-            sum(jnp.sum(r.detections) for r in (rv, rp, rq, rk))
-            .astype(jnp.float32),
-            sum(jnp.sum(r.uncorrectable) for r in (rv, rp, rq, rk))
-            .astype(jnp.float32)])
-        return grads + (dsink,)
+    def bwd_core(res, g):
+        grads, rs = _bwd_products(res, g)
+        det = sum(jnp.sum(r.detections) for r in rs)
+        unc = sum(jnp.sum(r.uncorrectable) for r in rs)
+        return grads, det, unc
 
-    att_sink.defvjp(fwd_s, bwd_s)
-    return att_sink
+    return sink_vjp(primal, fwd_fn, bwd_core, with_bwd_counts)
 
 
 def attention_reference(q, k, v, *, scale: Optional[float] = None,
@@ -347,6 +401,7 @@ __all__ = [
     "FtAttentionResult",
     "PV_SHAPE",
     "QK_SHAPE",
+    "SOFTMAX_RECHECK_ROWS",
     "SOFTMAX_RESIDUAL_THRESHOLD",
     "attention_reference",
     "causal_mask_bias",

@@ -81,6 +81,52 @@ class FtMatmulResult(NamedTuple):
     uncorrectable: jax.Array  # scalar int32 — unverified fwd intervals
 
 
+def sink_vjp(primal, fwd, bwd_core, with_bwd_counts):
+    """Wrap a differentiable FT op into a ``jax.custom_vjp``, optionally
+    adding the gradient side-channel's trailing ``bwd_sink`` argument —
+    the ONE implementation of the channel, shared by the matmul,
+    attention, and ring-attention factories (module docstring has the
+    mechanism).
+
+    ``primal(*args) -> out``; ``fwd(*args) -> (out, saved)``;
+    ``bwd_core(saved, g) -> (grads_tuple, detections, uncorrectable)``
+    with one grad per primal arg. Without the sink the counts are
+    discarded (XLA prunes the unused reductions); with it they become the
+    sink's (2,) f32 "gradient" ``[detections, uncorrectable]``.
+    """
+    if not with_bwd_counts:
+        @jax.custom_vjp
+        def fn(*args):
+            return primal(*args)
+
+        def fwd_fn(*args):
+            return fwd(*args)
+
+        def bwd_fn(saved, g):
+            return bwd_core(saved, g)[0]
+
+        fn.defvjp(fwd_fn, bwd_fn)
+        return fn
+
+    @jax.custom_vjp
+    def fn_sink(*args):
+        # Trailing arg is the sink; its VALUE never enters the
+        # computation — only its custom gradient carries information.
+        return primal(*args[:-1])
+
+    def fwd_s(*args):
+        return fwd(*args[:-1])
+
+    def bwd_s(saved, g):
+        grads, det, unc = bwd_core(saved, g)
+        dsink = jnp.stack([jnp.asarray(det).astype(jnp.float32),
+                           jnp.asarray(unc).astype(jnp.float32)])
+        return tuple(grads) + (dsink,)
+
+    fn_sink.defvjp(fwd_s, bwd_s)
+    return fn_sink
+
+
 @functools.lru_cache(maxsize=64)
 def _kernels(shape, strategy, threshold, in_dtype, interpret):
     fn = make_ft_sgemm(shape, alpha=1.0, beta=0.0, strategy=strategy,
@@ -169,43 +215,15 @@ def make_ft_matmul(
                       zk_b, inj_b)
         return ra, rb
 
-    if not with_bwd_counts:
-        @jax.custom_vjp
-        def ft_mm(a, b):
-            return _fwd_out(a, b)
-
-        def fwd(a, b):
-            return ft_mm(a, b), (a, b)
-
-        def bwd(res, g):
-            a, b = res
-            ra, rb = _bwd_products(a, b, g)
-            return ra.c.astype(a.dtype), rb.c.astype(b.dtype)
-
-        ft_mm.defvjp(fwd, bwd)
-        return ft_mm
-
-    @jax.custom_vjp
-    def ft_mm_sink(a, b, bwd_sink):
-        # The sink's VALUE never enters the computation; only its
-        # custom-defined gradient carries information (out of the bwd).
-        return _fwd_out(a, b)
-
-    def fwd_s(a, b, bwd_sink):
-        return ft_mm_sink(a, b, bwd_sink), (a, b)
-
-    def bwd_s(res, g):
+    def bwd_core(res, g):
         a, b = res
         ra, rb = _bwd_products(a, b, g)
-        dsink = jnp.stack([
-            (jnp.sum(ra.detections) + jnp.sum(rb.detections))
-            .astype(jnp.float32),
-            (jnp.sum(ra.uncorrectable) + jnp.sum(rb.uncorrectable))
-            .astype(jnp.float32)])
-        return ra.c.astype(a.dtype), rb.c.astype(b.dtype), dsink
+        det = jnp.sum(ra.detections) + jnp.sum(rb.detections)
+        unc = jnp.sum(ra.uncorrectable) + jnp.sum(rb.uncorrectable)
+        return (ra.c.astype(a.dtype), rb.c.astype(b.dtype)), det, unc
 
-    ft_mm_sink.defvjp(fwd_s, bwd_s)
-    return ft_mm_sink
+    return sink_vjp(_fwd_out, lambda a, b: (_fwd_out(a, b), (a, b)),
+                    bwd_core, with_bwd_counts)
 
 
 def ft_matmul(a, b, *args, **kwargs):
@@ -218,4 +236,4 @@ def ft_matmul(a, b, *args, **kwargs):
     return make_ft_matmul(**kwargs)(a, b, *args)
 
 
-__all__ = ["FtMatmulResult", "ft_matmul", "make_ft_matmul"]
+__all__ = ["FtMatmulResult", "ft_matmul", "make_ft_matmul", "sink_vjp"]

@@ -10,7 +10,8 @@ from ft_sgemm_tpu.parallel.ring import (
     ring_ft_sgemm,
     ring_sgemm,
 )
-from ft_sgemm_tpu.parallel.ring_attention import ring_ft_attention
+from ft_sgemm_tpu.parallel.ring_attention import (
+    make_ring_ft_attention_diff, ring_ft_attention)
 from ft_sgemm_tpu.parallel.sharded import (
     make_mesh,
     sharded_ft_sgemm,
@@ -23,6 +24,7 @@ __all__ = [
     "make_multihost_mesh",
     "multihost_ft_sgemm",
     "make_ring_mesh",
+    "make_ring_ft_attention_diff",
     "ring_ft_attention",
     "ring_ft_sgemm",
     "ring_sgemm",

@@ -19,7 +19,8 @@ from ft_sgemm_tpu.ops.attention import (
     QK_SHAPE,
     softmax_rowsum_residual,
 )
-from ft_sgemm_tpu.parallel import make_ring_mesh, ring_ft_attention
+from ft_sgemm_tpu.parallel import (
+    make_ring_ft_attention_diff, make_ring_mesh, ring_ft_attention)
 from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
 
 
@@ -161,6 +162,64 @@ def test_softmax_invariant_flags_corrupted_rows():
     assert float(softmax_rowsum_residual(p_bad)) > 0.4
 
 
+@pytest.mark.parametrize("stage", ["exp", "denom", "post"])
+def test_softmax_stage_faults_flagged(stage):
+    """VERDICT r3 item 5's done criterion: a fault injected into the
+    softmax/exp stage (NOT the GEMMs) is flagged. 'exp' corrupts e before
+    the denominator — renormalization launders it past the rowsum
+    invariant, so only the sampled dual recompute can see it (row 0 is
+    always in the static-stride sample); 'denom' and 'post' break the
+    normalization invariant directly."""
+    q, k, v = _qkv(256, 256, 128, 128, seed=14)
+    att = make_ft_attention(softmax_fault=(stage, 0, 5, 30.0))
+    res = att(q, k, v)
+    assert int(res.softmax_flags) > 0, f"{stage}-stage fault not flagged"
+    assert int(res.detections) == 0, "GEMMs saw no injection"
+    # Clean build on the same inputs: zero flags (no false positives).
+    clean = make_ft_attention()(q, k, v)
+    assert int(clean.softmax_flags) == 0
+    want = np.asarray(attention_reference(q, k, v))
+    np.testing.assert_allclose(np.asarray(clean.out), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_softmax_exp_fault_outside_sample_documents_coverage():
+    """The dual recompute's coverage is SAMPLED: an exp-stage fault on an
+    unsampled row is laundered by renormalization and passes unflagged —
+    the documented residual exposure (GEMM checksums stay full-coverage;
+    softmax redundancy is bought row-by-row). This test pins that the
+    claim in the module docstring is exact, not optimistic."""
+    q, k, v = _qkv(256, 256, 128, 128, seed=15)
+    # 256 rows / 16 recheck rows -> stride 16: row 7 is unsampled.
+    att = make_ft_attention(softmax_fault=("exp", 7, 5, 30.0))
+    res = att(q, k, v)
+    assert int(res.softmax_flags) == 0, (
+        "unsampled exp fault should be invisible (if this fires, coverage "
+        "improved — update the docs, not the check)")
+    # ...and full-coverage mode (one recheck row per row) catches it.
+    att_full = make_ft_attention(softmax_fault=("exp", 7, 5, 30.0),
+                                 softmax_recheck_rows=256)
+    assert int(att_full(q, k, v).softmax_flags) > 0
+
+
+def test_softmax_checks_active_in_diff_path():
+    """The decomposed checked softmax guards the differentiable factory
+    too (same shared forward)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu import make_ft_attention_diff
+
+    q, k, v = _qkv(256, 256, 128, 128, seed=16)
+    att = make_ft_attention_diff(softmax_fault=("denom", 3, 0, 30.0),
+                                 with_counts=True)
+    res = att(q, k, v)
+    assert int(res.softmax_flags) > 0
+    # Gradients still flow (the checks are detect-only side outputs).
+    g = jax.grad(lambda q: jnp.sum(att(q, k, v).out))(jnp.asarray(q))
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_ring_attention_matches_oracle():
     mesh = make_ring_mesh(8)
     q, k, v = _qkv(256, 512, 128, 128, seed=11)  # 32 q-rows, 64 kv per dev
@@ -199,3 +258,128 @@ def test_ring_attention_auto_threshold():
     assert ok, f"{nbad} tiny faults survived ring auto thresholds"
     assert int(res.detections) > 0
     assert int(res.uncorrectable) == 0
+
+
+# ---------------------------------------------------------------------------
+# Differentiable ring attention (VERDICT r3 item 7)
+# ---------------------------------------------------------------------------
+
+def _ring_grad_pair(att, q, k, v, ref_kwargs):
+    """Gradients through the ring path and the plain-XLA oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_ring(q, k, v):
+        out = att(q, k, v)
+        out = out.out if hasattr(out, "out") else out
+        return jnp.sum(jnp.tanh(out))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(attention_reference(q, k, v, **ref_kwargs)))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        *(jnp.asarray(x) for x in (q, k, v)))
+    return g_ring, g_ref
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_diff_grads_match_oracle(causal):
+    """The long-context path can TRAIN: custom-vjp ring attention on an
+    8-device mesh, gradients vs the single-device XLA oracle — clean run,
+    all backward products computed by a second ring pass with dK/dV
+    rotating home."""
+    mesh = make_ring_mesh(8)
+    q, k, v = _qkv(256, 512, 128, 128, seed=21)
+    att = make_ring_ft_attention_diff(mesh, causal=causal)
+    g_ring, g_ref = _ring_grad_pair(att, q, k, v, {"causal": causal})
+    for got, want, name in zip(g_ring, g_ref, ("dQ", "dK", "dV")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"ring {name} (causal={causal})")
+
+
+def test_ring_attention_diff_grads_with_injection():
+    """Injection ON in all forward and backward ring GEMMs: corrected
+    in-kernel, gradients still match the clean oracle."""
+    mesh = make_ring_mesh(4)
+    q, k, v = _qkv(256, 512, 128, 128, seed=22)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    att = make_ring_ft_attention_diff(mesh, inject=inj, inject_bwd=inj,
+                                      with_counts=True)
+    res = att(q, k, v)
+    assert int(res.detections) > 0
+    g_ring, g_ref = _ring_grad_pair(att, q, k, v, {})
+    for got, want, name in zip(g_ring, g_ref, ("dQ", "dK", "dV")):
+        ok, nbad, _ = verify_matrix(np.asarray(want), np.asarray(got),
+                                    verbose=False)
+        assert ok, f"ring {name}: {nbad} corrupted elements survived"
+
+
+def test_ring_attention_diff_bwd_sink():
+    """Backward ring GEMM counts ride the gradient side-channel: rotating
+    injection -> detections reported, psum'd over the ring; clean -> 0."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_ring_mesh(4)
+    q, k, v = _qkv(256, 512, 128, 128, seed=23)
+
+    def sink_grad(att):
+        def loss(q, k, v, sink):
+            return jnp.sum(jnp.tanh(att(q, k, v, sink)))
+
+        return jax.grad(loss, argnums=3)(q, k, v, jnp.zeros(2))
+
+    clean = sink_grad(make_ring_ft_attention_diff(mesh,
+                                                  with_bwd_counts=True))
+    assert float(clean[0]) == 0.0 and float(clean[1]) == 0.0
+
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    rot = sink_grad(make_ring_ft_attention_diff(mesh, inject_bwd=inj,
+                                                with_bwd_counts=True))
+    assert float(rot[0]) > 0
+    assert float(rot[1]) == 0.0
+
+
+def test_ring_attention_diff_bf16_in_dtype_keeps_primal_dtype():
+    """in_dtype='bfloat16' composes with the diff ring path: cotangents
+    come back in the PRIMAL dtype (f32), not in_dtype (residuals keep the
+    caller's arrays, like the single-device factory)."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_ring_mesh(4)
+    q, k, v = _qkv(128, 256, 128, 128, seed=24)
+    att = make_ring_ft_attention_diff(mesh, in_dtype="bfloat16")
+    g = jax.grad(lambda q, k, v: jnp.sum(jnp.tanh(att(q, k, v))),
+                 argnums=(0, 1, 2))(*(jnp.asarray(x) for x in (q, k, v)))
+    for arr, name in zip(g, ("dQ", "dK", "dV")):
+        assert arr.dtype == jnp.float32, (name, arr.dtype)
+        assert np.isfinite(np.asarray(arr)).all(), name
+
+
+def test_ring_diff_recompute_keeps_forward_threshold(monkeypatch):
+    """The backward ring's probability-recompute kernel mirrors the
+    FORWARD QK product (activation-scale operands), so it must be built
+    with `threshold`, not `bwd_threshold` — a cotangent-tight backward
+    threshold there would false-positive on clean activation-scale
+    checksum noise and trip the re-run gate on fault-free runs."""
+    import ft_sgemm_tpu.parallel.ring_attention as ra
+
+    calls = []
+    orig = ra.make_ft_sgemm
+
+    def spy(shape, **kw):
+        calls.append(kw.get("threshold"))
+        return orig(shape, **kw)
+
+    monkeypatch.setattr(ra, "make_ft_sgemm", spy)
+    make_ring_ft_attention_diff(make_ring_mesh(4), threshold=9500.0,
+                                bwd_threshold=1.0)
+    # Factory-time construction order: recompute qk_b, then the gradient
+    # kernels b_long, b_short.
+    assert calls[0] == 9500.0, (
+        "recompute kernel must keep the forward threshold")
+    assert calls[1:] == [1.0, 1.0], (
+        "gradient kernels must take bwd_threshold")
