@@ -501,22 +501,25 @@ def _worker_preexec():
 
 
 def _code_version_key():
-    """Content key of the code under measurement: commit hash, plus a hash
-    of the tracked diff and of untracked files' (path, size, mtime) when
-    the tree is dirty — so distinct code states map to distinct keys (a
-    boolean dirty flag would let two different edits of the same commit
-    share records; ignoring untracked files would let a new module attach
-    stale numbers). mtime+size for untracked content is a cheap proxy —
-    it can over-split keys, never under-split in practice."""
+    """Content key of the code under measurement.
+
+    Only code that can change the measured numbers participates: the bench
+    protocol itself, the package it measures, the native helpers, and the
+    build metadata. Tests, scripts, examples, and docs cannot alter a
+    GFLOPS reading, so editing (or committing) them must not discard
+    banked hardware stages — tunnel windows are too scarce to re-measure
+    after every cosmetic commit. The key is a digest of the tracked blobs
+    under those paths (``git ls-tree``, independent of which commit they
+    came from) plus the dirty tracked diff and untracked code files'
+    (path, size, mtime) — distinct code states map to distinct keys;
+    mtime+size for untracked content is a cheap proxy that can over-split
+    keys, never under-split in practice."""
     import hashlib
 
     base = os.path.dirname(os.path.abspath(__file__))
 
-    # Only CODE can invalidate records: artifact/log/doc files the round
-    # produces or edits (BENCH_*.json, RESULTS.md, CHANGELOG.md, records)
-    # must not silently defeat the resume this key exists to enable.
-    code_globs = ["*.py", "*.cpp", "*.cc", "*.c", "*.h", "*.sh", "*.toml"]
-    code_exts = tuple(g[1:] for g in code_globs)
+    code_paths = ["bench.py", "pyproject.toml", "ft_sgemm_tpu", "csrc"]
+    code_exts = (".py", ".cpp", ".cc", ".c", ".h", ".sh", ".toml")
 
     def git(*args):
         # check=True: a failed git call (e.g. another process holding
@@ -527,12 +530,12 @@ def _code_version_key():
                               timeout=10, check=True).stdout
 
     try:
-        head = git("rev-parse", "--short", "HEAD").strip()
-        if not head:
+        tree = git("ls-tree", "-r", "HEAD", "--", *code_paths)
+        if not tree.strip():
             return None
-        state = git("diff", "HEAD", "--", *code_globs)
-        for rel in git("ls-files", "--others",
-                       "--exclude-standard").splitlines():
+        state = git("diff", "HEAD", "--", *code_paths)
+        for rel in git("ls-files", "--others", "--exclude-standard",
+                       "--", *code_paths).splitlines():
             if not rel.endswith(code_exts):
                 continue
             try:
@@ -540,9 +543,8 @@ def _code_version_key():
                 state += f"\n{rel} {st.st_size} {st.st_mtime_ns}"
             except OSError:
                 state += f"\n{rel} gone"
-        if state:
-            head += "-" + hashlib.sha1(state.encode()).hexdigest()[:8]
-        return head
+        return hashlib.sha1(
+            (tree + "\0" + state).encode()).hexdigest()[:12]
     except Exception:  # noqa: BLE001 — any git failure means "no key"
         return None
 

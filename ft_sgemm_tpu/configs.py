@@ -78,6 +78,14 @@ SHAPES = {
 
 SHAPE_ORDER = ("small", "medium", "large", "tall", "wide", "huge")
 
+# Per-kernel VMEM budget passed to Mosaic. The compiler's default scoped-vmem
+# limit is 16 MiB, and the FT kernels' round-3 additions (runtime-threshold
+# SMEM operand, checksum pads, re-check scratch) sit 0.3-2 MiB past it at the
+# tuned 4096 tiles — a compile-time OOM on hardware that interpret-mode CPU
+# runs can never see. v5e cores have 128 MiB of physical VMEM; 64 MiB clears
+# every shipped tile with room for the tuner to explore larger ones.
+VMEM_LIMIT_BYTES = 64 * 1024 * 1024
+
 # bf16 input mode re-tunes the flagship tile (live-v5e sweep,
 # scripts/tune_tiles.py --bf16 [--ft], M=N=K=4096): halved A/B tile bytes
 # let the plain kernel go K-deep (512x512x2048, ~138 TFLOPS vs ~124 at the

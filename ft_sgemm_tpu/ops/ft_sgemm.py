@@ -85,7 +85,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
+from ft_sgemm_tpu.configs import (
+    SHAPES,
+    VMEM_LIMIT_BYTES,
+    KernelShape,
+    shape_for_dtype,
+)
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
     DEFAULT_THRESHOLD_MARGIN,
@@ -892,6 +897,7 @@ def _ft_sgemm_padded(
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES,
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,

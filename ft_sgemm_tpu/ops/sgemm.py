@@ -37,7 +37,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
+from ft_sgemm_tpu.configs import (
+    SHAPES,
+    VMEM_LIMIT_BYTES,
+    KernelShape,
+    shape_for_dtype,
+)
 from ft_sgemm_tpu.ops.common import (
     dtype_suffix as _dtype_suffix,
     gemm_cost_estimate as _gemm_cost_estimate,
@@ -102,6 +107,7 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES,
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,

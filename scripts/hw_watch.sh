@@ -1,0 +1,107 @@
+#!/bin/bash
+# Hardware watcher: probe the axon TPU tunnel; the moment a window opens,
+# run the full hardware stage list, banking results as it goes. The axon
+# tunnel comes and goes (rounds 2-4 each saw multi-hour outages bracketing
+# ~20-minute windows), so every stage must land the instant one opens —
+# bench.py's code-version-keyed records then hand the numbers to the
+# driver's scoring run even if the tunnel is down again by round end.
+#
+# Usage: nohup bash scripts/hw_watch.sh >> .bench/watch.log 2>&1 &
+# A stage that completes writes a .bench/done_<stage>_<key> marker and is
+# not re-run while the measurement-relevant code (bench.py's
+# _code_version_key) is unchanged. Delete markers to force a re-run.
+
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p .bench
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256)))
+jax.block_until_ready(y)
+assert jax.default_backend() == 'tpu'
+" >/dev/null 2>&1
+}
+
+key() {  # key [stage-script] — per-stage marker key
+  # bench._code_version_key deliberately excludes scripts/ (editing a
+  # stage script must not discard bench.py's banked records), but the
+  # watcher's stage markers DO gate script-driven stages — so fold the
+  # stage's OWN script (only: editing one stage script must not burn a
+  # scarce tunnel window re-running every other stage) plus this watcher
+  # into the marker key. On any failure emit a unique token: markers
+  # then never match and the stage re-runs (the safe direction; a
+  # constant fallback would let different code states share markers).
+  STAGE_SCRIPT="${1:-}" python - <<'EOF'
+import hashlib, importlib.util, os, uuid
+try:
+    spec = importlib.util.spec_from_file_location('bench', 'bench.py')
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    k = b._code_version_key()
+    h = hashlib.sha1()
+    paths = ['scripts/hw_watch.sh']
+    if os.environ.get('STAGE_SCRIPT'):
+        paths.append(os.environ['STAGE_SCRIPT'])
+    for p in paths:
+        with open(p, 'rb') as f:
+            h.update(p.encode() + b'\0' + f.read() + b'\0')
+    print((k or uuid.uuid4().hex[:12]) + '-' + h.hexdigest()[:8])
+except Exception:
+    print('fail-' + uuid.uuid4().hex[:12])
+EOF
+}
+
+stage_script() {  # stage_script <name> — the stage's own script ('' if none)
+  case $1 in
+    validate) echo scripts/validate_tpu.py ;;
+    detect) echo scripts/detection_study.py ;;
+    attn) echo scripts/bench_attention.py ;;
+    tune_bf16_ft) echo scripts/tune_tiles.py ;;
+    *) echo "" ;;  # bench/gen code is already in the bench key
+  esac
+}
+
+run_stage() {  # run_stage <name> <timeout-s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  local k; k=$(key "$(stage_script "$name")")
+  local marker=".bench/done_${name}_${k}"
+  if [ -e "$marker" ]; then
+    echo "[watch] $name already done for key $k"
+    return 0
+  fi
+  echo "[watch] $(date -u +%H:%M:%S) running $name (timeout ${tmo}s)"
+  if timeout "$tmo" "$@" > ".bench/${name}.log" 2>&1; then
+    touch "$marker"
+    echo "[watch] $(date -u +%H:%M:%S) $name OK"
+  else
+    echo "[watch] $(date -u +%H:%M:%S) $name FAILED rc=$? (see .bench/${name}.log)"
+    return 1
+  fi
+}
+
+while true; do
+  if probe; then
+    echo "[watch] $(date -u +%H:%M:%S) tunnel UP"
+    # External timeout must exceed bench.py's own 900 s deadline, or a
+    # slow-but-successful run gets SIGTERM'd from outside and the stage
+    # is never marked done.
+    run_stage bench 980 python bench.py
+    run_stage validate 1200 python scripts/validate_tpu.py 4096 --full --bf16
+    run_stage gen 900 python -m ft_sgemm_tpu.codegen.gen all
+    run_stage detect 900 python scripts/detection_study.py 2048
+    run_stage attn 900 python scripts/bench_attention.py
+    run_stage tune_bf16_ft 1200 python scripts/tune_tiles.py 4096 --ft --bf16
+    all=1
+    for s in bench validate gen detect attn tune_bf16_ft; do
+      [ -e ".bench/done_${s}_$(key "$(stage_script "$s")")" ] || all=0
+    done
+    if [ "$all" = 1 ]; then
+      echo "[watch] all stages banked; exiting"
+      exit 0
+    fi
+  else
+    echo "[watch] $(date -u +%H:%M:%S) tunnel down"
+  fi
+  sleep 240
+done
