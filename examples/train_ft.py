@@ -20,10 +20,19 @@ never silent.
 Runs anywhere (real TPU, or CPU interpret mode for a demo):
 
     python examples/train_ft.py [--steps N] [--no-inject] [--cpu]
+                                [--ckpt DIR [--ckpt-every N]]
 
 With ``--no-inject`` the same model runs clean (detections must be 0);
 diff the two loss columns to see that injected-and-corrected training
 matches clean training to float noise.
+
+With ``--ckpt DIR`` the run checkpoints through
+:class:`ft_sgemm_tpu.checkpoint.FtCheckpointer` and RESUMES from the
+newest checkpoint on restart — kill it mid-run and rerun the same command
+to see the step counter continue. The checkpointer enforces the ABFT
+clean-state gate: a step reporting a nonzero ``uncorrectable`` count is
+never persisted (checkpointing unverified state would launder detected
+corruption into every later resume).
 """
 
 import argparse
@@ -40,7 +49,11 @@ def main():
     ap.add_argument("--no-inject", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (interpret-mode kernels)")
+    ap.add_argument("--ckpt", default=None, metavar="DIR",
+                    help="checkpoint/resume through FtCheckpointer")
+    ap.add_argument("--ckpt-every", type=int, default=5)
     args = ap.parse_args()
+    args.ckpt_every = max(1, args.ckpt_every)
 
     import jax
 
@@ -78,6 +91,20 @@ def main():
     tx = optax.adam(1e-2)
     opt_state = tx.init(params)
 
+    ckpt, start = None, 0
+    if args.ckpt:
+        from ft_sgemm_tpu.checkpoint import FtCheckpointer
+
+        ckpt = FtCheckpointer(args.ckpt)
+        # The target pytree keeps its structure (incl. optax NamedTuple
+        # states) — restore fills the leaves.
+        latest, restored = ckpt.restore_latest(
+            {"params": params, "opt_state": opt_state})
+        if latest is not None:
+            params, opt_state = restored["params"], restored["opt_state"]
+            start = latest + 1
+            print(f"resumed from step {latest} in {args.ckpt}")
+
     @jax.jit
     def step(params, opt_state):
         def loss_fn(p, sink):
@@ -96,20 +123,34 @@ def main():
           f"inject={'off' if args.no_inject else 'magnitude 1e4, every call'}")
     print(f"{'step':>5} {'loss':>12} {'detected':>9} {'uncorrectable':>14} "
           f"{'bwd_det':>8} {'bwd_unc':>8}")
-    for i in range(args.steps):
-        params, opt_state, loss, counts, bwd = step(params, opt_state)
-        leaves = jax.tree_util.tree_leaves_with_path(counts)
-        det = sum(int(v) for p, v in leaves if "detections" in str(p))
-        unc = sum(int(v) for p, v in leaves if "uncorrectable" in str(p))
-        bwd_det, bwd_unc = int(bwd[0]), int(bwd[1])
-        print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14} "
-              f"{bwd_det:>8} {bwd_unc:>8}")
-        if unc or bwd_unc:
-            # Any GEMM of the step (forward or backward) with a violated
-            # correction assumption: the step must not be trusted.
-            print("uncorrectable interval reported: re-run the step",
-                  file=sys.stderr)
-            return 1
+    try:
+        for i in range(start, args.steps):
+            params, opt_state, loss, counts, bwd = step(params, opt_state)
+            leaves = jax.tree_util.tree_leaves_with_path(counts)
+            det = sum(int(v) for p, v in leaves if "detections" in str(p))
+            unc = sum(int(v) for p, v in leaves
+                      if "uncorrectable" in str(p))
+            bwd_det, bwd_unc = int(bwd[0]), int(bwd[1])
+            print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14} "
+                  f"{bwd_det:>8} {bwd_unc:>8}")
+            if unc or bwd_unc:
+                # Any GEMM of the step (forward or backward) with a
+                # violated correction assumption: the step must not be
+                # trusted.
+                print("uncorrectable interval reported: re-run the step",
+                      file=sys.stderr)
+                return 1
+            if ckpt and ((i + 1) % args.ckpt_every == 0
+                         or i == args.steps - 1):
+                # The clean gate holds by construction here (unc would
+                # have returned above), but pass the report anyway: the
+                # gate, not the call site, owns the policy.
+                ckpt.save(i, {"params": params, "opt_state": opt_state},
+                          uncorrectable=unc + bwd_unc)
+    finally:
+        if ckpt:
+            ckpt.close()  # waits for in-flight async saves; surfaces
+            # their failures even on the error-exit path
     return 0
 
 

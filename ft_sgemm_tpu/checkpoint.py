@@ -1,0 +1,140 @@
+"""Checkpoint / resume for fault-tolerant training (orbax-backed).
+
+The reference has no checkpoint or resume of any kind (SURVEY.md §5:
+"Checkpoint / resume: none" — it is a single-kernel study). A training
+framework built around ABFT needs one, and the two subsystems compose:
+ABFT guarantees a *step* is either clean or reported
+(``FtSgemmResult.uncorrectable``), and the checkpointer must only ever
+persist states that passed that gate — otherwise a corrupted-but-detected
+step could be laundered into a "known-good" checkpoint and every later
+resume would inherit the corruption silently, defeating the never-silent
+contract end to end.
+
+So the core API couples the two:
+
+    ckpt = FtCheckpointer(directory, max_to_keep=3)
+    for step in range(...):
+        state, uncorrectable = train_step(state)
+        ckpt.save(step, state, uncorrectable=uncorrectable)  # gate inside
+    step, state = ckpt.restore_latest(state)                 # resume
+
+``save`` refuses (returning ``False``, or raising with ``strict=True``)
+when the step reports a violated correction assumption — the caller
+re-runs the step from live state or restores the last clean checkpoint;
+``restore_latest`` is the recovery path. Works with sharded arrays: orbax
+saves/restores ``jax.sharding``-annotated pytrees across a Mesh without
+gathering to one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class UncleanStateError(RuntimeError):
+    """Refused to checkpoint a state with reported uncorrectable faults."""
+
+
+class FtCheckpointer:
+    """Orbax ``CheckpointManager`` with the ABFT clean-state gate.
+
+    Parameters
+    ----------
+    directory:
+        Checkpoint root (created if missing; must be absolute or
+        relative to cwd — orbax requires a concrete path).
+    max_to_keep:
+        Retention; oldest checkpoints beyond this are deleted.
+    strict:
+        When True, :meth:`save` raises :class:`UncleanStateError` on a
+        nonzero uncorrectable count instead of returning ``False``.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 strict: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._strict = strict
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(str(directory)),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    # -- saving ----------------------------------------------------------
+
+    def save(self, step: int, state: Any, *,
+             uncorrectable: Any = 0, force: bool = False) -> bool:
+        """Persist ``state`` at ``step`` iff the step verified clean.
+
+        ``uncorrectable`` is the step's report — a scalar, array, or any
+        pytree of counts (e.g. the ``ft_counts`` collection plus the
+        backward sink's ``[det, unc]``); any nonzero leaf sum blocks the
+        save. ``force=True`` bypasses the gate (for states verified by
+        other means). Returns True iff a checkpoint was written.
+        """
+        unc = self._total(uncorrectable)
+        if unc and not force:
+            if self._strict:
+                raise UncleanStateError(
+                    f"step {step}: {unc} uncorrectable fault interval(s) "
+                    "reported — refusing to checkpoint unverified state; "
+                    "re-run the step or restore_latest()")
+            return False
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        return True
+
+    def wait(self) -> None:
+        """Block until any async save has committed to disk."""
+        self._mgr.wait_until_finished()
+
+    # -- restoring -------------------------------------------------------
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int, target: Any) -> Any:
+        """Restore ``step``; ``target`` is a matching pytree of arrays (or
+        ShapeDtypeStructs with shardings) supplying structure/placement."""
+        ref = jax.tree.map(_as_abstract, target)
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(ref))
+
+    def restore_latest(self, target: Any) -> Tuple[Optional[int], Any]:
+        """(step, state) of the newest clean checkpoint, or (None, target)
+        when none exists — callers start fresh without a special case."""
+        step = self.latest_step
+        if step is None:
+            return None, target
+        return step, self.restore(step, target)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _total(counts: Any) -> int:
+        return int(sum(int(np.sum(np.asarray(leaf)))
+                       for leaf in jax.tree.leaves(counts)))
+
+
+def _as_abstract(x):
+    """Structure/placement reference for restore: keep ShapeDtypeStructs,
+    map concrete arrays to their shape/dtype/sharding."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    a = np.asarray(x) if not isinstance(x, jax.Array) else x
+    sharding = getattr(x, "sharding", None)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding)

@@ -271,7 +271,9 @@ def make_ring_ft_attention_diff(
     # false-positive on clean checksum noise and trip the re-run gate).
     qk_b = mk(qk_shape, threshold)
     b_long = mk(pv_shape, bthr)
-    b_short = mk(qk_shape, bthr)
+    # Same shape and threshold => same kernel: reuse the recompute kernel
+    # for dP, as the single-device factory does (ops/attention.py).
+    b_short = qk_b if bthr == threshold else mk(qk_shape, bthr)
 
     def _forward(q, k, v):
         q2, k2, v2, lq, lk, dv, _, sc = _ring_geometry(

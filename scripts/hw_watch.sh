@@ -70,6 +70,13 @@ run_stage() {  # run_stage <name> <timeout-s> <cmd...>
     echo "[watch] $name already done for key $k"
     return 0
   fi
+  # Re-probe before every stage: windows are ~20 min and can close
+  # mid-list; without this, one drop burns every remaining stage's full
+  # timeout against a dead tunnel before the outer loop probes again.
+  if ! probe; then
+    echo "[watch] $(date -u +%H:%M:%S) tunnel dropped before $name"
+    return 1
+  fi
   echo "[watch] $(date -u +%H:%M:%S) running $name (timeout ${tmo}s)"
   if timeout "$tmo" "$@" > ".bench/${name}.log" 2>&1; then
     touch "$marker"
