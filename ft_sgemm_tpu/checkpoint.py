@@ -85,8 +85,11 @@ class FtCheckpointer:
                     "reported — refusing to checkpoint unverified state; "
                     "re-run the step or restore_latest()")
             return False
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
-        return True
+        # orbax itself may skip the save (e.g. should_save is False when
+        # latest_step >= step after restoring an older step): forward its
+        # verdict so "True" really means "written".
+        return bool(self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state)))
 
     def wait(self) -> None:
         """Block until any async save has committed to disk."""

@@ -43,10 +43,16 @@ from __future__ import annotations
 from typing import Optional, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.attention import (
+    QK_SHAPE,
+    PV_SHAPE,
+    make_ft_attention_diff,
+)
 from ft_sgemm_tpu.ops.autodiff import make_ft_matmul
 
 # Counts are written to this flax variable collection (pass
@@ -158,4 +164,148 @@ class FtDense(nn.Module):
         return out.astype(x.dtype).reshape(*batch_shape, self.features)
 
 
-__all__ = ["COUNTS_COLLECTION", "FtDense"]
+class FtSelfAttention(nn.Module):
+    """Multi-head self-attention with every GEMM ABFT-protected.
+
+    The model-family layer above :class:`FtDense`: Q/K/V/output
+    projections are :class:`FtDense` layers, and each head's attention
+    core runs through :func:`ft_sgemm_tpu.make_ft_attention_diff` — all
+    six GEMM executions of its forward + backward (QKᵀ, PV, dV, dP, dQ,
+    dK) go through the fused-ABFT Pallas kernels, the softmax
+    normalization invariant and sampled dual recompute guard the
+    elementwise stage, and counts surface per layer through the
+    ``ft_counts`` collection (``detections`` / ``uncorrectable`` sum the
+    projections and the attention core; ``softmax_flags`` is the
+    attention core's softmax check).
+
+    Accepts ``(L, D)`` or ``(batch, L, D)`` inputs. ``causal=True``
+    applies the end-aligned decoder mask. ``bwd_sink`` (optional, any
+    (2,) f32 array) opens the backward-counts gradient side-channel
+    through the projections AND the attention core — differentiate with
+    respect to it for ``[detections, uncorrectable]`` over every
+    backward GEMM of the layer.
+    """
+
+    num_heads: int
+    qkv_features: Optional[int] = None  # default: model dim
+    out_features: Optional[int] = None  # default: model dim
+    causal: bool = False
+    use_bias: bool = True
+    strategy: str = "weighted"
+    threshold: Union[float, str] = "auto"  # see FtDense.threshold
+    bwd_threshold: Optional[Union[float, str]] = None
+    dense_shape: Union[KernelShape, str] = "huge"
+    qk_shape: KernelShape = QK_SHAPE
+    pv_shape: KernelShape = PV_SHAPE
+    in_dtype: str = "float32"
+    inject: Optional[InjectionSpec] = None  # attention-core self-test
+    inject_bwd: Optional[InjectionSpec] = None
+
+    @nn.compact
+    def __call__(self, x, bwd_sink=None):
+        d_model = x.shape[-1]
+        qkv = self.qkv_features or d_model
+        out_feat = self.out_features or d_model
+        if qkv % self.num_heads:
+            raise ValueError(
+                f"qkv_features {qkv} not divisible by num_heads "
+                f"{self.num_heads}")
+        d_head = qkv // self.num_heads
+        # Self-test injection drives EVERY GEMM of the layer — the four
+        # projections as well as the attention core — so a block-level
+        # inject/inject_bwd exercises the full protection surface.
+        dense_kw = dict(
+            use_bias=self.use_bias, strategy=self.strategy,
+            threshold=self.threshold, bwd_threshold=self.bwd_threshold,
+            shape=self.dense_shape, in_dtype=self.in_dtype,
+            inject=self.inject, inject_bwd=self.inject_bwd)
+        q = FtDense(qkv, name="query", **dense_kw)(x, bwd_sink)
+        k = FtDense(qkv, name="key", **dense_kw)(x, bwd_sink)
+        v = FtDense(qkv, name="value", **dense_kw)(x, bwd_sink)
+
+        batch_shape = x.shape[:-2]
+        length = x.shape[-2]
+        split = lambda t: t.reshape(  # noqa: E731 — (B, H, L, d_head)
+            -1, length, self.num_heads, d_head).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+
+        attn = make_ft_attention_diff(
+            causal=self.causal, strategy=self.strategy,
+            threshold=self.threshold, bwd_threshold=self.bwd_threshold,
+            inject=self.inject, inject_bwd=self.inject_bwd,
+            qk_shape=self.qk_shape, pv_shape=self.pv_shape,
+            in_dtype=self.in_dtype, with_counts=True,
+            with_bwd_counts=bwd_sink is not None)
+        args = (q, k, v) + (() if bwd_sink is None else (bwd_sink,))
+        axes = (0, 0, 0) + (() if bwd_sink is None else (None,))
+        res = jax.vmap(jax.vmap(attn, in_axes=axes), in_axes=axes)(*args)
+
+        if not self.is_initializing():
+            accumulate = lambda prev, new: prev + new  # noqa: E731
+            zero = lambda: jnp.int32(0)  # noqa: E731
+            for name, leaf in (("detections", res.detections),
+                               ("softmax_flags", res.softmax_flags),
+                               ("uncorrectable", res.uncorrectable)):
+                self.sow(COUNTS_COLLECTION, name, jnp.sum(leaf),
+                         reduce_fn=accumulate, init_fn=zero)
+
+        out = res.out.transpose(0, 2, 1, 3).reshape(
+            *batch_shape, length, qkv)
+        return FtDense(out_feat, name="out", **dense_kw)(out, bwd_sink)
+
+
+class FtTransformerBlock(nn.Module):
+    """Pre-LN transformer block with ABFT on every GEMM.
+
+    ``x + Attn(LN(x))`` then ``x + MLP(LN(x))`` — the standard block,
+    with :class:`FtSelfAttention` as the mixer and an :class:`FtDense`
+    pair (``mlp_ratio``× expansion, GELU) as the MLP, so every matrix
+    product of the block's forward and backward is ABFT-protected and
+    every sub-layer reports into ``ft_counts``. LayerNorm, GELU, and the
+    residual adds are elementwise VPU compute outside the checksum
+    domain (same honesty boundary as the softmax stage —
+    ops/attention.py module docstring).
+
+    A stack of these blocks is a fault-tolerant transformer; thread one
+    ``bwd_sink`` through every block to fold all backward-GEMM reports
+    into a single step-level ``[detections, uncorrectable]`` gradient.
+    """
+
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    strategy: str = "weighted"
+    threshold: Union[float, str] = "auto"
+    bwd_threshold: Optional[Union[float, str]] = None
+    dense_shape: Union[KernelShape, str] = "huge"
+    qk_shape: KernelShape = QK_SHAPE
+    pv_shape: KernelShape = PV_SHAPE
+    in_dtype: str = "float32"
+    inject: Optional[InjectionSpec] = None
+    inject_bwd: Optional[InjectionSpec] = None
+
+    @nn.compact
+    def __call__(self, x, bwd_sink=None):
+        d_model = x.shape[-1]
+        kw = dict(strategy=self.strategy, threshold=self.threshold,
+                  bwd_threshold=self.bwd_threshold,
+                  in_dtype=self.in_dtype)
+        h = nn.LayerNorm(name="ln_attn")(x)
+        h = FtSelfAttention(
+            num_heads=self.num_heads, causal=self.causal,
+            dense_shape=self.dense_shape, qk_shape=self.qk_shape,
+            pv_shape=self.pv_shape, inject=self.inject,
+            inject_bwd=self.inject_bwd, name="attn", **kw)(h, bwd_sink)
+        x = x + h
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        mlp_kw = dict(shape=self.dense_shape, inject=self.inject,
+                      inject_bwd=self.inject_bwd, **kw)
+        h = FtDense(self.mlp_ratio * d_model,
+                    name="mlp_in", **mlp_kw)(h, bwd_sink)
+        h = nn.gelu(h)
+        h = FtDense(d_model, name="mlp_out", **mlp_kw)(h, bwd_sink)
+        return x + h
+
+
+__all__ = ["COUNTS_COLLECTION", "FtDense", "FtSelfAttention",
+           "FtTransformerBlock"]

@@ -54,6 +54,16 @@ def test_strict_mode_raises(tmp_path):
             ck.save(0, _state(), uncorrectable=jnp.asarray(1))
 
 
+def test_save_forwards_orbax_verdict(tmp_path):
+    """orbax skips saves at steps <= latest_step; save() must say so
+    rather than claiming the state persisted."""
+    with FtCheckpointer(tmp_path / "ck") as ck:
+        assert ck.save(5, _state())
+        ck.wait()
+        assert not ck.save(4, _state())
+        assert ck.latest_step == 5
+
+
 def test_restore_latest_without_checkpoints_returns_target(tmp_path):
     target = _state()
     with FtCheckpointer(tmp_path / "ck") as ck:

@@ -1,0 +1,157 @@
+"""FtSelfAttention / FtTransformerBlock: the model-family layer.
+
+Oracle-differential tests in the reference's style (SURVEY.md §4 — every
+kernel verified against the vendor dot): the flax attention module under
+full injection must match a pure-XLA transformer oracle built from the
+same parameters, with faults corrected, counts observable, and gradients
+flowing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+flax = pytest.importorskip("flax")
+optax = pytest.importorskip("optax")
+
+from ft_sgemm_tpu import InjectionSpec  # noqa: E402
+from ft_sgemm_tpu.nn import (  # noqa: E402
+    COUNTS_COLLECTION,
+    FtSelfAttention,
+    FtTransformerBlock,
+)
+from ft_sgemm_tpu.ops.attention import attention_reference  # noqa: E402
+from ft_sgemm_tpu.utils import verify_matrix  # noqa: E402
+
+INJ = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+
+
+def _x(batch=2, length=32, d=32, seed=0):
+    k = jax.random.key(seed)
+    return jax.random.normal(k, (batch, length, d)) * 0.3
+
+
+def _oracle_attention(variables, x, num_heads, causal):
+    """Same math via plain XLA ops from the module's own parameters."""
+    p = variables["params"]
+
+    def proj(name, t):
+        return t @ p[name]["kernel"] + p[name]["bias"]
+
+    q, k, v = (proj(n, x) for n in ("query", "key", "value"))
+    b, length, qkv = q.shape
+    dh = qkv // num_heads
+    split = lambda t: t.reshape(  # noqa: E731
+        b, length, num_heads, dh).transpose(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    out = jax.vmap(jax.vmap(
+        lambda qq, kk, vv: attention_reference(qq, kk, vv, causal=causal)
+    ))(q, k, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, length, qkv)
+    return proj("out", out)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_matches_oracle_under_injection(causal):
+    x = _x()
+    mod = FtSelfAttention(num_heads=2, causal=causal, inject=INJ)
+    variables = mod.init(jax.random.key(1), x)
+    out, mut = mod.apply(variables, x, mutable=[COUNTS_COLLECTION])
+    want = _oracle_attention(variables, x, 2, causal)
+    ok, nbad, _ = verify_matrix(np.asarray(want).reshape(-1, x.shape[-1]),
+                                np.asarray(out).reshape(-1, x.shape[-1]),
+                                verbose=False)
+    assert ok, f"{nbad} mismatches vs the XLA oracle"
+    counts = mut[COUNTS_COLLECTION]
+    assert int(counts["detections"]) > 0, "injection must be detected"
+    assert int(counts["uncorrectable"]) == 0
+    # Projection sub-layers report under their own scopes.
+    assert "query" in counts and "detections" in counts["query"]
+
+
+def test_gradients_flow_and_bwd_counts_report():
+    x = _x()
+    mod = FtSelfAttention(num_heads=2, inject=INJ, inject_bwd=INJ)
+    variables = mod.init(jax.random.key(1), x)
+
+    def loss(params, sink):
+        out = mod.apply({"params": params["params"]}, x, sink)
+        return jnp.sum(out ** 2)
+
+    (g, bwd) = jax.grad(loss, argnums=(0, 1))(
+        {"params": variables["params"]}, jnp.zeros(2))
+    flat = jax.tree.leaves(g)
+    assert all(bool(jnp.all(jnp.isfinite(leaf))) for leaf in flat)
+    assert any(float(jnp.max(jnp.abs(leaf))) > 0 for leaf in flat)
+    # The gradient side-channel reports backward-GEMM fault activity.
+    assert float(bwd[0]) > 0, "bwd detections must be reported"
+    assert float(bwd[1]) == 0
+
+
+def test_adversarial_bwd_schedule_surfaces_uncorrectable():
+    """col_stride=0 (all faults in one column) in the BACKWARD pass only:
+    the report channel must carry a nonzero uncorrectable count to the
+    caller — never silent (VERDICT r3 item 4's done criterion, extended
+    to the attention layer)."""
+    x = _x(batch=1)
+    adv = InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                        col_stride=0)
+    # qkv_features=512 => d_head=256 => the dP gradient GEMM (contracts
+    # over d_head, qk profile bk=128) runs nk=2 K-steps: two same-column
+    # faults land in one deferred-check interval, where localization must
+    # misfire and the re-check must REPORT (a single fault per call is
+    # simply corrected — no uncorrectable to surface).
+    mod = FtSelfAttention(num_heads=2, qkv_features=512, inject_bwd=adv)
+    variables = mod.init(jax.random.key(1), x)
+
+    def loss(params, sink):
+        out = mod.apply({"params": params}, x, sink)
+        return jnp.sum(out ** 2)
+
+    _, bwd = jax.grad(loss, argnums=(0, 1))(variables["params"],
+                                            jnp.zeros(2))
+    assert float(bwd[1]) > 0, (
+        "adversarial backward corruption must surface as uncorrectable")
+
+
+def test_transformer_block_trains_under_injection():
+    x = _x(batch=1, length=32, d=32)
+    y = jnp.roll(x, 1, axis=-1)
+    mod = FtTransformerBlock(num_heads=2, causal=True, inject=INJ)
+    variables = mod.init(jax.random.key(1), x)
+    params = variables["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            out, mut = mod.apply({"params": p}, x,
+                                 mutable=[COUNTS_COLLECTION])
+            counts = mut[COUNTS_COLLECTION]
+            return jnp.mean((out - y) ** 2), counts
+
+        (loss, counts), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        upd, opt = tx.update(grads, opt)
+        return optax.apply_updates(params, upd), opt, loss, counts
+
+    losses = []
+    for _ in range(4):
+        params, opt, loss, counts = step(params, opt)
+        losses.append(float(loss))
+        unc = sum(int(np.sum(v)) for pth, v
+                  in jax.tree_util.tree_leaves_with_path(counts)
+                  if "uncorrectable" in str(pth))
+        assert unc == 0
+    assert losses[-1] < losses[0], (
+        f"loss must fall under per-call injection: {losses}")
+
+
+def test_unbatched_input_shape():
+    x = _x()[0]  # (L, D)
+    mod = FtSelfAttention(num_heads=2)
+    variables = mod.init(jax.random.key(1), x)
+    out = mod.apply(variables, x)
+    assert out.shape == x.shape
