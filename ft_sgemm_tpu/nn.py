@@ -45,6 +45,7 @@ from typing import Optional, Union
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec
@@ -58,6 +59,33 @@ from ft_sgemm_tpu.ops.autodiff import make_ft_matmul
 # Counts are written to this flax variable collection (pass
 # ``mutable=["ft_counts"]`` to ``apply`` to receive them).
 COUNTS_COLLECTION = "ft_counts"
+
+
+def _sow_counts(module, pairs):
+    """Report count leaves into ``ft_counts`` under ``module``'s scope.
+
+    Counts ride a variable collection via sow: flax's channel for
+    non-differentiable per-call outputs. Integer values take no
+    gradients; when the collection is not mutable (plain apply), sow
+    drops the writes silently. reduce_fn SUMS across calls: a module
+    instance applied more than once per step (weight tying, nn.scan)
+    must not let a later clean call's 0 overwrite an earlier call's
+    nonzero uncorrectable — every invocation's report survives into the
+    step's re-run gate. sow also reduces onto any value already present
+    in the PASSED-IN variables, so: (a) nothing is sown during the init
+    trace (init's returned variables would otherwise pre-load the first
+    real step), and (b) ``ft_counts`` is a per-apply output like flax's
+    ``intermediates`` — read it from ``mutated``, do NOT merge it back
+    into the variables you pass to the next apply (doing so would
+    accumulate counts across steps and latch the re-run gate).
+    """
+    if module.is_initializing():
+        return
+    accumulate = lambda prev, new: prev + new  # noqa: E731
+    zero = lambda: jnp.int32(0)  # noqa: E731
+    for name, leaf in pairs:
+        module.sow(COUNTS_COLLECTION, name, jnp.asarray(leaf),
+                   reduce_fn=accumulate, init_fn=zero)
 
 
 class FtDense(nn.Module):
@@ -133,28 +161,9 @@ class FtDense(nn.Module):
         res = (mm(x2, kt) if bwd_sink is None
                else mm(x2, kt, bwd_sink))
         out = res.out
-        # Counts ride a variable collection via sow: flax's channel for
-        # non-differentiable per-call outputs. Integer values take no
-        # gradients; when the collection is not mutable (plain apply),
-        # sow drops the writes silently. reduce_fn SUMS across calls: a
-        # module instance applied more than once per step (weight tying,
-        # nn.scan) must not let a later clean call's 0 overwrite an
-        # earlier call's nonzero uncorrectable — every invocation's
-        # report survives into the step's re-run gate. sow also reduces
-        # onto any value already present in the PASSED-IN variables, so:
-        # (a) nothing is sown during the init trace (init's returned
-        # variables would otherwise pre-load the first real step), and
-        # (b) ``ft_counts`` is a per-apply output like flax's
-        # ``intermediates`` — read it from ``mutated``, do NOT merge it
-        # back into the variables you pass to the next apply (doing so
-        # would accumulate counts across steps and latch the re-run gate).
-        if not self.is_initializing():
-            accumulate = lambda prev, new: prev + new  # noqa: E731
-            zero = lambda: jnp.int32(0)  # noqa: E731
-            self.sow(COUNTS_COLLECTION, "detections", res.detections,
-                     reduce_fn=accumulate, init_fn=zero)
-            self.sow(COUNTS_COLLECTION, "uncorrectable", res.uncorrectable,
-                     reduce_fn=accumulate, init_fn=zero)
+        # Counts ride the ft_counts collection (semantics: _sow_counts).
+        _sow_counts(self, (("detections", res.detections),
+                           ("uncorrectable", res.uncorrectable)))
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,),
                               jnp.float32)
@@ -162,6 +171,32 @@ class FtDense(nn.Module):
         # Drop-in dtype behavior: the FT kernels accumulate and return
         # f32; hand downstream ops the caller's activation dtype.
         return out.astype(x.dtype).reshape(*batch_shape, self.features)
+
+
+def _qkv_projections(mod, x, bwd_sink):
+    """Shared attention preamble: resolve feature sizes and apply the
+    FtDense Q/K/V projections (called from the owning module's compact
+    ``__call__``, so the submodules attach to its scope). Self-test
+    injection drives EVERY GEMM of the layer — the projections as well
+    as the attention core — so a layer-level ``inject``/``inject_bwd``
+    exercises the full protection surface. Returns
+    ``(q, k, v, qkv, out_features, d_head, dense_kw)``."""
+    d_model = x.shape[-1]
+    qkv = mod.qkv_features or d_model
+    out_feat = mod.out_features or d_model
+    if qkv % mod.num_heads:
+        raise ValueError(
+            f"qkv_features {qkv} not divisible by num_heads "
+            f"{mod.num_heads}")
+    dense_kw = dict(
+        use_bias=mod.use_bias, strategy=mod.strategy,
+        threshold=mod.threshold, bwd_threshold=mod.bwd_threshold,
+        shape=mod.dense_shape, in_dtype=mod.in_dtype,
+        inject=mod.inject, inject_bwd=mod.inject_bwd)
+    q = FtDense(qkv, name="query", **dense_kw)(x, bwd_sink)
+    k = FtDense(qkv, name="key", **dense_kw)(x, bwd_sink)
+    v = FtDense(qkv, name="value", **dense_kw)(x, bwd_sink)
+    return q, k, v, qkv, out_feat, qkv // mod.num_heads, dense_kw
 
 
 class FtSelfAttention(nn.Module):
@@ -203,25 +238,8 @@ class FtSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, bwd_sink=None):
-        d_model = x.shape[-1]
-        qkv = self.qkv_features or d_model
-        out_feat = self.out_features or d_model
-        if qkv % self.num_heads:
-            raise ValueError(
-                f"qkv_features {qkv} not divisible by num_heads "
-                f"{self.num_heads}")
-        d_head = qkv // self.num_heads
-        # Self-test injection drives EVERY GEMM of the layer — the four
-        # projections as well as the attention core — so a block-level
-        # inject/inject_bwd exercises the full protection surface.
-        dense_kw = dict(
-            use_bias=self.use_bias, strategy=self.strategy,
-            threshold=self.threshold, bwd_threshold=self.bwd_threshold,
-            shape=self.dense_shape, in_dtype=self.in_dtype,
-            inject=self.inject, inject_bwd=self.inject_bwd)
-        q = FtDense(qkv, name="query", **dense_kw)(x, bwd_sink)
-        k = FtDense(qkv, name="key", **dense_kw)(x, bwd_sink)
-        v = FtDense(qkv, name="value", **dense_kw)(x, bwd_sink)
+        q, k, v, qkv, out_feat, d_head, dense_kw = _qkv_projections(
+            self, x, bwd_sink)
 
         batch_shape = x.shape[:-2]
         length = x.shape[-2]
@@ -240,17 +258,90 @@ class FtSelfAttention(nn.Module):
         axes = (0, 0, 0) + (() if bwd_sink is None else (None,))
         res = jax.vmap(jax.vmap(attn, in_axes=axes), in_axes=axes)(*args)
 
-        if not self.is_initializing():
-            accumulate = lambda prev, new: prev + new  # noqa: E731
-            zero = lambda: jnp.int32(0)  # noqa: E731
-            for name, leaf in (("detections", res.detections),
-                               ("softmax_flags", res.softmax_flags),
-                               ("uncorrectable", res.uncorrectable)):
-                self.sow(COUNTS_COLLECTION, name, jnp.sum(leaf),
-                         reduce_fn=accumulate, init_fn=zero)
+        _sow_counts(self, (("detections", jnp.sum(res.detections)),
+                           ("softmax_flags", jnp.sum(res.softmax_flags)),
+                           ("uncorrectable", jnp.sum(res.uncorrectable))))
 
         out = res.out.transpose(0, 2, 1, 3).reshape(
             *batch_shape, length, qkv)
+        return FtDense(out_feat, name="out", **dense_kw)(out, bwd_sink)
+
+
+class FtRingSelfAttention(nn.Module):
+    """Long-context self-attention: the attention core runs the DISTRIBUTED
+    ring (sequence-parallel) path over a device mesh.
+
+    Same protection surface as :class:`FtSelfAttention`, but each head's
+    core is :func:`ft_sgemm_tpu.parallel.make_ring_ft_attention_diff`:
+    K/V shards rotate the ICI ring through the online-softmax recurrence,
+    every per-hop GEMM of the forward AND the backward ring pass goes
+    through the fused-ABFT kernels, detection counts ``psum`` over the
+    ring, and dK/dV accumulators rotate home with their blocks. The layer
+    is how a transformer trains on sequences no single device can hold —
+    with the same never-silent fault contract as the single-device path.
+
+    Input is an unbatched ``(L, D)`` sequence with ``L`` divisible by the
+    mesh's ring size (sequence parallelism shards L; batch, if any, is an
+    outer ``vmap``/``shard_map`` axis). ``bwd_sink`` opens the gradient
+    side-channel through the projections and every ring hop's backward
+    GEMMs (psum'd over the ring).
+    """
+
+    mesh: Mesh
+    num_heads: int
+    qkv_features: Optional[int] = None
+    out_features: Optional[int] = None
+    causal: bool = False
+    use_bias: bool = True
+    strategy: str = "weighted"
+    threshold: Union[float, str] = "auto"
+    bwd_threshold: Optional[Union[float, str]] = None
+    dense_shape: Union[KernelShape, str] = "huge"
+    qk_shape: KernelShape = QK_SHAPE
+    pv_shape: KernelShape = PV_SHAPE
+    in_dtype: str = "float32"
+    inject: Optional[InjectionSpec] = None
+    inject_bwd: Optional[InjectionSpec] = None
+
+    @nn.compact
+    def __call__(self, x, bwd_sink=None):
+        from ft_sgemm_tpu.parallel import make_ring_ft_attention_diff
+
+        if x.ndim != 2:
+            raise ValueError(
+                f"FtRingSelfAttention takes an unbatched (L, D) sequence, "
+                f"got shape {x.shape}; vmap/shard_map an outer batch axis")
+        q, k, v, qkv, out_feat, d_head, dense_kw = _qkv_projections(
+            self, x, bwd_sink)
+
+        length = x.shape[0]
+        heads = lambda t: t.reshape(  # noqa: E731 — (H, L, d_head)
+            length, self.num_heads, d_head).transpose(1, 0, 2)
+        q, k, v = heads(q), heads(k), heads(v)
+
+        attn = make_ring_ft_attention_diff(
+            self.mesh, causal=self.causal, strategy=self.strategy,
+            threshold=self.threshold, bwd_threshold=self.bwd_threshold,
+            inject=self.inject, inject_bwd=self.inject_bwd,
+            qk_shape=self.qk_shape, pv_shape=self.pv_shape,
+            in_dtype=self.in_dtype, with_counts=True,
+            with_bwd_counts=bwd_sink is not None)
+        # Static per-head loop: the ring recurrence is a shard_map over the
+        # sequence axis, so heads are a trace-time loop, not a vmap axis.
+        outs, det, flags, unc = [], 0, 0, 0
+        for h in range(self.num_heads):
+            args = ((q[h], k[h], v[h])
+                    + (() if bwd_sink is None else (bwd_sink,)))
+            res = attn(*args)
+            outs.append(res.out)
+            det = det + res.detections
+            flags = flags + res.softmax_flags
+            unc = unc + res.uncorrectable
+
+        _sow_counts(self, (("detections", det), ("softmax_flags", flags),
+                           ("uncorrectable", unc)))
+
+        out = jnp.stack(outs, axis=1).reshape(length, qkv)
         return FtDense(out_feat, name="out", **dense_kw)(out, bwd_sink)
 
 
@@ -307,5 +398,5 @@ class FtTransformerBlock(nn.Module):
         return x + h
 
 
-__all__ = ["COUNTS_COLLECTION", "FtDense", "FtSelfAttention",
-           "FtTransformerBlock"]
+__all__ = ["COUNTS_COLLECTION", "FtDense", "FtRingSelfAttention",
+           "FtSelfAttention", "FtTransformerBlock"]

@@ -95,7 +95,7 @@ while true; do
     # is never marked done.
     run_stage bench 980 python bench.py
     run_stage validate 1200 python scripts/validate_tpu.py 4096 --full --bf16
-    run_stage gen 900 python -m ft_sgemm_tpu.codegen.gen all
+    run_stage gen 900 bash -c "python -m ft_sgemm_tpu.codegen.gen all && python -m ft_sgemm_tpu.codegen.gen huge 0 --dtype=bfloat16 && python -m ft_sgemm_tpu.codegen.gen huge 1 --dtype=bfloat16"
     run_stage detect 900 python scripts/detection_study.py 2048
     run_stage attn 900 python scripts/bench_attention.py
     run_stage tune_bf16_ft 1200 python scripts/tune_tiles.py 4096 --ft --bf16
