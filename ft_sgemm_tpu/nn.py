@@ -34,8 +34,11 @@ read it from the mutated-variables return and act on it; never merge it
 back into the variables passed to the next apply — sow reduces onto
 carried-in values, so merging would accumulate counts across steps and
 permanently latch the ``uncorrectable`` re-run gate. Within one apply,
-counts DO sum across invocations of the same module instance (weight
-tying, ``nn.scan``), so no invocation's report can be overwritten.
+counts DO sum across repeated invocations of the same module instance
+(weight tying), so no invocation's report can be overwritten; under
+``nn.scan`` with ``variable_axes={"ft_counts": 0}`` (what
+:class:`FtTransformer` does) each step instead sows into its own
+stacked per-layer slice.
 """
 
 from __future__ import annotations
@@ -398,5 +401,61 @@ class FtTransformerBlock(nn.Module):
         return x + h
 
 
+class FtTransformer(nn.Module):
+    """A stack of :class:`FtTransformerBlock` layers via ``nn.scan``.
+
+    The model-scale composition: ``num_layers`` blocks share one traced
+    body (compile time stays constant in depth — the XLA-friendly way to
+    stack), and parameters AND ``ft_counts`` carry a leading layer axis
+    (``variable_axes``): each layer sows into its own stacked slice, so
+    every layer's fault report is individually visible and no layer can
+    overwrite another's. Step-level readers that sum count leaves (the
+    re-run gate, the training examples) are unchanged by the extra axis.
+    ``bwd_sink`` broadcasts to every layer, so one sink gradient reports
+    the whole stack's backward GEMMs.
+    """
+
+    num_layers: int
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    strategy: str = "weighted"
+    threshold: Union[float, str] = "auto"
+    bwd_threshold: Optional[Union[float, str]] = None
+    dense_shape: Union[KernelShape, str] = "huge"
+    qk_shape: KernelShape = QK_SHAPE
+    pv_shape: KernelShape = PV_SHAPE
+    in_dtype: str = "float32"
+    inject: Optional[InjectionSpec] = None
+    inject_bwd: Optional[InjectionSpec] = None
+
+    @nn.compact
+    def __call__(self, x, bwd_sink=None):
+        block_kw = dict(
+            num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+            causal=self.causal, strategy=self.strategy,
+            threshold=self.threshold, bwd_threshold=self.bwd_threshold,
+            dense_shape=self.dense_shape, qk_shape=self.qk_shape,
+            pv_shape=self.pv_shape, in_dtype=self.in_dtype,
+            inject=self.inject, inject_bwd=self.inject_bwd)
+
+        class _Step(nn.Module):
+            @nn.compact
+            def __call__(self, carry, _):
+                return (FtTransformerBlock(name="block", **block_kw)(
+                    carry, bwd_sink), None)
+
+        scan = nn.scan(
+            _Step,
+            # ft_counts stacks with a leading layer axis (like flax's
+            # "intermediates"): per-layer fault visibility, and readers
+            # that sum leaves (the step-level re-run gate) are unchanged.
+            variable_axes={"params": 0, COUNTS_COLLECTION: 0},
+            split_rngs={"params": True},
+            length=self.num_layers)
+        y, _ = scan(name="layers")(x, None)
+        return y
+
+
 __all__ = ["COUNTS_COLLECTION", "FtDense", "FtRingSelfAttention",
-           "FtSelfAttention", "FtTransformerBlock"]
+           "FtSelfAttention", "FtTransformer", "FtTransformerBlock"]
