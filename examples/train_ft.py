@@ -33,6 +33,11 @@ to see the step counter continue. The checkpointer enforces the ABFT
 clean-state gate: a step reporting a nonzero ``uncorrectable`` count is
 never persisted (checkpointing unverified state would launder detected
 corruption into every later resume).
+
+The explicit re-run gate below is written out for clarity; production
+loops can use :func:`ft_sgemm_tpu.train.resilient_step`, which packages
+the same policy (bounded retry from the pre-step state, restore from the
+newest clean checkpoint on persistent reports).
 """
 
 import argparse

@@ -39,6 +39,13 @@ class UncleanStateError(RuntimeError):
     """Refused to checkpoint a state with reported uncorrectable faults."""
 
 
+def total_count(counts: Any) -> int:
+    """Sum every leaf of a count report — scalar, array, or pytree (the
+    ``ft_counts`` collection, a backward sink's ``[det, unc]``, …)."""
+    return int(sum(int(np.sum(np.asarray(leaf)))
+                   for leaf in jax.tree.leaves(counts)))
+
+
 class FtCheckpointer:
     """Orbax ``CheckpointManager`` with the ABFT clean-state gate.
 
@@ -76,6 +83,9 @@ class FtCheckpointer:
         backward sink's ``[det, unc]``); any nonzero leaf sum blocks the
         save. ``force=True`` bypasses the gate (for states verified by
         other means). Returns True iff a checkpoint was written.
+
+        ``state`` must be a pytree CONTAINER (dict/list/dataclass —
+        orbax's StandardSave rejects a bare array or scalar).
         """
         unc = self._total(uncorrectable)
         if unc and not force:
@@ -125,12 +135,7 @@ class FtCheckpointer:
     def __exit__(self, *exc):
         self.close()
 
-    # -- helpers ---------------------------------------------------------
-
-    @staticmethod
-    def _total(counts: Any) -> int:
-        return int(sum(int(np.sum(np.asarray(leaf)))
-                       for leaf in jax.tree.leaves(counts)))
+    _total = staticmethod(total_count)
 
 
 def _as_abstract(x):

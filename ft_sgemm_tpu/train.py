@@ -1,0 +1,128 @@
+"""Failure handling for training loops: retry, then restore.
+
+The kernels' contract is *clean-or-reported*: every GEMM either produces
+a verified output or raises its ``uncorrectable`` count
+(residual-after-correct re-check, ops/ft_sgemm.py). What a TRAINING LOOP
+should do with a report is policy, and every example was hand-rolling
+the same one — this module packages it:
+
+1. **Retry** the step from the pre-step state (SDC is overwhelmingly
+   transient: a re-run of the same step on the same data is the cheapest
+   recovery, and the pre-step state is untainted by construction — the
+   report gated the corrupted update from being applied).
+2. **Restore** from the newest clean checkpoint when reports persist
+   (a persistent report suggests the fault is not transient — bad
+   memory, a poisoned input batch — so replaying from checkpointed
+   history is the sound fallback; the
+   :class:`ft_sgemm_tpu.checkpoint.FtCheckpointer` gate guarantees
+   whatever it holds was verified clean).
+3. **Raise** when there is nothing to restore: never train on, or
+   checkpoint, a state built from an unverified update.
+
+The reference has no training loop at all (it is a kernel study); this
+is the aux "failure detection / recovery" subsystem of the task brief,
+built on the framework's own report channels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = ["UncorrectableStepError", "StepReport", "resilient_step"]
+
+
+class UncorrectableStepError(RuntimeError):
+    """A step kept reporting uncorrectable faults and no clean state was
+    available to fall back to."""
+
+
+class StepReport:
+    """What :func:`resilient_step` did to produce the returned state.
+
+    Attributes: ``retries`` (attempts beyond the first — every one of
+    them forced by a reported fault), ``restored_step`` (checkpoint step
+    resumed from, or None), ``uncorrectable`` (the final attempt's
+    count — 0 unless ``raise_on_failure=False``).
+    """
+
+    def __init__(self, retries: int, restored_step: Optional[int],
+                 uncorrectable: int):
+        self.retries = retries
+        self.restored_step = restored_step
+        self.uncorrectable = uncorrectable
+
+    def __repr__(self):
+        return (f"StepReport(retries={self.retries}, "
+                f"restored_step={self.restored_step}, "
+                f"uncorrectable={self.uncorrectable})")
+
+
+def resilient_step(
+    step_fn: Callable[[Any], Tuple[Any, Any, Any]],
+    state: Any,
+    *,
+    max_retries: int = 2,
+    checkpointer=None,
+    restore_target: Any = None,
+    raise_on_failure: bool = True,
+) -> Tuple[Any, Any, StepReport]:
+    """Run one training step under the clean-or-reported contract.
+
+    ``step_fn(state) -> (new_state, metrics, uncorrectable)`` is the
+    caller's (usually jitted) step; ``uncorrectable`` is the step's
+    total report — forward counts plus the ``bwd_sink`` gradient
+    (anything summable; see examples/train_ft.py for the step shape).
+    The step must NOT apply side effects it cannot discard: on a report,
+    ``new_state`` is dropped and ``state`` is re-used.
+
+    On a report: retry up to ``max_retries`` times from the same
+    pre-step state. If every attempt reports and ``checkpointer`` is
+    given, restore its newest clean checkpoint (``restore_target``
+    supplies the pytree structure/shardings, defaulting to ``state``)
+    and run ONE attempt from there. If that also reports — or there is
+    no checkpoint — raise :class:`UncorrectableStepError` (or, with
+    ``raise_on_failure=False``, return the LAST CLEAN ``state`` with
+    ``metrics=None`` and the report, so the caller owns the policy;
+    neither the unverified ``new_state`` nor metrics computed by a
+    reporting attempt are ever returned).
+
+    Returns ``(new_state, metrics, StepReport)``. ``uncorrectable`` may
+    be anything :func:`ft_sgemm_tpu.checkpoint.total_count` can sum — a
+    scalar, an array, or a whole count pytree.
+    """
+
+    from ft_sgemm_tpu.checkpoint import total_count
+
+    def attempt(s):
+        new_state, metrics, unc = step_fn(s)
+        return new_state, metrics, total_count(unc)
+
+    attempts = 0
+    for _ in range(max_retries + 1):
+        new_state, metrics, unc = attempt(state)
+        attempts += 1
+        if unc == 0:
+            return new_state, metrics, StepReport(attempts - 1, None, 0)
+
+    restored_step = None
+    if checkpointer is not None:
+        restored_step = checkpointer.latest_step
+        if restored_step is not None:
+            target = state if restore_target is None else restore_target
+            state = checkpointer.restore(restored_step, target)
+            new_state, metrics, unc = attempt(state)
+            attempts += 1
+            if unc == 0:
+                return new_state, metrics, StepReport(
+                    attempts - 1, restored_step, 0)
+
+    if raise_on_failure:
+        raise UncorrectableStepError(
+            f"step reported uncorrectable faults through {attempts} "
+            f"attempt(s)"
+            + (f" incl. one from checkpoint step {restored_step}"
+               if restored_step is not None else
+               " and no clean checkpoint was available"))
+    # metrics from a reporting attempt were computed by unverified GEMMs:
+    # suppress them along with new_state.
+    return state, None, StepReport(attempts - 1, restored_step, unc)

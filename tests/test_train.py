@@ -1,0 +1,158 @@
+"""resilient_step: the retry/restore/raise policy over the report channel.
+
+The step stubs model the kernels' clean-or-reported contract exactly:
+they return (new_state, metrics, uncorrectable) and the wrapper must
+never let an unverified new_state escape. One integration test runs the
+real FtDense step shape end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu.train import (
+    StepReport,
+    UncorrectableStepError,
+    resilient_step,
+)
+
+
+def _flaky(fail_times):
+    """A step that reports on its first `fail_times` calls, then is clean.
+    new_state increments only so we can see WHICH attempt's state won."""
+    calls = {"n": 0}
+
+    def step(state):
+        calls["n"] += 1
+        unc = 1 if calls["n"] <= fail_times else 0
+        return state + 1, {"loss": 0.5}, unc
+
+    return step, calls
+
+
+def test_clean_step_passes_through():
+    step, calls = _flaky(0)
+    new_state, metrics, rep = resilient_step(step, 10)
+    assert new_state == 11 and metrics["loss"] == 0.5
+    assert calls["n"] == 1
+    assert rep.retries == 0 and rep.restored_step is None
+
+
+def test_transient_report_retries_from_pre_step_state():
+    step, calls = _flaky(2)
+    new_state, _, rep = resilient_step(step, 10, max_retries=2)
+    assert new_state == 11, "retry must re-run from the PRE-step state"
+    assert calls["n"] == 3 and rep.retries == 2
+
+
+def test_persistent_report_raises_without_checkpointer():
+    step, _ = _flaky(10)
+    with pytest.raises(UncorrectableStepError, match="no clean checkpoint"):
+        resilient_step(step, 10, max_retries=1)
+
+
+def test_persistent_report_restores_then_succeeds(tmp_path):
+    from ft_sgemm_tpu.checkpoint import FtCheckpointer
+
+    state0 = {"w": jnp.asarray([1.0, 2.0])}
+    with FtCheckpointer(tmp_path / "ck") as ck:
+        assert ck.save(7, state0)
+        ck.wait()
+
+        seen = []
+
+        def step(state):
+            seen.append(np.asarray(state["w"]).copy())
+            # Reports until handed the checkpointed state; the "bad"
+            # live state never produces a clean step.
+            bad = float(state["w"][0]) != 1.0
+            return ({"w": state["w"] + 1}, {}, 1 if bad else 0)
+
+        live = {"w": jnp.asarray([99.0, 99.0])}  # corrupted live state
+        new_state, _, rep = resilient_step(
+            step, live, max_retries=1, checkpointer=ck)
+    assert rep.restored_step == 7 and rep.retries == 2
+    np.testing.assert_array_equal(np.asarray(new_state["w"]), [2.0, 3.0])
+    # Attempts: live, live (retry), then restored.
+    assert [s[0] for s in seen] == [99.0, 99.0, 1.0]
+
+
+def test_failure_after_restore_raises(tmp_path):
+    from ft_sgemm_tpu.checkpoint import FtCheckpointer
+
+    always_bad = lambda s: (s, {}, 1)  # noqa: E731
+    with FtCheckpointer(tmp_path / "ck") as ck:
+        assert ck.save(3, {"w": jnp.zeros(2)})
+        ck.wait()
+        with pytest.raises(UncorrectableStepError, match="step 3"):
+            resilient_step(always_bad, {"w": jnp.ones(2)}, max_retries=0,
+                           checkpointer=ck)
+
+
+def test_no_raise_mode_returns_last_clean_state():
+    step, _ = _flaky(10)
+    state, metrics, rep = resilient_step(step, 10, max_retries=1,
+                                         raise_on_failure=False)
+    assert state == 10, "the unverified new_state must never be returned"
+    assert metrics is None, "a reporting attempt's metrics are unverified"
+    assert isinstance(rep, StepReport) and rep.uncorrectable == 1
+
+
+def test_pytree_report_is_summed():
+    """The report channel accepts whole count pytrees (ft_counts + sink),
+    matching the checkpointer's gate."""
+    def step(state):
+        report = {"layer": {"uncorrectable": jnp.asarray([0, 0])},
+                  "bwd": jnp.zeros(2)}
+        return state + 1, {}, report
+
+    new_state, _, rep = resilient_step(step, 1)
+    assert new_state == 2 and rep.retries == 0
+
+
+def test_integration_with_ftdense_step():
+    """The real step shape from examples/train_ft.py, wrapped: clean run
+    (rotating injector, all corrected) → zero retries, state advances."""
+    flax = pytest.importorskip("flax")  # noqa: F841
+    import optax
+
+    from ft_sgemm_tpu import InjectionSpec
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense
+
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    layer = FtDense(128, shape=tile, inject=inj, inject_bwd=inj)
+    x = jax.random.normal(jax.random.key(0), (128, 128)) * 0.3
+    y = jnp.roll(x, 1, axis=1)
+    params = layer.init(jax.random.key(1), x, jnp.zeros(2))["params"]
+    tx = optax.sgd(1e-2)
+    state = {"params": params, "opt": tx.init(params)}
+
+    @jax.jit
+    def raw_step(state):
+        def loss_fn(p, sink):
+            out, mut = layer.apply({"params": p}, x, sink,
+                                   mutable=[COUNTS_COLLECTION])
+            counts = mut[COUNTS_COLLECTION]
+            return jnp.mean((out - y) ** 2), counts
+
+        (loss, counts), (g, bwd) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(
+                state["params"], jnp.zeros(2))
+        upd, opt = tx.update(g, state["opt"])
+        unc = sum(jnp.sum(v) for p, v in
+                  jax.tree_util.tree_leaves_with_path(counts)
+                  if "uncorrectable" in str(p)) + bwd[1].astype(jnp.int32)
+        new = {"params": optax.apply_updates(state["params"], upd),
+               "opt": opt}
+        return new, {"loss": loss, "det": counts}, unc
+
+    new_state, metrics, rep = resilient_step(raw_step, state)
+    assert rep.retries == 0 and rep.uncorrectable == 0
+    assert float(metrics["loss"]) > 0
+    changed = jax.tree.map(
+        lambda a, b: bool(jnp.any(a != b)),
+        state["params"], new_state["params"])
+    assert any(jax.tree.leaves(changed))
