@@ -73,6 +73,7 @@ def main():
     from ft_sgemm_tpu import InjectionSpec
     from ft_sgemm_tpu.configs import KernelShape
     from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense
+    from ft_sgemm_tpu.checkpoint import total_count
     from ft_sgemm_tpu.utils import generate_random_matrix
 
     tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
@@ -131,10 +132,8 @@ def main():
     try:
         for i in range(start, args.steps):
             params, opt_state, loss, counts, bwd = step(params, opt_state)
-            leaves = jax.tree_util.tree_leaves_with_path(counts)
-            det = sum(int(v) for p, v in leaves if "detections" in str(p))
-            unc = sum(int(v) for p, v in leaves
-                      if "uncorrectable" in str(p))
+            det = total_count(counts, "detections")
+            unc = total_count(counts, "uncorrectable")
             bwd_det, bwd_unc = int(bwd[0]), int(bwd[1])
             print(f"{i:>5} {float(loss):>12.6f} {det:>9} {unc:>14} "
                   f"{bwd_det:>8} {bwd_unc:>8}")

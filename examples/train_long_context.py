@@ -71,6 +71,7 @@ def main():
     from ft_sgemm_tpu.nn import (
         COUNTS_COLLECTION, FtDense, FtRingSelfAttention)
     from ft_sgemm_tpu.parallel import make_ring_mesh
+    from ft_sgemm_tpu.checkpoint import total_count
 
     mesh = make_ring_mesh(args.devices)
     tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
@@ -140,11 +141,9 @@ def main():
     try:
         for i in range(start, args.steps):
             params, opt_state, loss, counts, bwd = step(params, opt_state)
-            leaves = jax.tree_util.tree_leaves_with_path(counts)
-            pick = lambda key: sum(  # noqa: E731
-                int(np.sum(v)) for p, v in leaves if key in str(p))
-            det, flags = pick("detections"), pick("softmax_flags")
-            unc = pick("uncorrectable")
+            det = total_count(counts, "detections")
+            flags = total_count(counts, "softmax_flags")
+            unc = total_count(counts, "uncorrectable")
             bwd_det, bwd_unc = int(bwd[0]), int(bwd[1])
             print(f"{i:>5} {float(loss):>12.6f} {det:>9} {flags:>9} "
                   f"{unc:>14} {bwd_det:>8} {bwd_unc:>8}")

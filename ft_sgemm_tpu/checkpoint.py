@@ -39,11 +39,20 @@ class UncleanStateError(RuntimeError):
     """Refused to checkpoint a state with reported uncorrectable faults."""
 
 
-def total_count(counts: Any) -> int:
-    """Sum every leaf of a count report — scalar, array, or pytree (the
-    ``ft_counts`` collection, a backward sink's ``[det, unc]``, …)."""
-    return int(sum(int(np.sum(np.asarray(leaf)))
-                   for leaf in jax.tree.leaves(counts)))
+def total_count(counts: Any, match: Optional[str] = None) -> int:
+    """Sum a count report's leaves — scalar, array, or pytree (the
+    ``ft_counts`` collection, a backward sink's ``[det, unc]``, …).
+
+    ``match`` restricts the sum to leaves whose tree path contains the
+    substring (e.g. ``"uncorrectable"`` over a full ``ft_counts`` tree);
+    None sums everything. Host-side only (concrete values, not tracers).
+    """
+    if match is None:
+        leaves = jax.tree.leaves(counts)
+    else:
+        leaves = [v for p, v in jax.tree_util.tree_leaves_with_path(counts)
+                  if match in str(p)]
+    return int(sum(int(np.sum(np.asarray(leaf))) for leaf in leaves))
 
 
 class FtCheckpointer:

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
+from ft_sgemm_tpu.checkpoint import total_count
+
 __all__ = ["UncorrectableStepError", "StepReport", "resilient_step"]
 
 
@@ -90,8 +92,6 @@ def resilient_step(
     be anything :func:`ft_sgemm_tpu.checkpoint.total_count` can sum — a
     scalar, an array, or a whole count pytree.
     """
-
-    from ft_sgemm_tpu.checkpoint import total_count
 
     def attempt(s):
         new_state, metrics, unc = step_fn(s)
