@@ -50,8 +50,16 @@ def total_count(counts: Any, match: Optional[str] = None) -> int:
     if match is None:
         leaves = jax.tree.leaves(counts)
     else:
-        leaves = [v for p, v in jax.tree_util.tree_leaves_with_path(counts)
-                  if match in str(p)]
+        with_path = jax.tree_util.tree_leaves_with_path(counts)
+        if with_path and all(not p for p, _ in with_path):
+            # A bare leaf has the empty path: no name can ever match, and
+            # silently returning 0 would read a faulted report as clean —
+            # the exact silent-zero the never-silent contract forbids.
+            raise ValueError(
+                "total_count(match=...) needs a NAMED pytree (dict/"
+                "dataclass); a bare array/scalar has no key paths to "
+                "filter — pass match=None to sum it")
+        leaves = [v for p, v in with_path if match in str(p)]
     return int(sum(int(np.sum(np.asarray(leaf))) for leaf in leaves))
 
 

@@ -54,6 +54,21 @@ def test_strict_mode_raises(tmp_path):
             ck.save(0, _state(), uncorrectable=jnp.asarray(1))
 
 
+def test_total_count_match_filter():
+    from ft_sgemm_tpu.checkpoint import total_count
+
+    tree = {"a": {"uncorrectable": jnp.asarray([2, 1]),
+                  "detections": jnp.asarray(7)}}
+    assert total_count(tree) == 10
+    assert total_count(tree, "uncorrectable") == 3
+    assert total_count(tree, "detections") == 7
+    # A bare leaf has no key paths: filtering it must be loud, never a
+    # silent zero.
+    assert total_count(jnp.asarray([3, 1])) == 4
+    with pytest.raises(ValueError, match="NAMED pytree"):
+        total_count(jnp.asarray([3, 1]), "uncorrectable")
+
+
 def test_save_forwards_orbax_verdict(tmp_path):
     """orbax skips saves at steps <= latest_step; save() must say so
     rather than claiming the state persisted."""

@@ -1,0 +1,53 @@
+"""The examples are product surface: smoke them as real subprocesses.
+
+Each runs a tiny configuration on the CPU backend and must exit 0 with
+the fault columns showing detections > 0 and uncorrectable == 0 — the
+same end-to-end claim the examples document.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+flax = pytest.importorskip("flax")
+optax = pytest.importorskip("optax")
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ)
+    # The conftest's virtual-device settings must not leak in; each
+    # example owns its backend setup.
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, *args], cwd=ROOT, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{args} rc={proc.returncode}\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def test_train_ft_example():
+    out = _run(["examples/train_ft.py", "--cpu", "--steps", "2"])
+    rows = [ln.split() for ln in out.splitlines()
+            if ln.strip().startswith(("0 ", "1 "))]
+    assert len(rows) == 2
+    for row in rows:  # step loss det unc bwd_det bwd_unc
+        assert int(row[2]) > 0 and int(row[3]) == 0
+        assert int(row[4]) > 0 and int(row[5]) == 0
+
+
+def test_train_long_context_example():
+    out = _run(["examples/train_long_context.py", "--devices", "2",
+                "--steps", "1"])
+    rows = [ln.split() for ln in out.splitlines()
+            if ln.strip().startswith("0 ")]
+    assert len(rows) == 1
+    row = rows[0]  # step loss det sm_flags unc bwd_det bwd_unc
+    assert int(row[2]) > 0 and int(row[4]) == 0
+    assert int(row[5]) > 0 and int(row[6]) == 0
