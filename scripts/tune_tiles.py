@@ -42,6 +42,11 @@ CANDIDATES = [
     (768, 768, 512),
     (1024, 512, 256),
     (1024, 1024, 256),
+    # Enabled by vmem_limit_bytes=64MiB (the 16MiB default rejected
+    # these): deeper K amortizes the FT check epilogues further.
+    (512, 512, 2048),
+    (1024, 1024, 512),
+    (1024, 512, 512),
 ]
 
 
