@@ -51,16 +51,52 @@ def total_count(counts: Any, match: Optional[str] = None) -> int:
         leaves = jax.tree.leaves(counts)
     else:
         with_path = jax.tree_util.tree_leaves_with_path(counts)
-        if with_path and all(not p for p, _ in with_path):
-            # A bare leaf has the empty path: no name can ever match, and
-            # silently returning 0 would read a faulted report as clean —
-            # the exact silent-zero the never-silent contract forbids.
+        # EVERY leaf must be reachable through at least one NAMED key
+        # (dict key / attribute): a leaf with only positional keys (bare
+        # array, plain list/tuple, keypath-less registered node) can
+        # never match a name, and silently dropping it from the sum
+        # would read a faulted report as clean — the exact silent-zero
+        # the never-silent contract forbids. (A named tree simply
+        # missing the key still sums to 0: absence of a count category
+        # is a real answer.)
+        def _named(path):
+            return any(isinstance(k, (jax.tree_util.DictKey,
+                                      jax.tree_util.GetAttrKey))
+                       for k in path)
+
+        if not all(_named(p) for p, _ in with_path):
             raise ValueError(
-                "total_count(match=...) needs a NAMED pytree (dict/"
-                "dataclass); a bare array/scalar has no key paths to "
-                "filter — pass match=None to sum it")
+                "total_count(match=...) needs every leaf under a NAMED "
+                "key (dict/dataclass); bare arrays and plain lists/"
+                "tuples have no key names to filter — pass match=None "
+                "to sum them")
         leaves = [v for p, v in with_path if match in str(p)]
     return int(sum(int(np.sum(np.asarray(leaf))) for leaf in leaves))
+
+
+def _gate_total(report: Any) -> int:
+    """Sum an UNCORRECTABLE report for the clean-state gates.
+
+    The gates must see only uncorrectable counts: corrected
+    ``detections`` (and ``softmax_flags``) are the ABFT success case,
+    and summing them would block every save / burn every retry under
+    normal operation. Passing an unfiltered report tree is therefore an
+    ERROR, not a silent starvation: any leaf whose path names another
+    count category is rejected with instructions to filter first.
+    """
+    offending = sorted({
+        str(key) for path, _ in jax.tree_util.tree_leaves_with_path(report)
+        for key in path
+        if any(name in str(key) for name in ("detections", "softmax_flags"))
+    })
+    if offending:
+        raise ValueError(
+            "the clean-state gate takes UNCORRECTABLE counts only, but the "
+            f"report contains {offending} leaves — corrected detections "
+            "are benign and would block every step. Filter first: "
+            "total_count(counts, 'uncorrectable') plus the bwd sink "
+            "gradient's [1] element.")
+    return total_count(report)
 
 
 class FtCheckpointer:
@@ -95,23 +131,29 @@ class FtCheckpointer:
              uncorrectable: Any = 0, force: bool = False) -> bool:
         """Persist ``state`` at ``step`` iff the step verified clean.
 
-        ``uncorrectable`` is the step's report — a scalar, array, or any
-        pytree of counts (e.g. the ``ft_counts`` collection plus the
-        backward sink's ``[det, unc]``); any nonzero leaf sum blocks the
-        save. ``force=True`` bypasses the gate (for states verified by
-        other means). Returns True iff a checkpoint was written.
+        ``uncorrectable`` is the step's UNCORRECTABLE total — a scalar,
+        array, or pytree whose leaves all count uncorrectable intervals
+        (e.g. ``total_count(counts, "uncorrectable") + int(bwd[1])``);
+        any nonzero leaf sum blocks the save. Do NOT pass a full report
+        tree: corrected ``detections`` are the ABFT success case, and a
+        tree containing them is rejected loudly rather than blocking
+        every save. ``force=True`` bypasses the gate (for states
+        verified by other means). Returns True iff a checkpoint was
+        written.
 
         ``state`` must be a pytree CONTAINER (dict/list/dataclass —
         orbax's StandardSave rejects a bare array or scalar).
         """
-        unc = self._total(uncorrectable)
-        if unc and not force:
-            if self._strict:
-                raise UncleanStateError(
-                    f"step {step}: {unc} uncorrectable fault interval(s) "
-                    "reported — refusing to checkpoint unverified state; "
-                    "re-run the step or restore_latest()")
-            return False
+        if not force:  # force bypasses the gate AND its report validation
+            unc = _gate_total(uncorrectable)
+            if unc:
+                if self._strict:
+                    raise UncleanStateError(
+                        f"step {step}: {unc} uncorrectable fault "
+                        "interval(s) reported — refusing to checkpoint "
+                        "unverified state; re-run the step or "
+                        "restore_latest()")
+                return False
         # orbax itself may skip the save (e.g. should_save is False when
         # latest_step >= step after restoring an older step): forward its
         # verdict so "True" really means "written".
@@ -152,7 +194,6 @@ class FtCheckpointer:
     def __exit__(self, *exc):
         self.close()
 
-    _total = staticmethod(total_count)
 
 
 def _as_abstract(x):

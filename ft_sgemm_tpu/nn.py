@@ -329,22 +329,19 @@ class FtRingSelfAttention(nn.Module):
             qk_shape=self.qk_shape, pv_shape=self.pv_shape,
             in_dtype=self.in_dtype, with_counts=True,
             with_bwd_counts=bwd_sink is not None)
-        # Static per-head loop: the ring recurrence is a shard_map over the
-        # sequence axis, so heads are a trace-time loop, not a vmap axis.
-        outs, det, flags, unc = [], 0, 0, 0
-        for h in range(self.num_heads):
-            args = ((q[h], k[h], v[h])
-                    + (() if bwd_sink is None else (bwd_sink,)))
-            res = attn(*args)
-            outs.append(res.out)
-            det = det + res.detections
-            flags = flags + res.softmax_flags
-            unc = unc + res.uncorrectable
+        # vmap over heads COMPOSES with the inner shard_map: every hop
+        # ppermutes the head-stacked K/V block once, so ring rounds stay
+        # 2·(devices) per step instead of multiplying by num_heads (a
+        # per-head Python loop would serialize H full ring passes).
+        args = (q, k, v) + (() if bwd_sink is None else (bwd_sink,))
+        axes = (0, 0, 0) + (() if bwd_sink is None else (None,))
+        res = jax.vmap(attn, in_axes=axes)(*args)
 
-        _sow_counts(self, (("detections", det), ("softmax_flags", flags),
-                           ("uncorrectable", unc)))
+        _sow_counts(self, (("detections", jnp.sum(res.detections)),
+                           ("softmax_flags", jnp.sum(res.softmax_flags)),
+                           ("uncorrectable", jnp.sum(res.uncorrectable))))
 
-        out = jnp.stack(outs, axis=1).reshape(length, qkv)
+        out = jnp.moveaxis(res.out, 0, 1).reshape(length, qkv)
         return FtDense(out_feat, name="out", **dense_kw)(out, bwd_sink)
 
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
-from ft_sgemm_tpu.checkpoint import total_count
+from ft_sgemm_tpu.checkpoint import _gate_total
 
 __all__ = ["UncorrectableStepError", "StepReport", "resilient_step"]
 
@@ -72,10 +72,13 @@ def resilient_step(
 
     ``step_fn(state) -> (new_state, metrics, uncorrectable)`` is the
     caller's (usually jitted) step; ``uncorrectable`` is the step's
-    total report — forward counts plus the ``bwd_sink`` gradient
-    (anything summable; see examples/train_ft.py for the step shape).
-    The step must NOT apply side effects it cannot discard: on a report,
-    ``new_state`` is dropped and ``state`` is re-used.
+    UNCORRECTABLE total only — e.g.
+    ``total_count(counts, "uncorrectable") + bwd[1]`` (corrected
+    ``detections`` are the ABFT success case; a report tree containing
+    them is rejected loudly, since treating benign corrected faults as
+    failures would burn every retry). The step must NOT apply side
+    effects it cannot discard: on a report, ``new_state`` is dropped and
+    ``state`` is re-used.
 
     On a report: retry up to ``max_retries`` times from the same
     pre-step state. If every attempt reports and ``checkpointer`` is
@@ -89,13 +92,13 @@ def resilient_step(
     reporting attempt are ever returned).
 
     Returns ``(new_state, metrics, StepReport)``. ``uncorrectable`` may
-    be anything :func:`ft_sgemm_tpu.checkpoint.total_count` can sum — a
-    scalar, an array, or a whole count pytree.
+    be a scalar, an array, or a pytree — as long as every leaf counts
+    uncorrectable intervals.
     """
 
     def attempt(s):
         new_state, metrics, unc = step_fn(s)
-        return new_state, metrics, total_count(unc)
+        return new_state, metrics, _gate_total(unc)
 
     attempts = 0
     for _ in range(max_retries + 1):

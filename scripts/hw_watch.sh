@@ -82,7 +82,8 @@ run_stage() {  # run_stage <name> <timeout-s> <cmd...>
     touch "$marker"
     echo "[watch] $(date -u +%H:%M:%S) $name OK"
   else
-    echo "[watch] $(date -u +%H:%M:%S) $name FAILED rc=$? (see .bench/${name}.log)"
+    local rc=$?  # BEFORE the $(date) substitution below resets $?
+    echo "[watch] $(date -u +%H:%M:%S) $name FAILED rc=$rc (see .bench/${name}.log)"
     return 1
   fi
 }

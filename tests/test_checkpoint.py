@@ -65,8 +65,45 @@ def test_total_count_match_filter():
     # A bare leaf has no key paths: filtering it must be loud, never a
     # silent zero.
     assert total_count(jnp.asarray([3, 1])) == 4
-    with pytest.raises(ValueError, match="NAMED pytree"):
+    with pytest.raises(ValueError, match="NAMED"):
         total_count(jnp.asarray([3, 1]), "uncorrectable")
+
+
+def test_gate_rejects_unfiltered_report_trees(tmp_path):
+    """Corrected detections are the SUCCESS case: a gate fed the full
+    report tree must reject it loudly, not block every save forever."""
+    with FtCheckpointer(tmp_path / "ck") as ck:
+        report = {"layer0": {"detections": jnp.asarray(4),
+                             "uncorrectable": jnp.asarray(0)}}
+        with pytest.raises(ValueError, match="UNCORRECTABLE counts only"):
+            ck.save(0, _state(), uncorrectable=report)
+
+
+def test_total_count_match_rejects_unnamed_sequences():
+    from ft_sgemm_tpu.checkpoint import total_count
+
+    with pytest.raises(ValueError, match="NAMED"):
+        total_count([jnp.asarray(3), jnp.asarray(1)], "uncorrectable")
+    # Mixed trees: a name-less leaf anywhere must be loud, not silently
+    # dropped from the filtered sum.
+    with pytest.raises(ValueError, match="NAMED"):
+        total_count(({"uncorrectable": jnp.asarray(1)}, jnp.asarray(2)),
+                    "uncorrectable")
+    # Named path through a dict of lists is fine (the dict key names it).
+    assert total_count({"uncorrectable": [jnp.asarray(1),
+                                          jnp.asarray(2)]},
+                       "uncorrectable") == 3
+
+
+def test_force_bypasses_gate_validation_too(tmp_path):
+    """force=True is the documented escape hatch for externally-verified
+    states: it must skip the unfiltered-report rejection as well."""
+    with FtCheckpointer(tmp_path / "ck") as ck:
+        report = {"detections": jnp.asarray(4),
+                  "uncorrectable": jnp.asarray(1)}
+        assert ck.save(0, _state(), uncorrectable=report, force=True)
+        ck.wait()
+        assert ck.latest_step == 0
 
 
 def test_save_forwards_orbax_verdict(tmp_path):

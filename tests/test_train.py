@@ -100,15 +100,26 @@ def test_no_raise_mode_returns_last_clean_state():
 
 
 def test_pytree_report_is_summed():
-    """The report channel accepts whole count pytrees (ft_counts + sink),
+    """The report channel accepts pytrees of uncorrectable counts,
     matching the checkpointer's gate."""
     def step(state):
         report = {"layer": {"uncorrectable": jnp.asarray([0, 0])},
-                  "bwd": jnp.zeros(2)}
+                  "bwd_unc": jnp.asarray(0)}
         return state + 1, {}, report
 
     new_state, _, rep = resilient_step(step, 1)
     assert new_state == 2 and rep.retries == 0
+
+
+def test_unfiltered_report_tree_is_rejected():
+    """A report containing corrected-detection leaves must error loudly —
+    treating benign corrected faults as failures would burn every retry."""
+    def step(state):
+        return state, {}, {"detections": jnp.asarray(4),
+                           "uncorrectable": jnp.asarray(0)}
+
+    with pytest.raises(ValueError, match="UNCORRECTABLE counts only"):
+        resilient_step(step, 1)
 
 
 def test_integration_with_ftdense_step():
