@@ -893,6 +893,19 @@ def _worker_stages(rec):
 
     import jax
 
+    # Persistent executable cache: tunnel windows are ~20 min; a relaunch
+    # or a later stage must not respend them recompiling the same
+    # kernels. Best effort — an axon backend that can't serialize
+    # executables just skips caching.
+    try:
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench", "jaxcache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — caching is never worth a crash
+        pass
+
     def probe():
         devs = jax.devices()
         x = jax.device_put(np.zeros((8, 128), np.float32))

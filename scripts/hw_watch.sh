@@ -12,7 +12,11 @@
 # _code_version_key) is unchanged. Delete markers to force a re-run.
 
 cd "$(dirname "$0")/.." || exit 1
-mkdir -p .bench
+mkdir -p .bench .bench/jaxcache
+# Persistent executable cache for every stage (same dir bench.py's worker
+# configures): re-runs across windows skip identical Mosaic compiles.
+export JAX_COMPILATION_CACHE_DIR="$PWD/.bench/jaxcache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5
 
 probe() {
   timeout 120 python -c "
