@@ -3,6 +3,7 @@
 from ft_sgemm_tpu.parallel.multihost import (
     initialize,
     make_multihost_mesh,
+    make_multihost_ring_mesh,
     multihost_ft_sgemm,
 )
 from ft_sgemm_tpu.parallel.ring import (
@@ -22,6 +23,7 @@ __all__ = [
     "initialize",
     "make_mesh",
     "make_multihost_mesh",
+    "make_multihost_ring_mesh",
     "multihost_ft_sgemm",
     "make_ring_mesh",
     "make_ring_ft_attention_diff",

@@ -106,3 +106,49 @@ def test_initialize_checks_state_not_message(monkeypatch):
     monkeypatch.setattr(mh.jax.distributed, "initialize", fails)
     with pytest.raises(RuntimeError, match="coordinator said"):
         mh.initialize()
+
+
+def test_multihost_ring_mesh_long_context():
+    """The pod-scale long-context mesh: host-major 1-D ring over every
+    device; the unchanged ring-attention family (and the flax ring
+    module) runs over it, oracle-gated with injection on."""
+    import jax
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu import attention_reference
+    from ft_sgemm_tpu.parallel import (
+        make_multihost_ring_mesh, ring_ft_attention)
+
+    mesh = make_multihost_ring_mesh()
+    dnum = mesh.shape["x"]
+    assert dnum == len(jax.devices())
+    # Host-major: ring order is sorted by (process_index, id).
+    ids = [d.id for d in mesh.devices.flat]
+    assert ids == sorted(ids)
+
+    rng = np.random.default_rng(11)
+    lq, dh = 64 * dnum, 32
+    q = generate_random_matrix(lq, dh, rng=rng)
+    k = generate_random_matrix(lq, dh, rng=rng)
+    v = generate_random_matrix(lq, dh, rng=rng)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = ring_ft_attention(q, k, v, mesh, causal=True, inject=inj,
+                            qk_shape=TILE, pv_shape=TILE)
+    want = np.asarray(attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.out), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the multihost ring"
+    assert int(res.detections) > 0
+    flax = pytest.importorskip("flax")  # noqa: F841
+
+    from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtRingSelfAttention
+
+    mod = FtRingSelfAttention(mesh=mesh, num_heads=2, causal=True,
+                              inject=inj, dense_shape=TILE, qk_shape=TILE,
+                              pv_shape=TILE)
+    x = jnp.asarray(generate_random_matrix(lq, 64, rng=rng))
+    variables = mod.init(jax.random.key(1), x)
+    out, mut = mod.apply(variables, x, mutable=[COUNTS_COLLECTION])
+    assert out.shape == x.shape
+    assert int(mut[COUNTS_COLLECTION]["uncorrectable"]) == 0
+    assert int(mut[COUNTS_COLLECTION]["detections"]) > 0
