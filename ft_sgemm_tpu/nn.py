@@ -423,6 +423,12 @@ class FtTransformer(nn.Module):
     qk_shape: KernelShape = QK_SHAPE
     pv_shape: KernelShape = PV_SHAPE
     in_dtype: str = "float32"
+    # Rematerialize each block's forward during backward (jax.checkpoint):
+    # activation memory drops from O(layers) block-internals to O(layers)
+    # residual-stream tensors — the HBM-for-FLOPs trade long sequences
+    # need. The replayed forward GEMMs run through the same FT kernels,
+    # so the recompute is protected like the original pass.
+    remat: bool = False
     inject: Optional[InjectionSpec] = None
     inject_bwd: Optional[InjectionSpec] = None
 
@@ -442,8 +448,13 @@ class FtTransformer(nn.Module):
                 return (FtTransformerBlock(name="block", **block_kw)(
                     carry, bwd_sink), None)
 
+        # prevent_cse=False: scan already provides the barrier remat's
+        # default CSE protection exists for; keeping it would wrap every
+        # layer's replay in optimization barriers that inhibit fusion —
+        # on exactly the deep-stack path this flag targets.
+        step = nn.remat(_Step, prevent_cse=False) if self.remat else _Step
         scan = nn.scan(
-            _Step,
+            step,
             # ft_counts stacks with a leading layer axis (like flax's
             # "intermediates"): per-layer fault visibility, and readers
             # that sum leaves (the step-level re-run gate) are unchanged.

@@ -120,18 +120,22 @@ def make_multihost_ring_mesh() -> Mesh:
     Use with the ring family unchanged (``ring_ft_attention``,
     :func:`ft_sgemm_tpu.parallel.make_ring_ft_attention_diff`,
     ``ring_ft_sgemm``, :class:`ft_sgemm_tpu.nn.FtRingSelfAttention`):
-    they only need a mesh with axis ``"x"``, and THIS constructor decides
-    which of its ``ppermute`` hops cross DCN. Host-major ordering makes
-    ring neighbors process-contiguous, so of the D hops in a full ring
-    cycle exactly ``process_count`` are host boundaries riding DCN and
-    the rest stay on intra-host ICI — the minimum any single ring over
-    P processes can have. (The reference has no distributed anything,
-    SURVEY.md §5; this extends the first-class long-context axis to pod
-    scale. Single-process runs get the same mesh ``make_ring_mesh``
-    would build, which is how tests cover it without a pod.)
+    they only need a mesh with axis ``"x"``, and the mesh constructor
+    decides which of its ``ppermute`` hops cross DCN. Host-major
+    ordering makes ring neighbors process-contiguous, so of the D hops
+    in a full ring cycle exactly ``process_count`` are host boundaries
+    riding DCN and the rest stay on intra-host ICI — the minimum any
+    single ring over P processes can have. (The reference has no
+    distributed anything, SURVEY.md §5; this extends the first-class
+    long-context axis to pod scale.)
+
+    The ordering lives in :func:`ft_sgemm_tpu.parallel.make_ring_mesh`
+    (every ring is host-major); this alias simply documents and pins
+    the all-devices pod-scale usage.
     """
-    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    return Mesh(np.asarray(devs), ("x",))
+    from ft_sgemm_tpu.parallel.ring import make_ring_mesh
+
+    return make_ring_mesh()
 
 
 def _check_divisible(name, dim, parts, axis):

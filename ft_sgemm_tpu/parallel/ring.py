@@ -41,8 +41,16 @@ from ft_sgemm_tpu.parallel.sharded import shard_map
 
 
 def make_ring_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """1-D ring mesh over the first n devices (ICI ring on real pods)."""
-    devs = jax.devices()
+    """1-D ring mesh over the first n devices (ICI ring on real pods).
+
+    Host-major ordering (sorted by ``(process_index, id)``): ring
+    neighbors are process-contiguous, so on a multi-process pod a full
+    ``ppermute`` cycle crosses DCN exactly ``process_count`` times — the
+    minimum any single ring over P processes can have — and every other
+    hop stays on ICI. Single-process ordering is unchanged
+    (``jax.devices()`` is already id-sorted there).
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     n = n_devices or len(devs)
     import numpy as np
 
