@@ -360,6 +360,11 @@ class FtTransformerBlock(nn.Module):
     A stack of these blocks is a fault-tolerant transformer; thread one
     ``bwd_sink`` through every block to fold all backward-GEMM reports
     into a single step-level ``[detections, uncorrectable]`` gradient.
+
+    ``ring_mesh`` switches the mixer to :class:`FtRingSelfAttention`
+    over that mesh — a long-context transformer block is then a config
+    flag, not a rewrite (inputs must be unbatched ``(L, D)``, the ring
+    module's contract).
     """
 
     num_heads: int
@@ -372,6 +377,7 @@ class FtTransformerBlock(nn.Module):
     qk_shape: KernelShape = QK_SHAPE
     pv_shape: KernelShape = PV_SHAPE
     in_dtype: str = "float32"
+    ring_mesh: Optional[Mesh] = None  # sequence-parallel attention core
     inject: Optional[InjectionSpec] = None
     inject_bwd: Optional[InjectionSpec] = None
 
@@ -381,12 +387,17 @@ class FtTransformerBlock(nn.Module):
         kw = dict(strategy=self.strategy, threshold=self.threshold,
                   bwd_threshold=self.bwd_threshold,
                   in_dtype=self.in_dtype)
-        h = nn.LayerNorm(name="ln_attn")(x)
-        h = FtSelfAttention(
+        attn_kw = dict(
             num_heads=self.num_heads, causal=self.causal,
             dense_shape=self.dense_shape, qk_shape=self.qk_shape,
             pv_shape=self.pv_shape, inject=self.inject,
-            inject_bwd=self.inject_bwd, name="attn", **kw)(h, bwd_sink)
+            inject_bwd=self.inject_bwd, name="attn", **kw)
+        h = nn.LayerNorm(name="ln_attn")(x)
+        if self.ring_mesh is not None:
+            h = FtRingSelfAttention(mesh=self.ring_mesh,
+                                    **attn_kw)(h, bwd_sink)
+        else:
+            h = FtSelfAttention(**attn_kw)(h, bwd_sink)
         x = x + h
         h = nn.LayerNorm(name="ln_mlp")(x)
         mlp_kw = dict(shape=self.dense_shape, inject=self.inject,
@@ -423,6 +434,7 @@ class FtTransformer(nn.Module):
     qk_shape: KernelShape = QK_SHAPE
     pv_shape: KernelShape = PV_SHAPE
     in_dtype: str = "float32"
+    ring_mesh: Optional[Mesh] = None  # sequence-parallel attention cores
     # Rematerialize each block's forward during backward (jax.checkpoint):
     # activation memory drops from O(layers) block-internals to O(layers)
     # residual-stream tensors — the HBM-for-FLOPs trade long sequences
@@ -440,6 +452,7 @@ class FtTransformer(nn.Module):
             threshold=self.threshold, bwd_threshold=self.bwd_threshold,
             dense_shape=self.dense_shape, qk_shape=self.qk_shape,
             pv_shape=self.pv_shape, in_dtype=self.in_dtype,
+            ring_mesh=self.ring_mesh,
             inject=self.inject, inject_bwd=self.inject_bwd)
 
         class _Step(nn.Module):
