@@ -68,8 +68,7 @@ def main():
 
     from ft_sgemm_tpu import InjectionSpec
     from ft_sgemm_tpu.configs import KernelShape
-    from ft_sgemm_tpu.nn import (
-        COUNTS_COLLECTION, FtDense, FtRingSelfAttention)
+    from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtTransformerBlock
     from ft_sgemm_tpu.parallel import make_ring_mesh
     from ft_sgemm_tpu.checkpoint import total_count
 
@@ -82,14 +81,14 @@ def main():
     class LongModel(nn.Module):
         @nn.compact
         def __call__(self, x, bwd_sink):
-            h = FtRingSelfAttention(
-                mesh=mesh, num_heads=2, causal=True, inject=inject,
-                inject_bwd=inject, dense_shape=tile, qk_shape=tile,
+            # ring_mesh swaps the block's mixer to the sequence-parallel
+            # ring attention core — the long-context transformer is a
+            # config flag (ft_sgemm_tpu.nn.FtTransformerBlock docstring).
+            return FtTransformerBlock(
+                num_heads=2, mlp_ratio=2, causal=True,
+                ring_mesh=mesh, inject=inject, inject_bwd=inject,
+                dense_shape=tile, qk_shape=tile,
                 pv_shape=tile)(x, bwd_sink)
-            x = x + h
-            h = jnp.tanh(FtDense(d_model, shape=tile, inject=inject,
-                                 name="mlp")(x, bwd_sink))
-            return h
 
     rng = np.random.default_rng(10)
     x = jnp.asarray(rng.normal(size=(length, d_model)) * 0.3,
