@@ -1,0 +1,101 @@
+"""Summarize banked bench records into a RESULTS-ready table.
+
+Reads `.bench/records_*.jsonl` (the fsync'd stage records bench.py's
+worker appends; see bench.py's module docstring) and prints, per records
+file: the backend identity, every measured stage with GFLOPS and derived
+ratios, and the errors — so a scarce tunnel window's yield can be read
+(and pasted into RESULTS.md) at a glance.
+
+Usage: python scripts/summarize_bench.py [records.jsonl ...]
+(defaults to every .bench/records_*.jsonl, newest first)
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def _load(path):
+    vals, errs = {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("ok"):
+                vals[rec["name"]] = rec.get("value")
+            else:
+                errs[rec["name"]] = str(rec.get("error", ""))[:200]
+    return vals, errs
+
+
+# Stages whose value is a plain number but NOT a GFLOPS reading.
+_SCALAR_STAGES = {"injected_faults_per_tile"}
+# bf16 stages compare against bf16_xla, not the f32 xla_dot.
+_BF16_STAGES = {"bf16_plain", "bf16_abft", "bf16_xla"}
+
+
+def _fmt(v, name=""):
+    if isinstance(v, dict):
+        g = v.get("gflops")
+        s = v.get("strategy")
+        if g is not None:
+            return f"{g:10.1f} GFLOPS" + (f"  [{s}]" if s else "")
+        return json.dumps(v)
+    if isinstance(v, (int, float)):
+        if name in _SCALAR_STAGES:
+            return f"{v:10g}"
+        return f"{v:10.1f} GFLOPS"
+    return str(v)
+
+
+def summarize(path):
+    vals, errs = _load(path)
+    print(f"== {os.path.basename(path)}")
+    backend = vals.get("backend")
+    if backend:
+        print(f"   backend: {backend}")
+    ratio_base = vals.get("xla_dot")
+    for name, v in vals.items():
+        if name in ("backend", "_reset_token"):
+            continue
+        line = f"   {name:34s} {_fmt(v, name)}"
+        g = v.get("gflops") if isinstance(v, dict) else (
+            v if isinstance(v, (int, float)) else None)
+        if (g and isinstance(ratio_base, (int, float)) and ratio_base
+                and name not in _SCALAR_STAGES
+                and name not in _BF16_STAGES):
+            line += f"  ({g / ratio_base * 100:5.1f}% of xla_dot)"
+        print(line)
+    bf = vals.get("bf16_xla")
+    for name in ("bf16_plain", "bf16_abft"):
+        v = vals.get(name)
+        if isinstance(v, (int, float)) and isinstance(bf, (int, float)) and bf:
+            print(f"   {name + ' vs bf16 dot':34s} {v / bf * 100:9.1f}%")
+    for name, e in errs.items():
+        first = e.splitlines()[0] if e else ""
+        print(f"   {name:34s} ERROR: {first[:90]}")
+    print()
+
+
+def main():
+    paths = sys.argv[1:] or sorted(
+        glob.glob(os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            ".bench", "records_*.jsonl")),
+        key=os.path.getmtime, reverse=True)
+    if not paths:
+        print("no records files found under .bench/")
+        return 1
+    for p in paths:
+        summarize(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
