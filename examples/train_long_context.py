@@ -114,7 +114,12 @@ def main():
         target = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep),
             {"params": params, "opt_state": opt_state})
-        latest, restored = ckpt.restore_latest(target)
+        try:
+            latest, restored = ckpt.restore_latest(target)
+        except Exception as e:  # noqa: BLE001 — stale-tree checkpoints
+            print(f"checkpoint in {args.ckpt} does not match this model "
+                  f"({type(e).__name__}); starting fresh", file=sys.stderr)
+            latest = None
         if latest is not None:
             params, opt_state = restored["params"], restored["opt_state"]
             start = latest + 1

@@ -207,4 +207,5 @@ def multihost_ft_sgemm(
     return FtSgemmResult(out, det, unc)
 
 
-__all__ = ["initialize", "make_multihost_mesh", "multihost_ft_sgemm"]
+__all__ = ["initialize", "make_multihost_mesh", "make_multihost_ring_mesh",
+           "multihost_ft_sgemm"]

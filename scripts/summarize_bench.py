@@ -11,27 +11,23 @@ Usage: python scripts/summarize_bench.py [records.jsonl ...]
 """
 
 import glob
+import importlib.util
 import json
 import os
 import sys
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _load(path):
-    vals, errs = {}, {}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if rec.get("ok"):
-                vals[rec["name"]] = rec.get("value")
-            else:
-                errs[rec["name"]] = str(rec.get("error", ""))[:200]
-    return vals, errs
+    """Parse a records file with bench.py's own loader — the canonical
+    semantics (later lines win, ok pops a stale error, torn writes and
+    stray lines skipped, errors="replace" decoding) live there."""
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench._read_records(path)
 
 
 # Stages whose value is a plain number but NOT a GFLOPS reading.
@@ -62,12 +58,16 @@ def summarize(path):
         print(f"   backend: {backend}")
     ratio_base = vals.get("xla_dot")
     for name, v in vals.items():
-        if name in ("backend", "_reset_token"):
+        # Tombstones (backend_guard/worker_crash "cleared: ..." markers)
+        # are provenance, not measurements.
+        if name in ("backend", "_reset_token", "backend_guard",
+                    "worker_crash"):
             continue
         line = f"   {name:34s} {_fmt(v, name)}"
         g = v.get("gflops") if isinstance(v, dict) else (
             v if isinstance(v, (int, float)) else None)
         if (g and isinstance(ratio_base, (int, float)) and ratio_base
+                and name != "xla_dot"
                 and name not in _SCALAR_STAGES
                 and name not in _BF16_STAGES):
             line += f"  ({g / ratio_base * 100:5.1f}% of xla_dot)"
@@ -78,7 +78,7 @@ def summarize(path):
         if isinstance(v, (int, float)) and isinstance(bf, (int, float)) and bf:
             print(f"   {name + ' vs bf16 dot':34s} {v / bf * 100:9.1f}%")
     for name, e in errs.items():
-        first = e.splitlines()[0] if e else ""
+        first = str(e).splitlines()[0] if e else ""
         print(f"   {name:34s} ERROR: {first[:90]}")
     print()
 
