@@ -378,21 +378,16 @@ def _emit_locked(values, errors, extra_errors=None):
     errors.update(extra_errors or {})
 
     ft_rec = values.get("ft_headline")
-    ft = ft_rec.get("gflops") if isinstance(ft_rec, dict) else ft_rec
-    strategy = (ft_rec.get("strategy") if isinstance(ft_rec, dict)
-                else None)
+    # What the weighted ladder itself measured (pre-override, for context).
+    ladder_gflops = ft_rec.get("gflops") if isinstance(ft_rec, dict) else ft_rec
+    ladder_strategy = (ft_rec.get("strategy") if isinstance(ft_rec, dict)
+                       else None)
     # The headline is the BEST measured correcting fused-ABFT variant —
     # rowcol and fused qualify as "abft_kernel_huge" exactly as the
     # weighted ladder does (all correct injected faults in-kernel; the
     # reference's flagship row is likewise its best FT kernel). Every
     # per-variant number stays visible in context.
-    ladder_gflops = ft  # what the weighted ladder itself measured
-    ladder_strategy = strategy
-    for stage, label in (("ft_rowcol", "rowcol"),
-                         ("ft_fused", "fused (MXU-augmented)")):
-        v = values.get(stage)
-        if isinstance(v, (int, float)) and (ft is None or v > ft):
-            ft, strategy = v, label
+    ft, strategy = _best_measurement(values)
     context = {}
     if strategy:
         context["strategy"] = strategy
@@ -454,6 +449,14 @@ def _emit_locked(values, errors, extra_errors=None):
         and values.get(k) == v)
     if resumed:
         context["resumed_stages"] = resumed
+    if ft is None:
+        # Honest pointer, not a substitute: value stays null (this run
+        # measured nothing), but the artifact names the newest banked
+        # measurement from ANY code version so the reader knows a
+        # driver-protocol number exists and where its provenance lives.
+        stale = _newest_stale_headline()
+        if stale:
+            context["last_measured_other_code_version"] = stale
     context["errors"] = errors
     print(json.dumps({
         "metric": "abft_kernel_huge_gflops_4096",
@@ -464,6 +467,57 @@ def _emit_locked(values, errors, extra_errors=None):
         "context": context,
     }), flush=True)
     return 0 if ft is not None else 1
+
+
+def _best_measurement(vals):
+    """Best measured correcting variant in a records dict: the weighted
+    ladder's own headline, overridden by a faster rowcol/fused stage.
+    Returns ``(gflops_or_None, strategy_label)`` — one vocabulary for
+    both the live emit and the stale-provenance scan."""
+    rec = vals.get("ft_headline")
+    ft = rec.get("gflops") if isinstance(rec, dict) else rec
+    strategy = rec.get("strategy") if isinstance(rec, dict) else None
+    for stage, label in (("ft_rowcol", "rowcol"),
+                         ("ft_fused", "fused (MXU-augmented)")):
+        v = vals.get(stage)
+        if isinstance(v, (int, float)) and (ft is None or v > ft):
+            ft, strategy = v, label
+    return ft, strategy
+
+
+def _newest_stale_headline():
+    """Newest same-SIZE records file (any code version) with a measured
+    headline.
+
+    Returns ``{"file", "gflops", "strategy"}`` or None. Provenance only —
+    the caller must NOT promote it into ``value`` (it was measured under
+    different code; RESULTS.md carries the full story). The current run's
+    own records file is excluded: its values are already the emit's
+    input, and labeling them "other code version" would be false."""
+    try:
+        base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench")
+        current = os.path.basename(_RECORDS_PATH) if _RECORDS_PATH else None
+        stamped = []
+        for name in os.listdir(base):
+            if (not name.startswith("records_")
+                    or not name.endswith(f"_{SIZE}.jsonl")
+                    or name == current):
+                continue
+            try:  # a concurrent prune may unlink between listdir and stat
+                stamped.append((os.path.getmtime(os.path.join(base, name)),
+                                name))
+            except OSError:
+                continue
+        for _, name in sorted(stamped, reverse=True):
+            vals, _ = _read_records(os.path.join(base, name))
+            g, strategy = _best_measurement(vals)
+            if isinstance(g, (int, float)):
+                return {"gflops": round(float(g), 1),
+                        "strategy": strategy, "file": name}
+    except OSError:
+        pass
+    return None
 
 
 def _emit_from_disk(extra_errors=None):
