@@ -1,0 +1,31 @@
+"""summarize_bench renders banked records with bench.py's semantics."""
+
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_summarizer_handles_resume_artifacts(tmp_path):
+    p = tmp_path / "records_key_4096.jsonl"
+    with open(p, "w") as f:
+        f.write("42\n")  # stray scalar line (resumed-file artifact)
+        f.write('{"name":"backend","ok":true,"value":'
+                '{"backend":"tpu","device":"d","num_devices":1}}\n')
+        f.write('{"name":"xla_dot","ok":true,"value":32000.0}\n')
+        f.write('{"name":"ft_rowcol","ok":false,"error":"skipped"}\n')
+        f.write('{"name":"ft_rowcol","ok":true,"value":25600.0}\n')
+        f.write('{"name":"backend_guard","ok":true,"value":"cleared: x"}\n')
+    with open(p, "ab") as f:
+        f.write(b'{"name":"torn","ok":true,"value":"\xc3')  # torn write
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(p)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "ft_rowcol" in out.stdout and "25600.0" in out.stdout
+    assert "80.0% of xla_dot" in out.stdout
+    # Later ok wins: the superseded error must not be reported.
+    assert "ERROR" not in out.stdout
+    # Tombstones are provenance, not measurement rows.
+    assert "backend_guard" not in out.stdout
