@@ -10,13 +10,105 @@
 # A stage that completes writes a .bench/done_<stage>_<key> marker and is
 # not re-run while the measurement-relevant code (bench.py's
 # _code_version_key) is unchanged. Delete markers to force a re-run.
+#
+# The script self-supervises: the top-level invocation only restarts the
+# inner probe loop when it dies (round 4 lost a window to a watcher whose
+# log just stopped at 05:06 with nothing recording that it was dead).
+# Liveness is observable two ways: an epoch timestamp is written to
+# .bench/watch.hb every probe cycle AND at every stage start (staleness
+# while healthy is therefore bounded by the longest single stage budget,
+# 2400 s — not the multi-hour stage-list total), and every inner-loop
+# exit is logged with its rc before the 60 s re-arm. The supervisor runs the inner loop as a
+# background child and waits on it, so INT/TERM to the supervisor pid is
+# handled immediately (bash defers traps while a FOREGROUND child runs)
+# and is forwarded to the child's whole process group.
 
-cd "$(dirname "$0")/.." || exit 1
+# Resolve our own absolute path BEFORE cd: the supervisor re-execs
+# "$self" after the cd, and a relative $0 (invoked as e.g.
+# `cd /root && bash repo/scripts/hw_watch.sh`) would resolve against the
+# new cwd, fail rc=127, and leave the supervisor re-arming forever
+# without ever running a stage.
+self=$(readlink -f "$0") || exit 1
+cd "$(dirname "$self")/.." || exit 1
 mkdir -p .bench .bench/jaxcache
+
+if [ "${HW_WATCH_INNER:-}" != 1 ]; then
+  child=
+  on_sig() {
+    echo "[watch-supervisor] $(date -u +%H:%M:%S) terminated by signal"
+    if [ -n "$child" ]; then
+      kill -- -"$child" 2>/dev/null || kill "$child" 2>/dev/null
+    fi
+    exit 130
+  }
+  trap on_sig INT TERM
+  echo "[watch-supervisor] $(date -u +%H:%M:%S) armed (pid $$)"
+  while true; do
+    # setsid: the inner loop gets its own process group, so on_sig can
+    # kill the stage subprocesses (python/timeout) along with it.
+    HW_WATCH_INNER=1 setsid bash "$self" &
+    child=$!
+    wait "$child"
+    rc=$?
+    child=
+    if [ "$rc" = 0 ]; then
+      echo "[watch-supervisor] $(date -u +%H:%M:%S) inner loop finished: all stages banked"
+      exit 0
+    fi
+    echo "[watch-supervisor] $(date -u +%H:%M:%S) inner loop DIED rc=$rc; re-arming in 60s"
+    sleep 60 &
+    wait $!
+  done
+fi
 # Persistent executable cache for every stage (same dir bench.py's worker
 # configures): re-runs across windows skip identical Mosaic compiles.
 export JAX_COMPILATION_CACHE_DIR="$PWD/.bench/jaxcache"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5
+
+# THE stage list — the single source for the run sequence, the window-open
+# plan, and the all-banked check. Per-stage command/timeout/script live in
+# the stage_cmd/stage_timeout/stage_script tables below.
+STAGES="bench validate gen detect attn tune_bf16_ft sweep"
+
+stage_cmd() {
+  case $1 in
+    # External timeout must exceed bench.py's own 900 s deadline, or a
+    # slow-but-successful run gets SIGTERM'd from outside and the stage
+    # is never marked done.
+    bench) echo "python bench.py" ;;
+    validate) echo "python scripts/validate_tpu.py 4096 --full --bf16" ;;
+    gen) echo "python -m ft_sgemm_tpu.codegen.gen all && python -m ft_sgemm_tpu.codegen.gen huge 0 --dtype=bfloat16 && python -m ft_sgemm_tpu.codegen.gen huge 1 --dtype=bfloat16" ;;
+    detect) echo "python scripts/detection_study.py 2048" ;;
+    attn) echo "python scripts/bench_attention.py" ;;
+    tune_bf16_ft) echo "python scripts/tune_tiles.py 4096 --ft --bf16" ;;
+    # Last: the full 14-row driver sweep (VERDICT r4 #6 — RESULTS.md's
+    # table is round-1/2 kernels). Longest stage; every measured cell is
+    # flushed to the log immediately, so a tunnel drop mid-sweep still
+    # leaves citable partial rows in .bench/sweep.log. --no-verify: the
+    # verify pass is covered by the validate stage; a ~20-min window
+    # should spend itself on table cells.
+    sweep) echo "python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 --mintime=0.5 --no-verify" ;;
+  esac
+}
+
+stage_timeout() {
+  case $1 in
+    bench) echo 980 ;;
+    validate | tune_bf16_ft) echo 1200 ;;
+    sweep) echo 2400 ;;
+    *) echo 900 ;;
+  esac
+}
+
+stage_script() {  # the stage's own script ('' if none)
+  case $1 in
+    validate) echo scripts/validate_tpu.py ;;
+    detect) echo scripts/detection_study.py ;;
+    attn) echo scripts/bench_attention.py ;;
+    tune_bf16_ft) echo scripts/tune_tiles.py ;;
+    *) echo "" ;;  # bench/gen/sweep code is already in the bench key
+  esac
+}
 
 probe() {
   timeout 120 python -c "
@@ -56,22 +148,31 @@ except Exception:
 EOF
 }
 
-stage_script() {  # stage_script <name> — the stage's own script ('' if none)
-  case $1 in
-    validate) echo scripts/validate_tpu.py ;;
-    detect) echo scripts/detection_study.py ;;
-    attn) echo scripts/bench_attention.py ;;
-    tune_bf16_ft) echo scripts/tune_tiles.py ;;
-    *) echo "" ;;  # bench/gen code is already in the bench key
-  esac
+# Per-cycle key cache: key() spawns a python subprocess that hashes the
+# repo — computing it once per stage per cycle (instead of up to 3x per
+# stage) keeps window-open overhead to ~7 subprocess spawns. Keys are
+# refreshed at every tunnel-UP probe, so a mid-window code edit is picked
+# up one cycle later (the accepted tradeoff; edits during a live window
+# are operator error anyway).
+declare -A KEYS
+refresh_keys() {
+  local s
+  for s in $STAGES; do
+    KEYS[$s]=$(key "$(stage_script "$s")")
+  done
 }
 
-run_stage() {  # run_stage <name> <timeout-s> <cmd...>
-  local name=$1 tmo=$2; shift 2
-  local k; k=$(key "$(stage_script "$name")")
-  local marker=".bench/done_${name}_${k}"
+run_stage() {  # run_stage <name> — cmd/timeout/key from the stage tables
+  local name=$1
+  local tmo; tmo=$(stage_timeout "$name")
+  local marker=".bench/done_${name}_${KEYS[$name]}"
+  # Refresh the heartbeat per stage, not just per probe cycle: the stage
+  # list can run for hours (sweep alone has a 2400s budget) and a
+  # heartbeat that goes stale mid-window would make the watcher read
+  # "dead" exactly while it is doing its most important work.
+  date -u +%s > .bench/watch.hb
   if [ -e "$marker" ]; then
-    echo "[watch] $name already done for key $k"
+    echo "[watch] $name already done for key ${KEYS[$name]}"
     return 0
   fi
   # Re-probe before every stage: windows are ~20 min and can close
@@ -82,7 +183,7 @@ run_stage() {  # run_stage <name> <timeout-s> <cmd...>
     return 1
   fi
   echo "[watch] $(date -u +%H:%M:%S) running $name (timeout ${tmo}s)"
-  if timeout "$tmo" "$@" > ".bench/${name}.log" 2>&1; then
+  if timeout "$tmo" bash -c "$(stage_cmd "$name")" > ".bench/${name}.log" 2>&1; then
     touch "$marker"
     echo "[watch] $(date -u +%H:%M:%S) $name OK"
   else
@@ -92,21 +193,28 @@ run_stage() {  # run_stage <name> <timeout-s> <cmd...>
   fi
 }
 
+stage_plan() {  # log which stages are pending vs banked for current keys
+  local pending="" done="" s
+  for s in $STAGES; do
+    if [ -e ".bench/done_${s}_${KEYS[$s]}" ]; then
+      done="$done $s"
+    else
+      pending="$pending $s"
+    fi
+  done
+  echo "[watch] stage plan: pending:${pending:- none}; banked:${done:- none}"
+}
+
 while true; do
+  date -u +%s > .bench/watch.hb
   if probe; then
     echo "[watch] $(date -u +%H:%M:%S) tunnel UP"
-    # External timeout must exceed bench.py's own 900 s deadline, or a
-    # slow-but-successful run gets SIGTERM'd from outside and the stage
-    # is never marked done.
-    run_stage bench 980 python bench.py
-    run_stage validate 1200 python scripts/validate_tpu.py 4096 --full --bf16
-    run_stage gen 900 bash -c "python -m ft_sgemm_tpu.codegen.gen all && python -m ft_sgemm_tpu.codegen.gen huge 0 --dtype=bfloat16 && python -m ft_sgemm_tpu.codegen.gen huge 1 --dtype=bfloat16"
-    run_stage detect 900 python scripts/detection_study.py 2048
-    run_stage attn 900 python scripts/bench_attention.py
-    run_stage tune_bf16_ft 1200 python scripts/tune_tiles.py 4096 --ft --bf16
+    refresh_keys
+    stage_plan
     all=1
-    for s in bench validate gen detect attn tune_bf16_ft; do
-      [ -e ".bench/done_${s}_$(key "$(stage_script "$s")")" ] || all=0
+    for s in $STAGES; do
+      run_stage "$s"
+      [ -e ".bench/done_${s}_${KEYS[$s]}" ] || all=0
     done
     if [ "$all" = 1 ]; then
       echo "[watch] all stages banked; exiting"
