@@ -86,6 +86,33 @@ SHAPE_ORDER = ("small", "medium", "large", "tall", "wide", "huge")
 # every shipped tile with room for the tuner to explore larger ones.
 VMEM_LIMIT_BYTES = 64 * 1024 * 1024
 
+
+def vmem_limit_bytes() -> int:
+    """The scoped-VMEM budget to compile kernels against, per device.
+
+    The 64 MiB default assumes a v4/v5-class part (128 MiB physical VMEM
+    per core). Older generations have 16 MiB total — on those, a raised
+    compiler bound would only defer the failure from a clear compile-time
+    scoped-vmem error to a runtime allocation failure, so the limit is
+    derived from the live device kind. ``FT_SGEMM_VMEM_LIMIT_BYTES``
+    overrides both (trace-time; takes effect on the next compile).
+    """
+    import os
+
+    env = os.environ.get("FT_SGEMM_VMEM_LIMIT_BYTES")
+    if env:
+        return int(env)
+    kind = ""
+    try:
+        import jax
+
+        kind = jax.local_devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — no backend yet: assume the default
+        pass
+    if "v2" in kind or "v3" in kind:
+        return 16 * 1024 * 1024
+    return VMEM_LIMIT_BYTES
+
 # bf16 input mode re-tunes the flagship tile (live-v5e sweep,
 # scripts/tune_tiles.py --bf16 [--ft], M=N=K=4096): halved A/B tile bytes
 # let the plain kernel go K-deep (512x512x2048, ~138 TFLOPS vs ~124 at the

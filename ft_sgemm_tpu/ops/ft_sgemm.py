@@ -87,9 +87,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu.configs import (
     SHAPES,
-    VMEM_LIMIT_BYTES,
     KernelShape,
     shape_for_dtype,
+    vmem_limit_bytes,
 )
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
@@ -102,6 +102,7 @@ from ft_sgemm_tpu.ops.common import (
     should_interpret as _should_interpret,
     shrink_block as _shrink_block,
 )
+from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
 
 STRATEGIES = ("rowcol", "global", "weighted", "fused")
 
@@ -897,7 +898,7 @@ def _ft_sgemm_padded(
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=VMEM_LIMIT_BYTES,
+            vmem_limit_bytes=vmem_limit_bytes(),
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
@@ -985,32 +986,75 @@ def make_ft_sgemm(
         # (placeholder; thresholds are computed after the tile resolves,
         # since the re-check scales depend on bm — see below)
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+
+        def resolve_cadence(e):
+            """nk and the effective check cadence at tile ``e``.
+
+            One resolver for the VMEM-fit variant choice AND the final
+            kernel parameters, so the fitted body is the body that runs.
+            """
+            nk_ = -(-a.shape[1] // e.bk)
+            if check_every is not None:
+                ce_ = check_every
+            elif strategy in ("weighted", "fused"):
+                ce_ = nk_  # single final check: localization absorbs
+                # the whole fault backlog
+            else:
+                # ~20 checks per run like the reference's K/20-column
+                # cadence (code_gen.py:333), rounded to nearest so
+                # shallow-K-grid runs don't overshoot (nk=32: every-other-
+                # step = 16 checks, vs 32 checks with floor — the
+                # reference does 20 regardless).
+                ce_ = max(1, round(nk_ / 20))
+            if (inject.enabled
+                    and strategy in ("rowcol", "weighted", "fused")
+                    and math.gcd(inject.col_stride, e.bn) == 1):
+                # Column-localized correction needs the interval's faults
+                # in DISTINCT columns. A column stride coprime to bn
+                # advances the column by a full cycle only after bn
+                # injections, so up to bn faults per interval stay
+                # distinct; only clamp for K deep enough to wrap the
+                # cycle. Non-coprime strides (e.g. the adversarial
+                # col_stride=0) can collide regardless of cadence — no
+                # clamp helps; the in-kernel residual-after-correct
+                # re-check reports those intervals via
+                # FtSgemmResult.uncorrectable.
+                ce_ = min(ce_, e.bn * max(1, inject.every))
+            return nk_, ce_
+
+        # Trace-time scoped-VMEM guard: a tile over the Mosaic budget is
+        # auto-shrunk (named shapes) or loudly warned about (explicit
+        # shapes) instead of dying inside the compiler — the failure mode
+        # that cost round 4 its hardware window (ops/vmem.py). The fit
+        # targets the body that will actually run: weighted at a single-
+        # final-check cadence runs the lighter precomp body (estimating
+        # the in-kernel encode body instead would warn/shrink for tiles
+        # the real kernel fits — the tuner's pre-filter makes the same
+        # call, scripts/tune_tiles.py).
+        nk0, ce0 = resolve_cadence(eff)
+        variant = strategy
+        if strategy == "weighted" and ce0 >= nk0:
+            variant = "weighted_precomp"
+        limit = vmem_limit_bytes()
+        itemsize = jnp.dtype(in_dtype).itemsize
+        eff = _fit_block_to_vmem(
+            eff, variant, limit=limit, in_itemsize=itemsize,
+            allow_shrink=named)
+        if variant == "weighted_precomp":
+            nk1, ce1 = resolve_cadence(eff)
+            if ce1 < nk1:
+                # A bk shrink deepened the K grid past an explicit
+                # check_every (or the injection clamp): the in-kernel
+                # encode body will run after all — re-fit against it.
+                eff = _fit_block_to_vmem(
+                    eff, "weighted", limit=limit, in_itemsize=itemsize,
+                    allow_shrink=named)
         bm, bn, bk = eff.block
         ap = _pad_to(a, bm, bk)
         bp = _pad_to(b, bn, bk)
         cp = _pad_to(c, bm, bn)
         nk = ap.shape[1] // bk
-        if check_every is not None:
-            ce = check_every
-        elif strategy in ("weighted", "fused"):
-            ce = nk  # single final check: localization absorbs fault backlog
-        else:
-            # ~20 checks per run like the reference's K/20-column cadence
-            # (code_gen.py:333), rounded to nearest so shallow-K-grid runs
-            # don't overshoot (nk=32: every-other-step = 16 checks, vs 32
-            # checks with floor — the reference does 20 regardless).
-            ce = max(1, round(nk / 20))
-        if (inject.enabled and strategy in ("rowcol", "weighted", "fused")
-                and math.gcd(inject.col_stride, bn) == 1):
-            # Column-localized correction needs the interval's faults in
-            # DISTINCT columns. A column stride coprime to bn advances the
-            # column by a full cycle only after bn injections, so up to bn
-            # faults per interval stay distinct; only clamp for K deep
-            # enough to wrap the cycle. Non-coprime strides (e.g. the
-            # adversarial col_stride=0) can collide regardless of cadence —
-            # no clamp helps; the in-kernel residual-after-correct re-check
-            # reports those intervals via FtSgemmResult.uncorrectable.
-            ce = min(ce, bn * max(1, inject.every))
+        _, ce = resolve_cadence(eff)
         if strategy != "rowcol":
             mf = False  # only rowcol reads the flag; keep jit keys stable
         elif multifault is None:

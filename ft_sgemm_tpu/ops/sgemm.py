@@ -39,9 +39,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu.configs import (
     SHAPES,
-    VMEM_LIMIT_BYTES,
     KernelShape,
     shape_for_dtype,
+    vmem_limit_bytes,
 )
 from ft_sgemm_tpu.ops.common import (
     dtype_suffix as _dtype_suffix,
@@ -51,6 +51,7 @@ from ft_sgemm_tpu.ops.common import (
     should_interpret as _should_interpret,
     shrink_block as _shrink_block,
 )
+from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
 
 
 def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec):
@@ -107,7 +108,7 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=VMEM_LIMIT_BYTES,
+            vmem_limit_bytes=vmem_limit_bytes(),
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
@@ -149,6 +150,11 @@ def make_sgemm(
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+        # Trace-time scoped-VMEM guard (ops/vmem.py): auto-shrink named
+        # shapes over the Mosaic budget; warn for explicit ones.
+        eff = _fit_block_to_vmem(
+            eff, None, limit=vmem_limit_bytes(),
+            in_itemsize=jnp.dtype(in_dtype).itemsize, allow_shrink=named)
         ap = _pad_to(a, eff.bm, eff.bk)
         bp = _pad_to(b, eff.bn, eff.bk)
         cp = _pad_to(c, eff.bm, eff.bn)

@@ -1,0 +1,181 @@
+"""Static per-kernel VMEM-footprint estimation and trace-time tile fitting.
+
+Mosaic compiles each Pallas kernel against a scoped-VMEM budget
+(``vmem_limit_bytes``); exceeding it is a COMPILE-time error that CPU
+interpret mode can never see. Round 4 lost its one hardware window to
+exactly that: every FT strategy except ``rowcol`` died 0.3-2 MiB past the
+16 MiB default at the tuned 4096 tiles
+(``.bench/records_b855854_4096.jsonl``: weighted-precomp 16.27 MiB,
+weighted in-kernel 17.93 MiB, fused 16.38 MiB, bf16 weighted-precomp
+17.75 MiB). This module makes that failure class impossible to hit blind:
+every kernel wrapper estimates its footprint BEFORE ``pallas_call`` and
+either auto-shrinks the tile (named shapes) or warns loudly (explicit
+shapes, e.g. tuner candidates — a sweep must measure the tile its row
+label claims, so it gets the prediction but keeps the tile).
+
+The model is ``pipeline buffers + scratch + temporaries``:
+
+  - **Pipeline buffers**: each grid-blocked operand/output window is
+    multi-buffered by Mosaic; 2x its block bytes.
+  - **Scratch**: the wrapper's declared VMEM scratch shapes, exact.
+  - **Temporaries**: the kernel body's live vector values (dot results,
+    accumulator copies, residual/mask tiles). Not statically derivable
+    from Python, so modeled as ``factor x (a_rows * bn * 4)`` — one
+    accumulator-tile unit — with per-variant factors CALIBRATED against
+    the recorded Mosaic numbers above plus the configs that are known to
+    have compiled at 16 MiB (plain f32/bf16, rowcol f32). Factors sit a
+    safety margin above the observed temp footprint, so estimates are
+    conservative: a predicted fit may still (rarely) OOM for an exotic
+    tile, but every recorded real OOM is predicted.
+
+Calibration table (observed total - modeled buffers = observed temps, in
+accumulator-tile units of ``bm*bn*4``):
+
+  variant            observed temps   factor used
+  weighted-precomp   8.2 (f32) / 4.1 (bf16)   9
+  weighted           9.9                     11
+  fused              8.2                      9
+  rowcol             < 7.9 (compiled @16 MiB) 7
+  plain              < 3.9 (bf16 deep-K @16)  3
+  global             (no observation)         6
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+from ft_sgemm_tpu.configs import KernelShape
+
+MIB = 1024 * 1024
+
+# Per-variant temporary footprint, in accumulator-tile units (see module
+# docstring for the calibration provenance). "weighted" is the in-kernel
+# encode body; "weighted_precomp" the deferred-check body with the
+# precomputed expectations operand.
+TEMP_TILE_FACTORS = {
+    "plain": 3.0,
+    "global": 6.0,
+    "rowcol": 7.0,
+    "fused": 9.0,
+    "weighted_precomp": 9.0,
+    "weighted": 11.0,
+}
+
+
+def fused_aug_rows(in_itemsize: int) -> int:
+    """Sublane-aligned augmented-row count of the fused strategy (3 moment
+    rows for f32; 9 hi/lo/lo2 term rows for bf16 — ``_augment_a``)."""
+    return 8 if in_itemsize == 4 else 16
+
+
+def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
+                        in_itemsize: int = 4, multifault: bool = True) -> int:
+    """Predicted scoped-VMEM bytes for one kernel variant at ``shape``.
+
+    ``variant`` is a :data:`TEMP_TILE_FACTORS` key. ``in_itemsize`` is the
+    A/B input width (4 f32, 2 bf16); the accumulator/output is always f32.
+    """
+    if variant not in TEMP_TILE_FACTORS:
+        raise ValueError(
+            f"unknown kernel variant {variant!r}; pick from"
+            f" {tuple(TEMP_TILE_FACTORS)}")
+    bm, bn, bk = shape.block
+    aug = fused_aug_rows(in_itemsize) if variant == "fused" else 0
+    a_rows = bm + aug
+
+    buffers = 2 * a_rows * bk * in_itemsize     # A window
+    buffers += 2 * bn * bk * in_itemsize        # B window
+    buffers += 2 * bm * bn * 4                  # C operand window
+    buffers += 2 * bm * bn * 4                  # output window
+    if variant == "weighted_precomp":
+        buffers += 2 * 8 * bn * 4               # expected-checksum window
+
+    scratch = 0
+    if variant == "rowcol":
+        scratch = (bm + (2 if multifault else 1) * bn) * 4
+    elif variant == "weighted":
+        scratch = 3 * bn * 4
+    elif variant == "fused":
+        scratch = aug * bn * 4
+
+    temps = int(TEMP_TILE_FACTORS[variant] * a_rows * bn * 4)
+    return buffers + scratch + temps
+
+
+def _variant_for(strategy: str | None) -> str:
+    """Fitting variant for a wrapper-level strategy.
+
+    Callers that know which body will run pass the exact variant
+    (``make_ft_sgemm`` resolves ``weighted`` vs ``weighted_precomp`` from
+    the effective cadence; the tuner does the same). ``rowcol`` is fitted
+    with ``multifault=True`` scratch — a superset covering both modes.
+    ``None`` is the plain (non-FT) kernel.
+    """
+    return strategy if strategy is not None else "plain"
+
+
+def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
+                      limit: int, in_itemsize: int = 4,
+                      allow_shrink: bool) -> KernelShape:
+    """Guard one kernel launch against a Mosaic scoped-VMEM OOM.
+
+    Estimates the footprint at ``shape``; if it exceeds ``limit`` either
+    shrinks the tile until it fits (``allow_shrink=True`` — named shapes)
+    or warns and returns the tile unchanged (explicit shapes: tile sweeps
+    must measure what their row label claims; the warning tells the
+    operator the compile will likely fail). Shrink order: halve ``bk``
+    (cheapest — K-depth only changes pipeline efficiency), then ``bn``,
+    then ``bm`` (these also shrink the temp tiles), all floored at 128.
+    Every shrink is announced with one loud warning; an unfittable tile
+    (over budget at 128^3) raises instead of dying inside Mosaic.
+    """
+    variant = _variant_for(strategy)
+    est = estimate_vmem_bytes(shape, variant, in_itemsize=in_itemsize)
+    if est <= limit:
+        return shape
+    if not allow_shrink:
+        warnings.warn(
+            f"ft_sgemm_tpu: kernel {variant!r} at tile {shape.block} is"
+            f" predicted to need ~{est / MIB:.1f} MiB of scoped VMEM,"
+            f" over the {limit / MIB:.0f} MiB limit — Mosaic compilation"
+            f" will likely fail. (Explicit KernelShape: not auto-shrunk;"
+            f" use a named shape for auto-fit, or raise"
+            f" FT_SGEMM_VMEM_LIMIT_BYTES if the device allows.)",
+            stacklevel=3)
+        return shape
+    def halve(v):
+        # Largest multiple of 128 at or below v/2 (384 -> 128, not the
+        # illegal 192), floored at the minimum legal tile dim.
+        return max(128, (v // 2) // 128 * 128)
+
+    bm, bn, bk = shape.block
+    while True:
+        est = estimate_vmem_bytes(
+            dataclasses.replace(shape, bm=bm, bn=bn, bk=bk), variant,
+            in_itemsize=in_itemsize)
+        if est <= limit:
+            break
+        if bk > 128:
+            bk = halve(bk)
+        elif bn > 128:
+            bn = halve(bn)
+        elif bm > 128:
+            bm = halve(bm)
+        else:
+            raise ValueError(
+                f"ft_sgemm_tpu: kernel {variant!r} cannot fit the"
+                f" {limit / MIB:.0f} MiB scoped-VMEM limit even at the"
+                f" minimum 128x128x128 tile (predicted"
+                f" ~{est / MIB:.1f} MiB); raise FT_SGEMM_VMEM_LIMIT_BYTES"
+                f" or use a device with more VMEM")
+    fitted = dataclasses.replace(shape, bm=bm, bn=bn, bk=bk)
+    warnings.warn(
+        f"ft_sgemm_tpu: tile {shape.block} for kernel {variant!r} predicted"
+        f" at ~{estimate_vmem_bytes(shape, variant, in_itemsize=in_itemsize) / MIB:.1f}"
+        f" MiB of scoped VMEM, over the {limit / MIB:.0f} MiB limit —"
+        f" auto-shrunk to {fitted.block} (~{est / MIB:.1f} MiB) instead of"
+        f" failing Mosaic compilation. Perf characteristics change; tune"
+        f" tiles for this device or raise FT_SGEMM_VMEM_LIMIT_BYTES.",
+        stacklevel=3)
+    return fitted

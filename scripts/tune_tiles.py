@@ -14,9 +14,10 @@ import jax
 
 sys.path.insert(0, ".")
 
-from ft_sgemm_tpu.configs import KernelShape  # noqa: E402
+from ft_sgemm_tpu.configs import KernelShape, vmem_limit_bytes  # noqa: E402
 from ft_sgemm_tpu.injection import InjectionSpec  # noqa: E402
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm  # noqa: E402
+from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes  # noqa: E402
 from ft_sgemm_tpu.ops.sgemm import make_sgemm  # noqa: E402
 from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
 from ft_sgemm_tpu.utils.timing import bench_seconds_per_call  # noqa: E402
@@ -92,9 +93,33 @@ def main():
     c = jax.device_put(generate_random_matrix(size, size, rng=rng))
     flop = 2.0 * size**3
 
+    # Pre-filter by the calibrated VMEM-footprint estimator: a candidate
+    # predicted over the Mosaic budget would burn scarce tunnel-window
+    # seconds dying inside the compiler (explicit KernelShapes are
+    # deliberately never auto-shrunk — the row label must be the measured
+    # tile). Logged, not silent: the sweep's output says exactly which
+    # tiles were skipped and why. The variant mirrors make_ft_sgemm's
+    # resolve_cadence decision at the swept settings: no explicit
+    # check_every means the weighted strategy takes its single-final-
+    # check default, i.e. the lighter precomp body (the injection clamp
+    # cannot drop the cadence below nk here: bn*every >= 128 > nk at
+    # every swept size).
+    variant = (strategy_flag if strategy_flag
+               else "rowcol" if do_rowcol
+               else "weighted" if do_ft else "plain")
+    if variant == "weighted":
+        variant = "weighted_precomp"
+    limit = vmem_limit_bytes()
+    itemsize = 2 if in_dtype == "bfloat16" else 4
+
     results = []
     for bm, bn, bk in candidates:
         shape = KernelShape(f"t{bm}x{bn}x{bk}", bm, bn, bk, (0,) * 7)
+        est = estimate_vmem_bytes(shape, variant, in_itemsize=itemsize)
+        if est > limit:
+            print(f"{shape.name:18s} SKIPPED: predicted ~{est / MIB:.1f}"
+                  f" MiB scoped VMEM > {limit / MIB:.0f} MiB limit")
+            continue
         try:
             if do_ft or do_rowcol or strategy_flag:
                 strat = (strategy_flag if strategy_flag

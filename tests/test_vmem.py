@@ -1,0 +1,189 @@
+"""VMEM-footprint estimator and trace-time tile fitting (ops/vmem.py).
+
+The ground truth is round 4's one completed hardware window
+(``.bench/records_b855854_4096.jsonl``): Mosaic's own scoped-VMEM
+accounting for four kernel variants that FAILED at the 16 MiB default
+limit, plus the variants known to have compiled at it. The estimator must
+(a) predict every recorded OOM, (b) not flag anything that really
+compiled, and (c) pass every shipped configuration at the 64 MiB budget —
+so the bench ladder can never again lose rungs to a compile error.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import ft_sgemm_tpu as ft
+from ft_sgemm_tpu.configs import (
+    BF16_TILE_OVERRIDES,
+    SHAPE_ORDER,
+    SHAPES,
+    VMEM_LIMIT_BYTES,
+    shape_for_dtype,
+    vmem_limit_bytes,
+)
+from ft_sgemm_tpu.ops.vmem import (
+    MIB,
+    TEMP_TILE_FACTORS,
+    estimate_vmem_bytes,
+    fit_block_to_vmem,
+)
+
+HUGE = SHAPES["huge"]
+BF16_FT_TILE = dataclasses.replace(
+    HUGE, bm=BF16_TILE_OVERRIDES[("huge", True)][0],
+    bn=BF16_TILE_OVERRIDES[("huge", True)][1],
+    bk=BF16_TILE_OVERRIDES[("huge", True)][2])
+LIMIT_16 = 16 * MIB
+
+# The four Mosaic-recorded OOMs: (variant, shape, in_itemsize,
+# observed MiB). bf16_abft ran the weighted strategy at its single-final-
+# check default, i.e. the precomp body, at the bf16-FT override tile.
+RECORDED_OOMS = [
+    ("weighted_precomp", HUGE, 4, 16.27),
+    ("weighted", HUGE, 4, 17.93),
+    ("fused", HUGE, 4, 16.38),
+    ("weighted_precomp", BF16_FT_TILE, 2, 17.75),
+]
+
+# Variants that really compiled under the 16 MiB default in the same
+# window (plain f32/bf16, rowcol f32) — the estimator must not flag them.
+RECORDED_FITS = [
+    ("plain", HUGE, 4),
+    ("plain", dataclasses.replace(HUGE, bk=2048), 2),  # bf16 plain tile
+    ("rowcol", HUGE, 4),
+]
+
+
+@pytest.mark.parametrize("variant,shape,itemsize,observed", RECORDED_OOMS)
+def test_estimator_predicts_recorded_ooms(variant, shape, itemsize,
+                                          observed):
+    est = estimate_vmem_bytes(shape, variant, in_itemsize=itemsize)
+    assert est > LIMIT_16, (variant, est / MIB)
+    # Conservative: the estimate must be at least Mosaic's own number
+    # (else some real OOM would be predicted to fit at a tighter limit)...
+    assert est >= observed * MIB, (variant, est / MIB, observed)
+    # ...but still clear the shipped 64 MiB budget with real headroom.
+    assert est < 0.75 * VMEM_LIMIT_BYTES, (variant, est / MIB)
+
+
+@pytest.mark.parametrize("variant,shape,itemsize", RECORDED_FITS)
+def test_estimator_passes_recorded_fits(variant, shape, itemsize):
+    est = estimate_vmem_bytes(shape, variant, in_itemsize=itemsize)
+    assert est <= LIMIT_16, (variant, est / MIB)
+
+
+def test_every_shipped_config_fits_the_default_budget():
+    """No shipped named shape x strategy x dtype may trigger a shrink."""
+    for name in SHAPE_ORDER:
+        for is_ft in (False, True):
+            for itemsize, dtype in ((4, "float32"), (2, "bfloat16")):
+                shape = shape_for_dtype(SHAPES[name], is_ft, dtype)
+                variants = (
+                    ("rowcol", "global", "weighted", "weighted_precomp",
+                     "fused") if is_ft else ("plain",))
+                for variant in variants:
+                    est = estimate_vmem_bytes(
+                        shape, variant, in_itemsize=itemsize)
+                    assert est <= VMEM_LIMIT_BYTES, (
+                        name, variant, dtype, est / MIB)
+
+
+def test_unknown_variant_rejected():
+    with pytest.raises(ValueError, match="unknown kernel variant"):
+        estimate_vmem_bytes(HUGE, "warp")
+
+
+def test_fit_noop_within_budget():
+    assert fit_block_to_vmem(
+        HUGE, "rowcol", limit=VMEM_LIMIT_BYTES, allow_shrink=True) is HUGE
+
+
+def test_fit_shrinks_oversized_named_tile_with_warning():
+    big = dataclasses.replace(HUGE, bm=1024, bn=1024, bk=2048)
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        fitted = fit_block_to_vmem(
+            big, "weighted", limit=VMEM_LIMIT_BYTES, allow_shrink=True)
+    assert fitted.block != big.block
+    assert estimate_vmem_bytes(fitted, "weighted") <= VMEM_LIMIT_BYTES
+    for v in fitted.block:
+        assert v >= 128 and v % 128 == 0
+
+
+def test_fit_warns_but_keeps_explicit_tile():
+    big = dataclasses.replace(HUGE, bm=1024, bn=1024, bk=2048)
+    with pytest.warns(UserWarning, match="not auto-shrunk"):
+        kept = fit_block_to_vmem(
+            big, "weighted", limit=VMEM_LIMIT_BYTES, allow_shrink=False)
+    assert kept is big
+
+
+def test_fit_shrinks_non_power_of_two_dims_legally():
+    """Halving 384 would give the illegal 192; the shrink must step to a
+    multiple of 128 (or raise the documented error), never crash in the
+    KernelShape validator."""
+    odd = dataclasses.replace(HUGE, bm=384, bn=384, bk=384)
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        fitted = fit_block_to_vmem(
+            odd, "weighted", limit=8 * MIB, allow_shrink=True)
+    assert estimate_vmem_bytes(fitted, "weighted") <= 8 * MIB
+    for v in fitted.block:
+        assert v >= 128 and v % 128 == 0
+
+
+def test_fit_raises_when_unfittable():
+    with pytest.raises(ValueError, match="cannot fit"):
+        fit_block_to_vmem(
+            HUGE, "weighted", limit=1 * MIB, allow_shrink=True)
+
+
+def test_vmem_limit_env_override(monkeypatch):
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", str(32 * MIB))
+    assert vmem_limit_bytes() == 32 * MIB
+    monkeypatch.delenv("FT_SGEMM_VMEM_LIMIT_BYTES")
+    assert vmem_limit_bytes() == VMEM_LIMIT_BYTES  # cpu backend: default
+
+
+def test_oversized_named_shape_shrinks_end_to_end(monkeypatch, rng):
+    """The wire-level guarantee: a named-shape call over budget produces a
+    shrunk compile + warning and a CORRECT result — never an exception.
+    Forced by dropping the env limit under the huge tile's footprint."""
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", str(12 * MIB))
+    n = 512
+    a = ft.utils.generate_random_matrix(n, n, rng=rng)
+    b = ft.utils.generate_random_matrix(n, n, rng=rng)
+    c = ft.utils.generate_random_matrix(n, n, rng=rng)
+    want = np.asarray(ft.sgemm_reference(a, b, c, 1.0, -1.5))
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        res = ft.ft_sgemm(a, b, c, "huge", strategy="weighted")
+    ok, _, _ = ft.utils.verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_explicit_shape_is_never_shrunk_end_to_end(monkeypatch, rng):
+    """Tile sweeps measure the tile their row label claims: an explicit
+    KernelShape over budget warns but runs at the requested tile (on CPU
+    interpret mode there is no Mosaic to fail the compile)."""
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", str(12 * MIB))
+    n = 512
+    a = ft.utils.generate_random_matrix(n, n, rng=rng)
+    b = ft.utils.generate_random_matrix(n, n, rng=rng)
+    c = ft.utils.generate_random_matrix(n, n, rng=rng)
+    want = np.asarray(ft.sgemm_reference(a, b, c, 1.0, -1.5))
+    with pytest.warns(UserWarning, match="not auto-shrunk"):
+        res = ft.ft_sgemm(a, b, c, SHAPES["huge"], strategy="weighted")
+    ok, _, _ = ft.utils.verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok
+
+
+def test_factors_cover_every_strategy():
+    """Every wrapper-level strategy (plus the plain kernel and the precomp
+    body) has a calibrated factor — a new strategy must add one."""
+    import ft_sgemm_tpu.ops.ft_sgemm as mod
+
+    for strategy in mod.STRATEGIES:
+        assert strategy in TEMP_TILE_FACTORS
+    assert "plain" in TEMP_TILE_FACTORS
+    assert "weighted_precomp" in TEMP_TILE_FACTORS
