@@ -26,7 +26,7 @@ has no tunnel overhead to cancel).
 Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
-        [--dtype=bfloat16] [--strategy=rowcol|weighted|global|fused]
+        [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
 
 ``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
@@ -34,10 +34,14 @@ full-rate path, an axis the CUDA reference has no analog for. Verification
 then diffs against the XLA dot over the same bf16-rounded inputs.
 
 ``--strategy`` picks the fused-ABFT checksum design for the FT rows:
-``rowcol`` (default, reference parity), ``weighted`` (deferred
-localization — fastest correcting design), ``global`` (detect-only; its
-rows are excluded from the verification gate since corruption is left in
-the output by design), or ``fused`` (checksum moments ride extra A rows
+``weighted`` (default — deferred per-column localization; at its default
+single-final-check cadence the expected checksums are precomputed by one
+stacked XLA dot, so the hot loop is the plain kernel's MXU dot and the
+flagship overhead is the lowest of the family), ``rowcol`` (reference
+parity: row+col residual intersection checked every ~K/20 columns, the
+reference's shipped design), ``global`` (detect-only; its rows are
+excluded from the verification gate since corruption is left in the
+output by design), or ``fused`` (checksum moments ride extra A rows
 through the same MXU dot — the warp-level design's TPU analog).
 
 ``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
@@ -47,6 +51,7 @@ analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
 
 from __future__ import annotations
 
+import functools
 import sys
 import time
 
@@ -81,7 +86,7 @@ def _build_ft(kernel_id: int, size: int, in_dtype: str, strategy: str):
 
 
 def _build_callable(kernel_id: int, size: int, inject_ft: bool,
-                    in_dtype: str = "float32", strategy: str = "rowcol"):
+                    in_dtype: str = "float32", strategy: str = "weighted"):
     """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
     name, shape, is_abft = kernel_for_id(kernel_id)
     if kernel_id == 0:
@@ -101,9 +106,6 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     return lambda a, b, c: ft(a, b, c, inj).c
 
 
-import functools
-
-
 def print_device_info(out=sys.stdout) -> None:
     """Hardware line before any results — the reference's ``getDetails``
     (``utils/utils.cu:8-13``: device name, clock, memory) adapted to the
@@ -118,12 +120,13 @@ def print_device_info(out=sys.stdout) -> None:
         print(f"Device: unavailable ({e})", file=out)
 
 
-@functools.lru_cache(maxsize=2)
+@functools.lru_cache(maxsize=1)
 def _host_inputs(size: int):
     """Host-side A/B/C for one sweep size. The perf sweep iterates
     SIZE-major (all kernel rows per size), so this generates each size's
-    ~O(n^2) RNG draws exactly once per sweep — maxsize=2 only needs to
-    hold the current size (plus one for interleaved callers)."""
+    ~O(n^2) RNG draws exactly once per sweep — and only the current
+    size's set needs to stay resident (maxsize=1: a second 6144^2 set
+    would hold ~450 MB of dead host memory at sweep end)."""
     rng = np.random.default_rng(10)
     return (
         generate_random_matrix(size, size, rng=rng),
@@ -164,7 +167,7 @@ def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
 
 def run_verification(end_size: int, st_kernel: int, end_kernel: int,
                      out=sys.stdout, in_dtype: str = "float32",
-                     strategy: str = "rowcol") -> bool:
+                     strategy: str = "weighted") -> bool:
     """Pass 1: diff every selected kernel against the XLA oracle (for bf16
     mode: the XLA dot over the same bf16-rounded inputs).
 
@@ -221,7 +224,7 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
                    st_kernel: int, end_kernel: int,
                    min_device_time: float = 1.0, out=sys.stdout,
                    in_dtype: str = "float32",
-                   strategy: str = "rowcol") -> dict:
+                   strategy: str = "weighted") -> dict:
     """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439).
 
     The sweep runs SIZE-major — all kernel rows measured per size — so
@@ -287,7 +290,7 @@ def main(argv=None) -> int:
     min_device_time = 1.0
     trace_dir = None
     in_dtype = "float32"
-    strategy = "rowcol"
+    strategy = "weighted"
     for f in flags:
         if f.startswith("--mintime="):
             min_device_time = float(f.split("=", 1)[1])

@@ -27,9 +27,21 @@ correct — no second pass over C:
   4. **Fault injection** is a runtime :class:`InjectionSpec` lowered through
      SMEM scalars (the reference hardcodes it, ``ft_sgemm_huge.cuh:49-51``).
 
-Four checksum strategies mirror the reference's preserved designs:
+Four checksum strategies mirror the reference's preserved designs.
+``"weighted"`` is the default: at its default single-final-check cadence
+its expected checksums are closed-form and precomputed by one stacked XLA
+dot (``_ft_kernel_weighted_precomp``), so the hot loop is exactly the
+plain kernel's MXU dot — the measured overhead class the reference's
+fused flagship competes in (16.4 %, BASELINE.md) at ~4-6 % — while its
+per-column localization corrects ANY number of accumulated faults (one
+per corrupted column) in one check. ``"rowcol"`` is the reference-parity
+strategy (the reference's generated kernels check row+col intersections
+every ~K/20 columns) behind ``strategy="rowcol"``; its per-check
+accumulator reductions cost ~19 % at the 4096 flagship point
+(``.bench/records_b855854_4096.jsonl``), which is why it is no longer
+the default.
 
-  - ``"rowcol"`` (default): row+column checksums, residual-intersection
+  - ``"rowcol"`` (reference parity): row+column checksums, residual-intersection
     correction — the shipped generated kernels
     (``include_code_gen/ft_sgemm_*.cuh``) and the warp-level design
     (``include/ft_sgemm_huge_warp.cuh``). Unlike the reference (which can
@@ -911,7 +923,7 @@ def make_ft_sgemm(
     *,
     alpha: float = 1.0,
     beta: float = -1.5,
-    strategy: str = "rowcol",
+    strategy: str = "weighted",
     threshold: float | str = REFERENCE_THRESHOLD,
     threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
     check_every: Optional[int] = None,
@@ -1102,7 +1114,7 @@ def make_ft_sgemm(
 
 def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              beta=-1.5, inject: Optional[InjectionSpec] = None,
-             strategy: str = "rowcol",
+             strategy: str = "weighted",
              threshold: float | str = REFERENCE_THRESHOLD,
              threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
              check_every: Optional[int] = None, precision: str = "highest",

@@ -156,7 +156,7 @@ def multihost_ft_sgemm(
     alpha: float = 1.0,
     beta: float = -1.5,
     inject: Optional[InjectionSpec] = None,
-    strategy: str = "rowcol",
+    strategy: str = "weighted",
     threshold: float = REFERENCE_THRESHOLD,
     precision: str = "highest",
     in_dtype: str = "float32",

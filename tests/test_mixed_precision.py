@@ -112,7 +112,7 @@ def test_in_dtype_validation():
 
 def test_kernel_names_carry_dtype():
     assert make_sgemm("test", in_dtype="bfloat16").__name__.endswith("bfloat16")
-    assert make_ft_sgemm("test").__name__ == "ft_sgemm_test_rowcol"
+    assert make_ft_sgemm("test").__name__ == "ft_sgemm_test_weighted"
 
 
 def test_bf16_named_shape_picks_tuned_tile():
