@@ -554,6 +554,16 @@ def _worker_preexec():
         pass
 
 
+# Every path whose content can change a measured number. MUST cover every
+# repo-local module the worker imports (the package, the native helpers it
+# dlopens, the bench protocol, build metadata) — a measurement-relevant
+# code location outside this list would let stale banked records be
+# resumed after the code changed. tests/test_bench.py enforces the
+# coverage by importing everything the worker reaches in a subprocess and
+# asserting each repo-local module file lands under one of these paths.
+CODE_VERSION_PATHS = ["bench.py", "pyproject.toml", "ft_sgemm_tpu", "csrc"]
+
+
 def _code_version_key():
     """Content key of the code under measurement.
 
@@ -572,7 +582,7 @@ def _code_version_key():
 
     base = os.path.dirname(os.path.abspath(__file__))
 
-    code_paths = ["bench.py", "pyproject.toml", "ft_sgemm_tpu", "csrc"]
+    code_paths = CODE_VERSION_PATHS
     code_exts = (".py", ".cpp", ".cc", ".c", ".h", ".sh", ".toml")
 
     def git(*args):

@@ -149,8 +149,16 @@ def main():
                 # The clean gate holds by construction here (unc would
                 # have returned above), but pass the report anyway: the
                 # gate, not the call site, owns the policy.
-                ckpt.save(i, {"params": params, "opt_state": opt_state},
-                          uncorrectable=unc + bwd_unc)
+                saved = ckpt.save(i, {"params": params,
+                                      "opt_state": opt_state},
+                                  uncorrectable=unc + bwd_unc)
+                if not saved:
+                    # False covers orbax should_save skips as well as
+                    # gate refusals: a silently missing periodic save
+                    # would widen the crash-loss window past --ckpt-every.
+                    print(f"warning: checkpoint at step {i} was NOT "
+                          "written (save skipped or refused)",
+                          file=sys.stderr)
     finally:
         if ckpt:
             ckpt.close()  # waits for in-flight async saves; surfaces

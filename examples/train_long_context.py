@@ -157,8 +157,15 @@ def main():
                 return 1
             if ckpt and ((i + 1) % args.ckpt_every == 0
                          or i == args.steps - 1):
-                ckpt.save(i, {"params": params, "opt_state": opt_state},
-                          uncorrectable=unc + bwd_unc)
+                saved = ckpt.save(i, {"params": params,
+                                      "opt_state": opt_state},
+                                  uncorrectable=unc + bwd_unc)
+                if not saved:
+                    # A silently missing periodic save would widen the
+                    # crash-loss window past --ckpt-every (see train_ft).
+                    print(f"warning: checkpoint at step {i} was NOT "
+                          "written (save skipped or refused)",
+                          file=sys.stderr)
     finally:
         if ckpt:
             ckpt.close()

@@ -414,3 +414,67 @@ def test_resumed_stages_suppressed_after_reset(tmp_path):
                      {})
     payload = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert "resumed_stages" not in payload["context"], payload["context"]
+
+
+def test_code_version_paths_cover_worker_imports(tmp_path):
+    """ADVICE r4: every repo-local module the worker imports must live
+    under a CODE_VERSION_PATHS entry — a measurement-relevant module
+    outside the keyed paths would let stale banked records be resumed
+    after its code changed. The probe DERIVES the import set from
+    bench.py's own AST (module level plus every function body, which is
+    where worker_main's imports live), so a future worker import cannot
+    silently fall out of the check, then asserts every repo-local module
+    file in the resulting interpreter lands under a keyed path. Run in a
+    subprocess so the closure is exactly bench's, not this session's."""
+    repo = str(BENCH.parent)
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import ast, importlib, json, os, sys\n"
+        f"repo = {repo!r}\n"
+        "sys.path.insert(0, repo)\n"
+        "import importlib.util\n"
+        "bench_path = os.path.join(repo, 'bench.py')\n"
+        "spec = importlib.util.spec_from_file_location('bench', bench_path)\n"
+        "bench = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(bench)\n"
+        "names = set()\n"
+        "for node in ast.walk(ast.parse(open(bench_path).read())):\n"
+        "    if isinstance(node, ast.Import):\n"
+        "        names |= {a.name for a in node.names}\n"
+        "    elif isinstance(node, ast.ImportFrom) and node.module \\\n"
+        "            and node.level == 0:\n"
+        "        names.add(node.module)\n"
+        "failed = []\n"
+        "for name in sorted(names):\n"
+        "    try:\n"
+        "        importlib.import_module(name)\n"
+        "    except Exception as e:\n"
+        "        failed.append([name, repr(e)])\n"
+        "local = sorted({\n"
+        "    os.path.realpath(f) for m in list(sys.modules.values())\n"
+        "    if (f := getattr(m, '__file__', None))\n"
+        "    and os.path.realpath(f).startswith(repo + os.sep)})\n"
+        "print(json.dumps({'paths': local, 'failed': failed,\n"
+        "                  'names': sorted(names),\n"
+        "                  'keyed': bench.CODE_VERSION_PATHS}))\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, str(probe)], env=env, text=True,
+                         capture_output=True, timeout=240, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    # The derivation must actually have seen the worker's package imports,
+    # and every ft_sgemm_tpu import bench names must have succeeded (an
+    # optional third-party dep may fail; a repo-local one may not).
+    assert any(n.startswith("ft_sgemm_tpu") for n in payload["names"])
+    repo_fails = [f for f in payload["failed"]
+                  if f[0].startswith("ft_sgemm_tpu")]
+    assert not repo_fails, repo_fails
+    keyed = [os.path.join(repo, p) for p in payload["keyed"]]
+    assert payload["paths"], "probe found no repo-local modules"
+    for path in payload["paths"]:
+        assert any(path == k or path.startswith(k + os.sep)
+                   for k in keyed), (
+            f"bench-reachable module {path} is outside "
+            f"CODE_VERSION_PATHS {payload['keyed']}: its edits would not "
+            "invalidate banked hardware records")
