@@ -33,7 +33,7 @@ def _load(path):
 # Stages whose value is a plain number but NOT a GFLOPS reading.
 _SCALAR_STAGES = {"injected_faults_per_tile"}
 # bf16 stages compare against bf16_xla, not the f32 xla_dot.
-_BF16_STAGES = {"bf16_plain", "bf16_abft", "bf16_xla"}
+_BF16_STAGES = {"bf16_plain", "bf16_abft", "bf16_fused", "bf16_xla"}
 
 
 def _fmt(v, name=""):
@@ -73,7 +73,7 @@ def summarize(path):
             line += f"  ({g / ratio_base * 100:5.1f}% of xla_dot)"
         print(line)
     bf = vals.get("bf16_xla")
-    for name in ("bf16_plain", "bf16_abft"):
+    for name in ("bf16_plain", "bf16_abft", "bf16_fused"):
         v = vals.get(name)
         if isinstance(v, (int, float)) and isinstance(bf, (int, float)) and bf:
             print(f"   {name + ' vs bf16 dot':34s} {v / bf * 100:9.1f}%")
