@@ -1,0 +1,109 @@
+"""AOT compile-probe of the full bench ladder (VERDICT r5 #1a).
+
+Round 4's one hardware window burned its minutes discovering — one timed
+stage at a time — that every FT kernel except rowcol failed Mosaic
+compilation. This probe compiles, WITHOUT running, exactly the jitted
+rep-loop computations ``bench.py`` will execute at the target size:
+operands are ``jax.ShapeDtypeStruct``s (no data touches the chip; on the
+axon tunnel, Mosaic compilation happens in the chipless remote compile
+helper, so only the tunnel's compile service is needed), and the loop
+constructor is shared with the timing path (``timing._make_rep_loop``)
+so every successful probe compile is a persistent-cache hit for the
+subsequent bench/validate stages — window minutes then go to timing, not
+compiling, and a compile regression is identified in one shot with the
+exact Mosaic error per variant.
+
+Usage: python scripts/compile_probe.py [size]
+Prints one status line per variant and a final JSON summary line;
+exit 0 iff every variant compiled.
+"""
+
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_ROOT, ".bench", "jaxcache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ft_sgemm_tpu import InjectionSpec, SHAPES, make_ft_sgemm, make_sgemm  # noqa: E402
+from ft_sgemm_tpu.ops.reference import sgemm_reference  # noqa: E402
+from ft_sgemm_tpu.utils.timing import compile_bench_loop  # noqa: E402
+
+SIZE = 4096
+
+
+def _ft(size, **kwargs):
+    """An FT callable exactly as bench.py's stages build it (factory args
+    AND injection schedule must match for the traced HLO to match)."""
+    ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, **kwargs)
+    inj = InjectionSpec.reference_like(size, ft.shape_config.bk)
+    return lambda a, b, x: ft(a, b, x, inj).c
+
+
+def main():
+    size = SIZE
+    for tok in sys.argv[1:]:
+        if tok.isdigit():
+            size = int(tok)
+    f32 = jax.ShapeDtypeStruct((size, size), jnp.float32)
+    bf16 = jax.ShapeDtypeStruct((size, size), jnp.bfloat16)
+    nk = size // SHAPES["huge"].bk
+
+    variants = [
+        ("xla_dot", f32,
+         lambda: (lambda a, b, x: sgemm_reference(a, b, x, 1.0, -1.5))),
+        ("plain_huge", f32,
+         lambda: make_sgemm("huge", alpha=1.0, beta=-1.5)),
+        # The headline ladder, every rung (bench.py worker_main).
+        ("ft_weighted_precomp", f32,
+         lambda: _ft(size, strategy="weighted")),
+        ("ft_rowcol", f32, lambda: _ft(size, strategy="rowcol")),
+        ("ft_fused", f32, lambda: _ft(size, strategy="fused")),
+        ("bf16_plain", bf16,
+         lambda: make_sgemm("huge", alpha=1.0, beta=-1.5,
+                            in_dtype="bfloat16")),
+        ("bf16_abft", bf16,
+         lambda: _ft(size, strategy="weighted", in_dtype="bfloat16")),
+        ("bf16_fused", bf16,
+         lambda: _ft(size, strategy="fused", in_dtype="bfloat16")),
+        ("bf16_xla", bf16,
+         lambda: (lambda a, b, x: sgemm_reference(a, b, x, 1.0, -1.5,
+                                                  in_dtype="bfloat16"))),
+    ]
+    if nk >= 2:
+        variants.insert(3, ("ft_weighted_inkernel", f32,
+                            lambda: _ft(size, strategy="weighted",
+                                        check_every=nk // 2)))
+
+    print(f"compile_probe: backend={jax.default_backend()} size={size}",
+          flush=True)
+    results = {}
+    for name, ab, make_fn in variants:
+        t0 = time.perf_counter()
+        try:
+            compile_bench_loop(make_fn(), ab, ab, f32)
+            dt = time.perf_counter() - t0
+            results[name] = {"ok": True, "seconds": round(dt, 1)}
+            print(f"compile_probe: {name} OK ({dt:.1f}s)", flush=True)
+        except Exception as e:  # noqa: BLE001 — per-variant report is the job
+            results[name] = {"ok": False,
+                             "error": f"{type(e).__name__}: {str(e)[:400]}"}
+            print(f"compile_probe: {name} FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+    ok = all(r["ok"] for r in results.values())
+    print(json.dumps({"metric": "compile_probe", "size": size,
+                      "backend": jax.default_backend(), "ok": ok,
+                      "variants": results}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

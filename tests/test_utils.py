@@ -103,3 +103,29 @@ def test_bench_seconds_per_call_times_real_work():
     sec = bench_seconds_per_call(fn, a, b, c, min_device_time=0.01)
     assert sec > 0
     assert len(calls) >= 1
+
+
+def test_compile_bench_loop_is_aot_only_and_warms_the_timed_path():
+    """compile_bench_loop must build the timing loop's exact executable
+    from abstract ShapeDtypeStructs — operands with no data, so any
+    device execution of the lowered computation would raise — and the
+    shared constructor means the timed path traces byte-identical HLO
+    (the cache-warming contract of scripts/compile_probe.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu.utils import timing
+
+    def fn(a, b, c):
+        return a @ b.T + c
+
+    sds = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    timing.compile_bench_loop(fn, sds, sds, sds)  # must not raise
+
+    lowered_probe = timing._make_rep_loop(fn).lower(
+        sds, sds, sds, timing.NUM_TESTS, jnp.float32(0))
+    lowered_timed = timing._make_rep_loop(fn).lower(
+        sds, sds, sds, 5, jnp.float32(0))
+    assert (lowered_probe.as_text() == lowered_timed.as_text()), (
+        "probe and timed-path HLO diverged: probe compiles would no "
+        "longer warm the persistent cache for bench")
