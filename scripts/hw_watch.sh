@@ -74,7 +74,7 @@ export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5
 # then spend window minutes timing, not compiling, and any Mosaic
 # compile regression is identified in one shot with per-variant errors
 # (VERDICT r5 #1a).
-STAGES="probe bench validate gen detect attn tune_bf16_ft sweep"
+STAGES="probe bench validate gen detect attn tune_bf16_ft sweep tune_f32_ft"
 
 stage_cmd() {
   case $1 in
@@ -95,13 +95,16 @@ stage_cmd() {
     # verify pass is covered by the validate stage; a ~20-min window
     # should spend itself on table cells.
     sweep) echo "python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 --mintime=0.5 --no-verify" ;;
+    # f32 FT tile retune under the 64 MiB budget (VERDICT r4 #5): the
+    # deep-K candidates the raised limit admits have never been timed.
+    tune_f32_ft) echo "python scripts/tune_tiles.py 4096 --ft" ;;
   esac
 }
 
 stage_timeout() {
   case $1 in
     bench) echo 980 ;;
-    validate | tune_bf16_ft) echo 1200 ;;
+    validate | tune_bf16_ft | tune_f32_ft) echo 1200 ;;
     sweep) echo 2400 ;;
     *) echo 900 ;;
   esac
@@ -113,7 +116,7 @@ stage_script() {  # the stage's own script ('' if none)
     validate) echo scripts/validate_tpu.py ;;
     detect) echo scripts/detection_study.py ;;
     attn) echo scripts/bench_attention.py ;;
-    tune_bf16_ft) echo scripts/tune_tiles.py ;;
+    tune_bf16_ft | tune_f32_ft) echo scripts/tune_tiles.py ;;
     *) echo "" ;;  # bench/gen/sweep code is already in the bench key
   esac
 }
