@@ -122,7 +122,10 @@ stage_script() {  # the stage's own script ('' if none)
 }
 
 probe() {
-  timeout 120 python -c "
+  # -k: a tunnel-dead backend init can hang in C code and ignore the
+  # TERM timeout sends (the round-2 bench postmortem failure mode);
+  # SIGKILL must follow or one probe wedges the whole cycle.
+  timeout -k 10 120 python -c "
 import jax, jax.numpy as jnp
 y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256)))
 jax.block_until_ready(y)
@@ -135,7 +138,7 @@ compile_gate() {
   # helper but NOT chip execution. Lets the compile-probe stage bank the
   # ladder's executables while the chip is unreachable, so a later chip
   # window starts timing immediately instead of compiling.
-  timeout 120 python -c "
+  timeout -k 10 120 python -c "
 import jax, jax.numpy as jnp
 jax.jit(lambda a: a + 1).lower(
     jax.ShapeDtypeStruct((8, 128), jnp.float32)).compile()
@@ -215,7 +218,7 @@ run_stage() {  # run_stage <name> — cmd/timeout/key from the stage tables
     return 1
   fi
   echo "[watch] $(date -u +%H:%M:%S) running $name (timeout ${tmo}s)"
-  if timeout "$tmo" bash -c "$(stage_cmd "$name")" > ".bench/${name}.log" 2>&1; then
+  if timeout -k 15 "$tmo" bash -c "$(stage_cmd "$name")" > ".bench/${name}.log" 2>&1; then
     touch "$marker"
     echo "[watch] $(date -u +%H:%M:%S) $name OK"
   else
