@@ -411,6 +411,10 @@ def _emit_locked(values, errors, extra_errors=None):
         "bf16_plain": "bf16_sgemm_huge_gflops",
         "bf16_xla": "bf16_xla_dot_gflops",
         "injected_faults_per_tile": "injected_faults_per_tile",
+        # Fault-telemetry embed: the injected headline run's materialized
+        # detected/uncorrectable counters ride the artifact so SDC
+        # activity is auditable from the JSON alone.
+        "fault_counters": "fault_counters",
     }
     for src, dst in key_map.items():
         if src in values and values[src] is not None:
@@ -1087,6 +1091,19 @@ def _worker_stages(rec):
         # the supervisor can relaunch a fresh worker whose FIRST job is
         # the headline ladder again.
         return _worker_rc(rec)
+
+    def fault_counters_fn():
+        # Telemetry for the artifact: one injected headline-kernel run's
+        # materialized FtSgemmResult counters — detections must equal the
+        # schedule (tiles * per-tile), uncorrectable must be 0, and a
+        # reader of the JSON can check both without rerunning anything.
+        ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5)
+        res = ft(a, b, c, inj)
+        jax.block_until_ready(res.c)
+        return {"detections": int(res.num_detected),
+                "uncorrectable": int(res.num_uncorrectable)}
+
+    record_retry("fault_counters", fault_counters_fn, attempts=2)
 
     record_retry("xla_dot",
                  lambda: gf(lambda a, b, x: sgemm_reference(a, b, x, 1.0,

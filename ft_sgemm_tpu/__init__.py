@@ -23,7 +23,7 @@ become tile-axis reductions, shared-memory double buffering becomes Mosaic's
 automatically pipelined VMEM blocks.
 """
 
-from ft_sgemm_tpu import utils
+from ft_sgemm_tpu import telemetry, utils
 from ft_sgemm_tpu.configs import (
     KernelShape,
     SHAPES,
@@ -77,4 +77,5 @@ __all__ = [
     "make_ft_attention_diff",
     "ft_matmul",
     "make_ft_matmul",
+    "telemetry",
 ]

@@ -74,7 +74,7 @@ def total_count(counts: Any, match: Optional[str] = None) -> int:
     return int(sum(int(np.sum(np.asarray(leaf))) for leaf in leaves))
 
 
-def _gate_total(report: Any) -> int:
+def gate_total(report: Any) -> int:
     """Sum an UNCORRECTABLE report for the clean-state gates.
 
     The gates must see only uncorrectable counts: corrected
@@ -97,6 +97,11 @@ def _gate_total(report: Any) -> int:
             "total_count(counts, 'uncorrectable') plus the bwd sink "
             "gradient's [1] element.")
     return total_count(report)
+
+
+# Deprecated alias: the gate predates its public promotion and other
+# modules imported the underscore name; new code should use gate_total.
+_gate_total = gate_total
 
 
 class FtCheckpointer:
@@ -145,7 +150,7 @@ class FtCheckpointer:
         orbax's StandardSave rejects a bare array or scalar).
         """
         if not force:  # force bypasses the gate AND its report validation
-            unc = _gate_total(uncorrectable)
+            unc = gate_total(uncorrectable)
             if unc:
                 if self._strict:
                     raise UncleanStateError(

@@ -27,6 +27,16 @@ Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
         [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
+        [--telemetry=LOG.jsonl]
+    python -m ft_sgemm_tpu.cli telemetry LOG.jsonl
+
+``--telemetry=LOG.jsonl`` enables the fault-telemetry subsystem for the
+run (``ft_sgemm_tpu.telemetry``): every FT kernel call appends a
+structured event — counters, outcome, tile coordinates, and a host-side
+residual measurement — to LOG.jsonl. The ``telemetry`` subcommand then
+summarizes such a log: per-op/per-layer totals, outcome counts, and the
+residual-magnitude histogram that feeds threshold calibration
+(``analysis.calibrate_threshold``).
 
 ``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
@@ -273,10 +283,34 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
     return results
 
 
+def run_telemetry_summary(log_path: str, out=None) -> int:
+    """``telemetry`` subcommand: summarize a fault-event JSONL log."""
+    from ft_sgemm_tpu.telemetry import (
+        format_summary, read_events, summarize_events)
+
+    # Resolve stdout at CALL time (a def-time default would pin whatever
+    # object sys.stdout was at import — stale under test capture or any
+    # caller that swaps streams).
+    out = sys.stdout if out is None else out
+    try:
+        summary = summarize_events(read_events(log_path))
+    except OSError as e:
+        print(f"ft_sgemm: cannot read telemetry log: {e}", file=sys.stderr)
+        return 2
+    print(f"telemetry summary of {log_path}", file=out)
+    print(format_summary(summary), file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
     flags = {a for a in argv[1:] if a.startswith("--")}
+    if args and args[0] == "telemetry":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        return run_telemetry_summary(args[1])
     if len(args) < 5:
         print(__doc__)
         return 2
@@ -291,11 +325,14 @@ def main(argv=None) -> int:
     trace_dir = None
     in_dtype = "float32"
     strategy = "weighted"
+    telemetry_log = None
     for f in flags:
         if f.startswith("--mintime="):
             min_device_time = float(f.split("=", 1)[1])
         elif f.startswith("--trace="):
             trace_dir = f.split("=", 1)[1]
+        elif f.startswith("--telemetry="):
+            telemetry_log = f.split("=", 1)[1]
         elif f.startswith("--dtype="):
             in_dtype = f.split("=", 1)[1]
             if in_dtype not in ("float32", "bfloat16"):
@@ -309,20 +346,36 @@ def main(argv=None) -> int:
                       f" {strategy!r}", file=sys.stderr)
                 return 2
 
+    if telemetry_log is not None:
+        # Observability mode: events + host-side residual measurements
+        # for every FT call of the run (clean calls included — their
+        # residuals are the noise-floor half of the calibration input).
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.configure(telemetry_log, measure_residual=True,
+                            log_clean=True)
     print_device_info()
     ok = True
-    if "--no-verify" not in flags:
-        ok = run_verification(end_size, st_kernel, end_kernel,
-                              in_dtype=in_dtype, strategy=strategy)
-    if "--no-perf" not in flags:
-        import contextlib
+    try:
+        if "--no-verify" not in flags:
+            ok = run_verification(end_size, st_kernel, end_kernel,
+                                  in_dtype=in_dtype, strategy=strategy)
+        if "--no-perf" not in flags:
+            import contextlib
 
-        ctx = (jax.profiler.trace(trace_dir) if trace_dir
-               else contextlib.nullcontext())
-        with ctx:
-            run_perf_table(start_size, end_size, gap_size, st_kernel,
-                           end_kernel, min_device_time=min_device_time,
-                           in_dtype=in_dtype, strategy=strategy)
+            ctx = (jax.profiler.trace(trace_dir) if trace_dir
+                   else contextlib.nullcontext())
+            with ctx:
+                run_perf_table(start_size, end_size, gap_size, st_kernel,
+                               end_kernel, min_device_time=min_device_time,
+                               in_dtype=in_dtype, strategy=strategy)
+    finally:
+        if telemetry_log is not None:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.disable()
+            print(f"telemetry events written to {telemetry_log}",
+                  file=sys.stderr)
     return 0 if ok else 1
 
 

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec
 from ft_sgemm_tpu.ops.attention import (
@@ -84,11 +85,30 @@ def _sow_counts(module, pairs):
     """
     if module.is_initializing():
         return
+    pairs = list(pairs)
     accumulate = lambda prev, new: prev + new  # noqa: E731
     zero = lambda: jnp.int32(0)  # noqa: E731
     for name, leaf in pairs:
         module.sow(COUNTS_COLLECTION, name, jnp.asarray(leaf),
                    reduce_fn=accumulate, init_fn=zero)
+    if telemetry.enabled():
+        # Per-layer fault attribution: the telemetry event carries the
+        # module's scope path (e.g. "attn/query") alongside the counts.
+        # Under a caller's jit the counts are tracers and record_* skips
+        # itself; eager applies record one event per layer invocation.
+        import types
+
+        d = dict(pairs)
+        counts = types.SimpleNamespace(
+            detections=d.get("detections"),
+            uncorrectable=d.get("uncorrectable"),
+            softmax_flags=d.get("softmax_flags"))
+        path = getattr(module, "path", None)
+        layer = ("/".join(str(p) for p in path) if path
+                 else (module.name or type(module).__name__))
+        record = (telemetry.record_attention if "softmax_flags" in d
+                  else telemetry.record_gemm)
+        record(f"nn.{type(module).__name__}", counts, layer=layer)
 
 
 class FtDense(nn.Module):
@@ -161,8 +181,12 @@ class FtDense(nn.Module):
         # The FT kernels compute a @ b.T with b stored (out, in): pass the
         # transposed kernel, matching a linear layer's stored weight.
         kt = jnp.swapaxes(kernel, 0, 1)
-        res = (mm(x2, kt) if bwd_sink is None
-               else mm(x2, kt, bwd_sink))
+        # suppress(): this layer's _sow_counts record (with the module
+        # path) is the one event for the call; the inner FT matmul must
+        # not also record an anonymous op-level event.
+        with telemetry.suppress():
+            res = (mm(x2, kt) if bwd_sink is None
+                   else mm(x2, kt, bwd_sink))
         out = res.out
         # Counts ride the ft_counts collection (semantics: _sow_counts).
         _sow_counts(self, (("detections", res.detections),

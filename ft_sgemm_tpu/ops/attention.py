@@ -52,6 +52,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
@@ -232,9 +233,15 @@ def make_ft_attention(
                        interpret=interpret)
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
-        res, _, _ = _ft_attention_forward(
-            qk, pv, q, k, v, inject, scale, causal, softmax_threshold,
-            softmax_recheck_rows, softmax_fault)
+        # suppress(): the inner QK/PV GEMMs must not record their own
+        # events — this call is ONE logical op and records once.
+        with telemetry.trace_span("ft_attention"), telemetry.suppress():
+            res, _, _ = _ft_attention_forward(
+                qk, pv, q, k, v, inject, scale, causal, softmax_threshold,
+                softmax_recheck_rows, softmax_fault)
+        if telemetry.enabled():
+            telemetry.record_attention("ft_attention", res,
+                                       strategy=strategy)
         return res
 
     fn.strategy = strategy
@@ -323,9 +330,15 @@ def make_ft_attention_diff(
     b_short = qk if bthr == threshold else mk(qk_shape, bthr)
 
     def _fwd_parts(q, k, v):
-        res, p, sc = _ft_attention_forward(
-            qk, pv, q, k, v, inj, scale, causal, softmax_threshold,
-            softmax_recheck_rows, softmax_fault)
+        with telemetry.trace_span("ft_attention_diff"), telemetry.suppress():
+            res, p, sc = _ft_attention_forward(
+                qk, pv, q, k, v, inj, scale, causal, softmax_threshold,
+                softmax_recheck_rows, softmax_fault)
+        if telemetry.enabled():
+            # Skips itself under a caller's jit/grad trace (tracers);
+            # eager calls record the forward pass's materialized report.
+            telemetry.record_attention("ft_attention_diff", res,
+                                       strategy=strategy)
         return (res if with_counts else res.out), p, sc
 
     def _bwd_products(res, g):

@@ -8,6 +8,44 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+# resolve whichever this jax ships so the kernels build on both sides of
+# the rename (single source for every pallas_call in the package).
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+
+def _register_barrier_batching() -> None:
+    """Fill in the ``optimization_barrier`` vmap rule older jax lacks.
+
+    The softmax dual-recompute check (ops/attention.py) barriers its
+    duplicate reduction chain; newer jax ships the (trivial — the barrier
+    is operand-wise identity, so batch dims pass straight through)
+    batching rule, older jax raises NotImplementedError under vmap.
+    Registering only when absent means current jax is untouched.
+    """
+    try:
+        from jax._src.lax import lax as _lax_src
+        from jax.interpreters import batching
+
+        prim = getattr(_lax_src, "optimization_barrier_p", None)
+        if prim is None or prim in batching.primitive_batchers:
+            return
+
+        def _rule(args, dims, **params):
+            outs = prim.bind(*args, **params)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            return outs, list(dims)
+
+        batching.primitive_batchers[prim] = _rule
+    except Exception:  # noqa: BLE001 — unpatchable jax: vmap raises as before
+        pass
+
+
+_register_barrier_batching()
 
 # Calibrated constants of the clean-residual noise model — single source
 # for the numpy estimator (analysis.estimate_noise_floor, where the

@@ -97,6 +97,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import (
     SHAPES,
     KernelShape,
@@ -105,6 +106,7 @@ from ft_sgemm_tpu.configs import (
 )
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
+    CompilerParams as _CompilerParams,
     DEFAULT_THRESHOLD_MARGIN,
     dtype_suffix as _dtype_suffix,
     estimate_noise_floor_jnp as _estimate_noise_floor_jnp,
@@ -908,7 +910,7 @@ def _ft_sgemm_padded(
             jax.ShapeDtypeStruct((gm, gn), jnp.int32),
         ],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),
         ),
@@ -1096,16 +1098,26 @@ def make_ft_sgemm(
             # higher moments' noise is negligible and a single scale keeps
             # the adversarial-schedule reports maximally sensitive.
             thr = thr_m1 = thr_m2 = jnp.float32(threshold)
-        out, det, unc = _ft_sgemm_padded(
-            ap, bp, cp, jnp.asarray(inject.as_operand()),
-            shape=eff, alpha=alpha, beta=beta, precision=precision,
-            threshold=(thr, thr_m1, thr_m2), check_every=ce,
-            strategy=strategy, multifault=mf,
-            interpret=_should_interpret(interpret),
-        )
-        return FtSgemmResult(out[:m, :n], det, unc)
+        with telemetry.trace_span(op_name):
+            out, det, unc = _ft_sgemm_padded(
+                ap, bp, cp, jnp.asarray(inject.as_operand()),
+                shape=eff, alpha=alpha, beta=beta, precision=precision,
+                threshold=(thr, thr_m1, thr_m2), check_every=ce,
+                strategy=strategy, multifault=mf,
+                interpret=_should_interpret(interpret),
+            )
+        result = FtSgemmResult(out[:m, :n], det, unc)
+        if telemetry.enabled():
+            # Host-side observation of the already-materialized counters
+            # (skipped automatically when they are tracers — a caller's
+            # jit); the jitted computation above is untouched either way.
+            telemetry.record_gemm(
+                op_name, result, strategy=strategy, threshold=thr,
+                operands=(a, b, c), alpha=alpha, beta=beta)
+        return result
 
-    fn.__name__ = f"ft_sgemm_{shape.name}_{strategy}" + _dtype_suffix(in_dtype)
+    op_name = f"ft_sgemm_{shape.name}_{strategy}" + _dtype_suffix(in_dtype)
+    fn.__name__ = op_name
     fn.shape_config = shape
     fn.strategy = strategy
     fn.in_dtype = in_dtype

@@ -44,6 +44,7 @@ from ft_sgemm_tpu.configs import (
     vmem_limit_bytes,
 )
 from ft_sgemm_tpu.ops.common import (
+    CompilerParams as _CompilerParams,
     dtype_suffix as _dtype_suffix,
     gemm_cost_estimate as _gemm_cost_estimate,
     pad_to as _pad_to,
@@ -106,7 +107,7 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),
         ),

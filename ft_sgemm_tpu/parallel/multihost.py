@@ -46,6 +46,21 @@ from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.parallel.sharded import make_ft_step, shard_map
 
 
+def _distributed_is_initialized() -> bool:
+    """Version-tolerant ``jax.distributed.is_initialized``: the public
+    accessor only exists on newer jax; older versions expose the same
+    state through the distributed client singleton."""
+    checker = getattr(jax.distributed, "is_initialized", None)
+    if checker is not None:
+        return bool(checker())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — no detectable runtime: not inited
+        return False
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -57,7 +72,7 @@ def initialize(coordinator_address: Optional[str] = None,
     """
     # Ask the runtime directly instead of string-matching the double-init
     # RuntimeError (whose wording varies across JAX versions).
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

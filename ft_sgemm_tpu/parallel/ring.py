@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
@@ -142,8 +143,17 @@ def ring_ft_sgemm(
         in_specs=(P("x", None), P("x", None), P("x", None)),
         out_specs=(P("x", None), P(None, None), P(None, None)),
     )
-    out, det, unc = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det, unc)
+    with telemetry.trace_span("ring_ft_sgemm"):
+        out, det, unc = jax.jit(fn)(a, b, c)
+    result = FtSgemmResult(out, det, unc)
+    if telemetry.enabled():
+        # Ring counts psum over all hops and devices; the device label
+        # carries the ring extent for per-topology attribution.
+        telemetry.record_gemm(
+            "ring_ft_sgemm", result, strategy=strategy,
+            device=f"ring{d}", operands=(a, b, c),
+            alpha=alpha, beta=beta)
+    return result
 
 
 def ring_sgemm(

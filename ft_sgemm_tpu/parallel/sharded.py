@@ -32,11 +32,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 def shard_map(f, *, mesh, in_specs, out_specs):
-    # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
-    # metadata, which jax>=0.8 shard_map otherwise requires.
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    # Replication/varying-axes checking is off either way: pallas_call
+    # outputs don't carry the metadata the checker requires. The kwarg
+    # spells check_vma on jax>=0.8 and check_rep before the rename.
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
 
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
@@ -162,8 +169,18 @@ def sharded_ft_sgemm(
         in_specs=(P("x", "y"), P(None, "y"), c_spec),
         out_specs=(c_spec, P(None, None), P(None, None)),
     )
-    out, det, unc = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det, unc)
+    with telemetry.trace_span("sharded_ft_sgemm"):
+        out, det, unc = jax.jit(fn)(a, b, c)
+    result = FtSgemmResult(out, det, unc)
+    if telemetry.enabled():
+        # Counters arrive already psum-aggregated across the mesh; the
+        # device label records the mesh extent so fleet rollups can
+        # attribute counts per mesh topology.
+        telemetry.record_gemm(
+            "sharded_ft_sgemm", result, strategy=strategy,
+            device=f"mesh{mx}x{my}", operands=(a, b, c),
+            alpha=alpha, beta=beta)
+    return result
 
 
 def sharded_sgemm(

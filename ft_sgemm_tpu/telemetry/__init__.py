@@ -1,0 +1,438 @@
+"""Process-wide fault-telemetry subsystem.
+
+The stack's kernels uphold a *clean-or-reported* contract
+(``FtSgemmResult`` / ``FtAttentionResult`` counters), but until this
+module every caller summed those counters, compared to zero, and dropped
+them. Telemetry turns the reports into a persistent signal stream with
+three parts:
+
+1. **Metrics registry** (:mod:`.registry`) — thread-safe counters /
+   gauges / histograms keyed by name + labels (op, strategy, layer,
+   device), the process-wide aggregate a fleet exporter scrapes.
+2. **Structured fault-event log** (:mod:`.events`) — one JSON-lines
+   record per call that detected, corrected, or failed to correct a
+   fault (plus the training loop's retry / restore / raise ladder),
+   carrying step, op, tile coordinates, threshold, residual magnitude,
+   and outcome. ``python -m ft_sgemm_tpu.cli telemetry <log>``
+   summarizes one.
+3. **Profiler tracing** (:func:`trace_span`) — ``jax.profiler``
+   trace annotations around the FT ops and training steps, so fault
+   handling shows up in device profiles.
+
+Zero overhead when disabled — BY CONSTRUCTION, not by promise: every
+recording entry point returns before touching its arguments when
+telemetry is off, and recording itself is host-side Python over
+already-materialized values (never a traced op, never a callback), so the
+jitted HLO of any computation is byte-identical with telemetry on, off,
+or absent (``tests/test_telemetry.py`` pins this). The corollary: calls
+whose results are still tracers (an FT op invoked inside a caller's
+``jit``) skip event emission — recording observes values the host
+actually holds, it does not reach into device programs.
+
+Quickstart::
+
+    from ft_sgemm_tpu import telemetry
+
+    telemetry.configure(jsonl_path="faults.jsonl")
+    res = ft_sgemm(a, b, c, inject=InjectionSpec(enabled=True))
+    ...
+    telemetry.disable()
+    # then: python -m ft_sgemm_tpu.cli telemetry faults.jsonl
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ft_sgemm_tpu.telemetry.events import (
+    FaultEvent,
+    JsonlSink,
+    OUTCOMES,
+    format_summary,
+    read_events,
+    summarize_events,
+)
+from ft_sgemm_tpu.telemetry.registry import (
+    Counter,
+    DEFAULT_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+class _State:
+    """The process-wide telemetry session (one per process, like logging).
+
+    All mutation goes through :func:`configure` / :func:`disable` under
+    the lock; readers take the cheap unlocked fast path on ``enabled``
+    (a stale read costs one dropped or extra event at worst, never a
+    crash — the sink and registry are themselves thread-safe).
+    """
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.registry = MetricsRegistry()
+        self.sink: Optional[JsonlSink] = None
+        self.measure_residual = False
+        self.log_clean = False
+        self.step: Optional[int] = None
+
+
+_STATE = _State()
+
+
+def configure(jsonl_path=None, *, registry: Optional[MetricsRegistry] = None,
+              measure_residual: bool = False,
+              log_clean: bool = False) -> MetricsRegistry:
+    """Enable telemetry for this process.
+
+    ``jsonl_path`` (path or open text file) attaches the structured
+    fault-event sink; None records metrics only. ``measure_residual``
+    additionally measures each recorded GEMM's post-call column-checksum
+    residual host-side (numpy, O(MK + NK + MN) — the observability mode
+    for calibration runs; it forces a host transfer of the operands, so
+    leave it off on hot paths). ``log_clean`` writes an event for clean
+    calls too (residual observations from clean calls are the noise-floor
+    half of the calibration histogram). Returns the active registry.
+    """
+    global _STATE
+    with _STATE.lock:
+        if _STATE.sink is not None:
+            _STATE.sink.close()
+        if registry is not None:
+            _STATE.registry = registry
+        _STATE.sink = JsonlSink(jsonl_path) if jsonl_path is not None else None
+        _STATE.measure_residual = bool(measure_residual)
+        _STATE.log_clean = bool(log_clean)
+        _STATE.enabled = True
+        return _STATE.registry
+
+
+def disable() -> None:
+    """Turn telemetry off and close the event sink (registry is kept —
+    its aggregates remain readable after a run)."""
+    with _STATE.lock:
+        _STATE.enabled = False
+        if _STATE.sink is not None:
+            _STATE.sink.close()
+            _STATE.sink = None
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def get_registry() -> MetricsRegistry:
+    return _STATE.registry
+
+
+def reset() -> None:
+    """Disable AND drop all recorded state (tests / between runs)."""
+    disable()
+    with _STATE.lock:
+        _STATE.registry.reset()
+        _STATE.step = None
+        _STATE.measure_residual = False
+        _STATE.log_clean = False
+
+
+def set_step(step: Optional[int]) -> None:
+    """Tag subsequently recorded events with a training-step number
+    (training loops call this once per step; explicit ``step=`` args to
+    the record functions override it per event)."""
+    _STATE.step = None if step is None else int(step)
+
+
+_LOCAL = threading.local()
+
+
+def _suppressed() -> bool:
+    return getattr(_LOCAL, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def suppress():
+    """Suppress call-level recording in this thread for the scope.
+
+    Composite ops record hierarchically: attention wraps its inner FT
+    GEMMs, nn layers wrap their inner ops — the OUTERMOST recorder owns
+    the logical call, so one call produces exactly one event and summed
+    counters are never double-counted across nesting levels. Step-ladder
+    events (:func:`record_step_event`) are never suppressed: they are a
+    different stream (recovery transitions, not call reports).
+    """
+    _LOCAL.depth = getattr(_LOCAL, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _LOCAL.depth -= 1
+
+
+@contextlib.contextmanager
+def session(jsonl_path=None, **kw):
+    """``with telemetry.session("log.jsonl"): ...`` — configure on entry,
+    disable on exit (scoped form of :func:`configure`)."""
+    configure(jsonl_path, **kw)
+    try:
+        yield _STATE.registry
+    finally:
+        disable()
+
+
+# ---------------------------------------------------------------------------
+# Profiler tracing
+# ---------------------------------------------------------------------------
+
+
+def trace_span(name: str):
+    """Context manager: a ``jax.profiler`` trace annotation when telemetry
+    is enabled, a no-op otherwise.
+
+    ``TraceAnnotation`` marks host activity spans that bracket device
+    dispatch in profiler timelines; unlike ``jax.named_scope`` it adds
+    NOTHING to the jaxpr/HLO, so the zero-cost-off guarantee (and
+    HLO-identical on/off) holds. Ops wrap their dispatch in one of these
+    so fault-tolerant work is attributable in a trace.
+    """
+    if not _STATE.enabled:
+        return contextlib.nullcontext()
+    import jax
+
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler backend unavailable: never break the op
+        return contextlib.nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+
+def _concrete(x):
+    """The host value of ``x``, or None when it is a tracer / unavailable.
+
+    Recording only observes materialized values: inside a caller's jit
+    trace the counters are abstract and the call is skipped (the jitted
+    computation must not change because telemetry looked at it).
+    """
+    if x is None:
+        return None
+    import jax
+
+    if isinstance(x, jax.core.Tracer):
+        return None
+    try:
+        return np.asarray(x)
+    except Exception:
+        return None
+
+
+def _int_total(x) -> Optional[int]:
+    arr = _concrete(x)
+    return None if arr is None else int(np.sum(arr))
+
+
+def _float_or_none(x) -> Optional[float]:
+    arr = _concrete(x)
+    if arr is None or arr.size != 1:
+        return None
+    v = float(arr.reshape(()))
+    return v if np.isfinite(v) else None
+
+
+def _nonzero_tiles(x) -> Optional[list]:
+    arr = _concrete(x)
+    if arr is None or arr.ndim != 2:
+        return None
+    tiles = np.argwhere(arr != 0)
+    return [[int(i), int(j)] for i, j in tiles] if tiles.size else None
+
+
+def measure_output_residual(c_out, a, b, c_in=None, *, alpha=1.0,
+                            beta=0.0) -> Optional[float]:
+    """Max |column-checksum residual| of a returned GEMM output, measured
+    host-side with numpy (no device work, no trace impact).
+
+    The independent post-hoc check: ``1ᵀ C_out`` against
+    ``alpha · (1ᵀ A) Bᵀ + beta · 1ᵀ C_in`` — O(MK + NK + MN) vector work.
+    On clean/corrected calls this observes the run's actual noise floor
+    (the calibration input ``analysis.calibrate_threshold`` needs); on
+    uncorrected corruption it rises to fault scale. Returns None when any
+    operand is a tracer.
+    """
+    co = _concrete(c_out)
+    af = _concrete(a)
+    bf = _concrete(b)
+    if co is None or af is None or bf is None:
+        return None
+    af = af.astype(np.float32)
+    bf = bf.astype(np.float32)
+    expected = float(alpha) * (bf @ af.sum(axis=0, dtype=np.float32))
+    if c_in is not None and beta != 0.0:
+        ci = _concrete(c_in)
+        if ci is None:
+            return None
+        expected = expected + float(beta) * ci.astype(np.float32).sum(
+            axis=0, dtype=np.float32)
+    observed = co.astype(np.float32).sum(axis=0, dtype=np.float32)
+    return float(np.max(np.abs(expected - observed)))
+
+
+def _emit(event: FaultEvent) -> None:
+    sink = _STATE.sink
+    if sink is not None and (event.outcome != "clean" or _STATE.log_clean):
+        sink.write(event)
+
+
+def _series_labels(op, strategy, layer, device) -> dict:
+    labels = {"op": op}
+    if strategy:
+        labels["strategy"] = strategy
+    if layer:
+        labels["layer"] = layer
+    if device:
+        labels["device"] = device
+    return labels
+
+
+def record_gemm(op: str, result, *, strategy: Optional[str] = None,
+                step: Optional[int] = None, layer: Optional[str] = None,
+                device: Optional[str] = None, threshold=None,
+                operands=None, alpha: float = 1.0, beta: float = 0.0,
+                extra: Optional[dict] = None) -> Optional[FaultEvent]:
+    """Record one FT-GEMM call from its materialized result counters.
+
+    ``result`` is an :class:`~ft_sgemm_tpu.ops.ft_sgemm.FtSgemmResult`
+    (or anything with ``detections`` / ``uncorrectable``, e.g. the psum'd
+    counters of the sharded paths). No-op when telemetry is disabled or
+    the counters are tracers (call inside a caller's jit). ``operands``
+    — ``(a, b)`` or ``(a, b, c_in)`` — enables the host-side residual
+    measurement when ``configure(measure_residual=True)``; ``threshold``
+    is recorded when it is a concrete scalar. Returns the event (or None
+    when nothing was recorded).
+    """
+    if not _STATE.enabled or _suppressed():
+        return None
+    det = _int_total(getattr(result, "detections", None))
+    unc = _int_total(getattr(result, "uncorrectable", None))
+    if det is None or unc is None:
+        return None  # tracers: the caller is inside jit
+    corrected = 0 if strategy == "global" else det
+    outcome = ("uncorrectable" if unc else
+               "corrected" if det else "clean")
+    residual = None
+    if _STATE.measure_residual and operands is not None:
+        c_out = getattr(result, "c", getattr(result, "out", None))
+        residual = measure_output_residual(
+            c_out, operands[0], operands[1],
+            operands[2] if len(operands) > 2 else None,
+            alpha=alpha, beta=beta)
+    event = FaultEvent(
+        outcome=outcome, op=op, detected=det, corrected=corrected,
+        uncorrectable=unc,
+        step=_STATE.step if step is None else step,
+        strategy=strategy, layer=layer, device=device,
+        threshold=_float_or_none(threshold), residual=residual,
+        tiles=_nonzero_tiles(getattr(result, "detections", None)),
+        extra=extra)
+    reg = _STATE.registry
+    labels = _series_labels(op, strategy, layer, device)
+    reg.counter("ft_calls", **labels).inc()
+    reg.counter("ft_detections", **labels).inc(det)
+    reg.counter("ft_corrected", **labels).inc(corrected)
+    reg.counter("ft_uncorrectable", **labels).inc(unc)
+    if residual is not None:
+        reg.histogram("ft_residual", **labels).observe(residual)
+    _emit(event)
+    return event
+
+
+def record_attention(op: str, result, *, strategy: Optional[str] = None,
+                     step: Optional[int] = None,
+                     layer: Optional[str] = None,
+                     device: Optional[str] = None,
+                     extra: Optional[dict] = None) -> Optional[FaultEvent]:
+    """Record one FT-attention call (adds the softmax-stage flags the
+    GEMM record has no slot for). Same skip rules as :func:`record_gemm`.
+    """
+    if not _STATE.enabled or _suppressed():
+        return None
+    det = _int_total(getattr(result, "detections", None))
+    unc = _int_total(getattr(result, "uncorrectable", None))
+    flags = _int_total(getattr(result, "softmax_flags", None))
+    if det is None or unc is None:
+        return None
+    flags = flags or 0
+    outcome = ("uncorrectable" if (unc or flags) else
+               "corrected" if det else "clean")
+    merged = dict(extra or {})
+    merged["softmax_flags"] = flags
+    event = FaultEvent(
+        outcome=outcome, op=op, detected=det, corrected=det,
+        uncorrectable=unc,
+        step=_STATE.step if step is None else step,
+        strategy=strategy, layer=layer, device=device, extra=merged)
+    reg = _STATE.registry
+    labels = _series_labels(op, strategy, layer, device)
+    reg.counter("ft_calls", **labels).inc()
+    reg.counter("ft_detections", **labels).inc(det)
+    reg.counter("ft_corrected", **labels).inc(det)
+    reg.counter("ft_uncorrectable", **labels).inc(unc)
+    reg.counter("ft_softmax_flags", **labels).inc(flags)
+    _emit(event)
+    return event
+
+
+def record_step_event(outcome: str, *, op: str = "resilient_step",
+                      step: Optional[int] = None,
+                      uncorrectable: int = 0,
+                      extra: Optional[dict] = None) -> Optional[FaultEvent]:
+    """Record a training-loop recovery transition (``retry`` /
+    ``restore`` / ``raise`` / ``exhausted``). Always host-side (the loop
+    runs in Python); no-op when disabled, never suppressed (a different
+    stream from call reports — see :func:`suppress`)."""
+    if not _STATE.enabled:
+        return None
+    event = FaultEvent(
+        outcome=outcome, op=op,
+        uncorrectable=int(uncorrectable),
+        step=_STATE.step if step is None else step, extra=extra)
+    _STATE.registry.counter(
+        "ft_step_events", op=op, outcome=outcome).inc()
+    _emit(event)
+    return event
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "FaultEvent",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "OUTCOMES",
+    "configure",
+    "disable",
+    "enabled",
+    "format_summary",
+    "get_registry",
+    "measure_output_residual",
+    "read_events",
+    "record_attention",
+    "record_gemm",
+    "record_step_event",
+    "reset",
+    "session",
+    "set_step",
+    "summarize_events",
+    "suppress",
+    "trace_span",
+]

@@ -1,0 +1,238 @@
+"""Structured fault events and the JSON-lines sink.
+
+One :class:`FaultEvent` per GEMM / attention call or training-step
+transition that has something to report: what was detected, where (op,
+layer, tile coordinates), against what threshold, and what happened to it
+(outcome). Events serialize to JSON lines — an append-only, crash-tolerant
+format any log pipeline can ingest, and the raw input the adaptive-
+threshold work (V-ABFT, arXiv:2602.08043) needs: per-call residual
+magnitudes and fault statistics, which ``analysis.calibrate_threshold``
+currently has to re-measure from scratch.
+
+Everything here is host-side Python over already-concrete values; nothing
+imports jax, so writing events can never perturb a traced computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import IO, Iterable, Iterator, Optional
+
+# Event outcomes, the lifecycle a fault can take through the stack:
+#   clean          no fault this call (logged only when log_clean is set)
+#   corrected      in-kernel ABFT correction succeeded (detections > 0,
+#                  uncorrectable == 0)
+#   uncorrectable  residual-after-correct re-check still flags: output
+#                  unverified, caller must re-run
+#   retry / restore / raise / exhausted
+#                  training-loop recovery ladder stages
+#                  (train.resilient_step); "exhausted" is the non-raising
+#                  terminal — every recovery option spent, the last clean
+#                  state returned to the caller
+OUTCOMES = ("clean", "corrected", "uncorrectable", "retry", "restore",
+            "raise", "exhausted")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One structured record in the fault-event stream.
+
+    ``detected``/``corrected``/``uncorrectable`` carry the call's summed
+    counters (for correcting strategies corrected == detected; for the
+    detect-only ``global`` strategy corrected == 0). ``tiles`` lists the
+    ``[i, j]`` output-tile coordinates whose per-tile counter was nonzero
+    — the per-layer/per-tile attribution the attention-ABFT literature
+    (arXiv:2507.16676) shows matters in transformer stacks. ``residual``
+    is the call's max |checksum residual| when the emitter measured one
+    (see ``telemetry.record_gemm(measure_residual=...)``); None when not
+    measured. ``threshold`` is None when the call ran a traced/auto
+    threshold whose concrete value never materialized on host.
+    """
+
+    outcome: str
+    op: str
+    detected: int = 0
+    corrected: int = 0
+    uncorrectable: int = 0
+    step: Optional[int] = None
+    strategy: Optional[str] = None
+    layer: Optional[str] = None
+    device: Optional[str] = None
+    threshold: Optional[float] = None
+    residual: Optional[float] = None
+    tiles: Optional[list] = None
+    extra: Optional[dict] = None
+
+    def __post_init__(self):
+        if self.outcome not in OUTCOMES:
+            raise ValueError(
+                f"FaultEvent.outcome={self.outcome!r} not in {OUTCOMES}")
+
+    def to_json(self) -> str:
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if v is not None}
+        return json.dumps(d, sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultEvent":
+        known = {f.name for f in dataclasses.fields(FaultEvent)}
+        kw = {k: v for k, v in d.items() if k in known}
+        return FaultEvent(**kw)
+
+
+class JsonlSink:
+    """Append-only JSON-lines event sink, thread-safe.
+
+    One event per line, flushed per write (a crash loses at most the line
+    in flight — the same durability stance as bench.py's stage records).
+    Accepts a path (opened lazily, parent dirs created) or an open
+    text-mode file object (not closed on :meth:`close` unless owned).
+    """
+
+    def __init__(self, path_or_file):
+        self._lock = threading.Lock()
+        if hasattr(path_or_file, "write"):
+            self._fh: Optional[IO] = path_or_file
+            self._path = getattr(path_or_file, "name", None)
+            self._owns = False
+        else:
+            self._fh = None
+            self._path = os.fspath(path_or_file)
+            self._owns = True
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def write(self, event: FaultEvent) -> None:
+        with self._lock:
+            if self._fh is None:
+                if self._path is None:
+                    return  # closed file-object sink: nothing to reopen
+                parent = os.path.dirname(os.path.abspath(self._path))
+                os.makedirs(parent, exist_ok=True)
+                self._fh = open(self._path, "a", encoding="utf-8")
+            self._fh.write(event.to_json() + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and self._owns:
+                self._fh.close()
+            self._fh = None
+
+
+def read_events(path) -> Iterator[FaultEvent]:
+    """Iterate the events of a JSONL log; torn/foreign lines are skipped
+    (the log is append-only across crashes, so a torn tail is expected)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(d, dict) or "outcome" not in d:
+                continue
+            try:
+                yield FaultEvent.from_dict(d)
+            except (TypeError, ValueError):
+                continue
+
+
+def summarize_events(events: Iterable[FaultEvent]) -> dict:
+    """Aggregate an event stream into the summary the CLI prints.
+
+    Returns totals (events, detected, corrected, uncorrectable), per-op
+    and per-layer breakdowns, per-outcome counts, and a decade histogram
+    of observed residual magnitudes — the raw material
+    ``analysis.calibrate_threshold`` needs (clean-call residuals bound the
+    noise floor; fault residuals sit above the threshold).
+    """
+    from ft_sgemm_tpu.telemetry.registry import DEFAULT_BUCKETS, Histogram
+
+    totals = {"events": 0, "detected": 0, "corrected": 0,
+              "uncorrectable": 0}
+    per_op: dict = {}
+    per_layer: dict = {}
+    outcomes: dict = {}
+    hist = Histogram("residual", (), DEFAULT_BUCKETS)
+    call_outcomes = ("clean", "corrected", "uncorrectable")
+    for ev in events:
+        totals["events"] += 1
+        outcomes[ev.outcome] = outcomes.get(ev.outcome, 0) + 1
+        if ev.outcome not in call_outcomes:
+            # Recovery-ladder events (retry/restore/raise) echo the
+            # uncorrectable count of a call that already recorded its own
+            # event: summing them too would double-count the counters.
+            continue
+        totals["detected"] += ev.detected
+        totals["corrected"] += ev.corrected
+        totals["uncorrectable"] += ev.uncorrectable
+        for key, table in ((ev.op, per_op), (ev.layer, per_layer)):
+            if key is None:
+                continue
+            row = table.setdefault(
+                key, {"events": 0, "detected": 0, "corrected": 0,
+                      "uncorrectable": 0})
+            row["events"] += 1
+            row["detected"] += ev.detected
+            row["corrected"] += ev.corrected
+            row["uncorrectable"] += ev.uncorrectable
+        if ev.residual is not None:
+            hist.observe(ev.residual)
+    return {"totals": totals, "outcomes": outcomes, "per_op": per_op,
+            "per_layer": per_layer, "residuals": hist.value}
+
+
+def format_summary(summary: dict) -> str:
+    """Human-readable rendering of :func:`summarize_events` output."""
+    lines = []
+    t = summary["totals"]
+    lines.append(f"events: {t['events']}  detected: {t['detected']}  "
+                 f"corrected: {t['corrected']}  "
+                 f"uncorrectable: {t['uncorrectable']}")
+    if summary["outcomes"]:
+        lines.append("outcomes: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(summary["outcomes"].items())))
+    for title, table in (("per-op", summary["per_op"]),
+                         ("per-layer", summary["per_layer"])):
+        if not table:
+            continue
+        lines.append(f"{title}:")
+        width = max(len(k) for k in table)
+        for name in sorted(table):
+            row = table[name]
+            rate = (row["detected"] / row["events"]
+                    if row["events"] else 0.0)
+            lines.append(
+                f"  {name:<{width}}  events={row['events']:<6d} "
+                f"detected={row['detected']:<6d} "
+                f"corrected={row['corrected']:<6d} "
+                f"uncorrectable={row['uncorrectable']:<6d} "
+                f"det/call={rate:.2f}")
+    h = summary["residuals"]
+    if h["count"]:
+        lines.append(f"residual histogram ({h['count']} observations, "
+                     f"mean {h['sum'] / h['count']:.3g}):")
+        lo = float("-inf")
+        peak = max(h["counts"]) or 1
+        for ub, n in zip(h["buckets"], h["counts"]):
+            if n:
+                bar = "#" * max(1, round(40 * n / peak))
+                lines.append(f"  ({lo:>8.1e}, {ub:>8.1e}]  {n:>6d}  {bar}")
+            lo = ub
+    else:
+        lines.append("residual histogram: no residual observations "
+                     "(enable measure_residual or log residual-bearing "
+                     "events)")
+    return "\n".join(lines)
+
+
+__all__ = ["FaultEvent", "JsonlSink", "OUTCOMES", "format_summary",
+           "read_events", "summarize_events"]

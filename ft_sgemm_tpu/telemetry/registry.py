@@ -1,0 +1,188 @@
+"""Thread-safe metrics registry: counters, gauges, histograms.
+
+The process-wide aggregation layer of the fault-telemetry subsystem
+(:mod:`ft_sgemm_tpu.telemetry`). Metrics are keyed by ``(name, labels)``
+where labels is a frozen set of ``key=value`` pairs — the Prometheus data
+model, host-side only. Nothing here ever touches a JAX trace: recording
+takes already-materialized Python/numpy scalars, so enabling or disabling
+telemetry cannot change a jitted computation's HLO by construction (the
+property ``tests/test_telemetry.py`` pins byte-for-byte).
+
+Zero-overhead-off is enforced one layer up: :mod:`ft_sgemm_tpu.telemetry`
+only calls into a registry when telemetry is enabled, and ops guard their
+emission on ``telemetry.enabled()`` before doing any host transfer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[dict]) -> LabelKey:
+    """Canonical (sorted, stringified) label tuple for dict keying."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter for one ``(name, labels)`` series."""
+
+    def __init__(self, name: str, labels: LabelKey):
+        self.name = name
+        self.labels = labels
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: int = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-value-wins gauge for one ``(name, labels)`` series."""
+
+    def __init__(self, name: str, labels: LabelKey):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+# Default histogram buckets span the residual scales the stack actually
+# produces: auto-calibrated thresholds land near 1e-2 on quantized data
+# (analysis.estimate_noise_floor), the reference operating point at 9.5e3,
+# injected faults at 1e4 — decades from 1e-6 up cover all of it, with a
+# +inf overflow bucket so no observation is ever dropped.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    10.0 ** e for e in range(-6, 7)) + (float("inf"),)
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative-style buckets, like Prometheus).
+
+    ``observe(v)`` increments the first bucket whose upper bound is
+    >= v; ``counts`` returns per-bucket (non-cumulative) counts plus
+    running sum/count so means stay recoverable.
+    """
+
+    def __init__(self, name: str, labels: LabelKey,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b or b[-1] != float("inf"):
+            b = b + (float("inf"),)
+        self.name = name
+        self.labels = labels
+        self.buckets = b
+        self._counts = [0] * len(b)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    self._counts[i] += 1
+                    break
+            self._sum += v
+            self._count += 1
+
+    @property
+    def value(self) -> dict:
+        with self._lock:
+            return {"buckets": list(self.buckets),
+                    "counts": list(self._counts),
+                    "sum": self._sum, "count": self._count}
+
+
+class MetricsRegistry:
+    """Process-wide metric store, thread-safe, keyed by name + labels.
+
+    ``counter``/``gauge``/``histogram`` get-or-create a series (same
+    name+labels always returns the same object, so hot paths may cache
+    the handle). ``collect`` snapshots everything for export or the CLI
+    summarizer; ``total`` aggregates one counter name across all label
+    sets, optionally filtered (the query the re-run gates and tests ask).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, str, LabelKey], object] = {}
+
+    def _get(self, kind: str, cls, name: str, labels: Optional[dict],
+             **kw):
+        key = (kind, name, _label_key(labels))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = cls(name, key[2], **kw)
+                self._series[key] = s
+            return s
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get("histogram", Histogram, name, labels,
+                         buckets=buckets)
+
+    def collect(self) -> list[dict]:
+        """Snapshot: one dict per series (kind, name, labels, value)."""
+        with self._lock:
+            series = list(self._series.items())
+        return [{"kind": kind, "name": name, "labels": dict(labels),
+                 "value": s.value}
+                for (kind, name, labels), s in series]
+
+    def total(self, name: str, **label_filter) -> int:
+        """Sum a counter across every label set matching the filter.
+
+        ``total("ft_detections", op="ft_sgemm")`` sums all strategies /
+        layers / devices of that op; no filter sums everything under the
+        name. Missing series sum to 0 (absence is a real answer).
+        """
+        want = {str(k): str(v) for k, v in label_filter.items()}
+        out = 0
+        with self._lock:
+            series = list(self._series.items())
+        for (kind, nm, labels), s in series:
+            if kind != "counter" or nm != name:
+                continue
+            have = dict(labels)
+            if all(have.get(k) == v for k, v in want.items()):
+                out += s.value
+        return out
+
+    def reset(self) -> None:
+        """Drop every series (tests; between independent runs)."""
+        with self._lock:
+            self._series.clear()
+
+
+__all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
+           "MetricsRegistry"]

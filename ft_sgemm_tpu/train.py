@@ -28,7 +28,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
-from ft_sgemm_tpu.checkpoint import _gate_total
+from ft_sgemm_tpu import telemetry
+from ft_sgemm_tpu.checkpoint import gate_total
 
 __all__ = ["UncorrectableStepError", "StepReport", "resilient_step"]
 
@@ -97,20 +98,30 @@ def resilient_step(
     """
 
     def attempt(s):
-        new_state, metrics, unc = step_fn(s)
-        return new_state, metrics, _gate_total(unc)
+        with telemetry.trace_span("resilient_step.attempt"):
+            new_state, metrics, unc = step_fn(s)
+        return new_state, metrics, gate_total(unc)
 
     attempts = 0
-    for _ in range(max_retries + 1):
+    for i in range(max_retries + 1):
         new_state, metrics, unc = attempt(state)
         attempts += 1
         if unc == 0:
             return new_state, metrics, StepReport(attempts - 1, None, 0)
+        if i < max_retries:
+            # A reported fault forces the next attempt from the same
+            # pre-step state: one telemetry record per forced retry.
+            telemetry.record_step_event(
+                "retry", uncorrectable=unc, extra={"attempt": attempts})
 
     restored_step = None
     if checkpointer is not None:
         restored_step = checkpointer.latest_step
         if restored_step is not None:
+            telemetry.record_step_event(
+                "restore", uncorrectable=unc,
+                extra={"restored_step": int(restored_step),
+                       "attempt": attempts})
             target = state if restore_target is None else restore_target
             state = checkpointer.restore(restored_step, target)
             new_state, metrics, unc = attempt(state)
@@ -119,6 +130,12 @@ def resilient_step(
                 return new_state, metrics, StepReport(
                     attempts - 1, restored_step, 0)
 
+    telemetry.record_step_event(
+        "raise" if raise_on_failure else "exhausted",
+        uncorrectable=unc,
+        extra={"attempt": attempts,
+               "restored_step": (None if restored_step is None
+                                 else int(restored_step))})
     if raise_on_failure:
         raise UncorrectableStepError(
             f"step reported uncorrectable faults through {attempts} "

@@ -94,11 +94,16 @@ def test_initialize_checks_state_not_message(monkeypatch):
     def must_not_call(**kw):
         raise AssertionError("initialize() called despite live runtime")
 
-    monkeypatch.setattr(mh.jax.distributed, "is_initialized", lambda: True)
+    # raising=False: older jax has no public is_initialized — the module
+    # falls back to the client singleton, but the patched attribute (when
+    # injectable) is still what it must consult first.
+    monkeypatch.setattr(mh.jax.distributed, "is_initialized", lambda: True,
+                        raising=False)
     monkeypatch.setattr(mh.jax.distributed, "initialize", must_not_call)
     mh.initialize()  # already initialized: no call, no raise
 
-    monkeypatch.setattr(mh.jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(mh.jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
 
     def fails(**kw):
         raise RuntimeError("coordinator said: connect at most once, already dead")

@@ -167,3 +167,91 @@ def test_integration_with_ftdense_step():
         lambda a, b: bool(jnp.any(a != b)),
         state["params"], new_state["params"])
     assert any(jax.tree.leaves(changed))
+
+
+def test_adversarial_schedule_drives_full_ladder_with_telemetry(tmp_path):
+    """End-to-end satellite: the adversarial injection schedule
+    (``col_stride=0`` pins every fault to one column, defeating
+    per-column localization) drives a REAL uncorrectable report through
+    resilient_step's retry -> restore -> raise ladder, and telemetry
+    records every stage of it."""
+    from ft_sgemm_tpu import InjectionSpec, ft_sgemm, telemetry
+    from ft_sgemm_tpu.checkpoint import FtCheckpointer
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.telemetry import read_events
+
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    rng = np.random.default_rng(10)
+    a = rng.standard_normal((128, 256)).astype(np.float32)
+    b = rng.standard_normal((128, 256)).astype(np.float32)
+    c = rng.standard_normal((128, 128)).astype(np.float32)
+    # every=1 over nk=2 K-steps: two same-column faults per (single,
+    # final) check interval — the case column localization provably
+    # cannot correct; the kernel's residual-after-correct re-check must
+    # REPORT it.
+    adversarial = InjectionSpec(enabled=True, every=1, col_stride=0)
+
+    def step(state):
+        res = ft_sgemm(a, b, c, tile, inject=adversarial)
+        unc = int(res.num_uncorrectable)
+        assert unc > 0, "adversarial schedule must defeat correction"
+        return state, {"loss": 0.0}, unc
+
+    log = tmp_path / "ladder.jsonl"
+    telemetry.reset()
+    try:
+        with FtCheckpointer(tmp_path / "ck") as ck:
+            assert ck.save(3, {"w": jnp.zeros(2)})
+            ck.wait()
+            with telemetry.session(log):
+                with pytest.raises(UncorrectableStepError,
+                                   match="checkpoint step 3"):
+                    resilient_step(step, {"w": jnp.ones(2)}, max_retries=2,
+                                   checkpointer=ck,
+                                   restore_target={"w": jnp.zeros(2)})
+    finally:
+        telemetry.reset()
+
+    events = list(read_events(log))
+    outcomes = [e.outcome for e in events]
+    # Every attempt's GEMM recorded its own uncorrectable call event:
+    # 3 live attempts + 1 post-restore attempt.
+    assert outcomes.count("uncorrectable") == 4
+    # The ladder: one retry record per forced re-attempt, then the
+    # restore, then the raise — in that order.
+    ladder = [o for o in outcomes if o in ("retry", "restore", "raise")]
+    assert ladder == ["retry", "retry", "restore", "raise"]
+    restore = next(e for e in events if e.outcome == "restore")
+    assert restore.extra["restored_step"] == 3
+    # Call events carry nonzero uncorrectable counters; ladder records
+    # echo the gate total that forced them.
+    assert all(e.uncorrectable > 0 for e in events)
+
+
+def test_gate_total_is_public_with_deprecated_alias():
+    from ft_sgemm_tpu import checkpoint
+
+    assert checkpoint._gate_total is checkpoint.gate_total
+    assert checkpoint.gate_total({"unc": jnp.asarray(2)}) == 2
+    with pytest.raises(ValueError, match="UNCORRECTABLE counts only"):
+        checkpoint.gate_total({"detections": 1})
+
+
+def test_exhausted_outcome_recorded_when_not_raising(tmp_path):
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.telemetry import read_events
+
+    log = tmp_path / "exhausted.jsonl"
+    step, _ = _flaky(10)
+    telemetry.reset()
+    try:
+        with telemetry.session(log):
+            state, metrics, rep = resilient_step(
+                step, 10, max_retries=1, raise_on_failure=False)
+    finally:
+        telemetry.reset()
+    assert state == 10 and metrics is None and rep.uncorrectable == 1
+    # "exhausted" (not a call outcome): the summarizer must not fold its
+    # echoed count into the call-counter totals.
+    outcomes = [e.outcome for e in read_events(log)]
+    assert outcomes == ["retry", "exhausted"]
