@@ -9,6 +9,13 @@ Also embeds context fields: XLA f32 dot GFLOPS on the same chip and the
 fraction of it we reach (north-star target >= 0.80, BASELINE.json), the
 plain (non-FT) kernel GFLOPS, and the fused-ABFT overhead.
 
+``--tuned`` adds an ``ft_tuned`` stage: the same injected headline kernel
+dispatched through the autotuner's tile cache (``ft_sgemm_tpu.tuner`` —
+seed it with ``python -m ft_sgemm_tpu.cli tune 4096`` in a prior window),
+so the artifact reports heuristic-vs-tuned GFLOPS side by side
+(``context.abft_tuned_gflops`` / ``context.tuned_block``). Fails soft:
+with no cache entry the stage records why and the headline is untouched.
+
 Architecture (round-3 rework): a SUPERVISOR / WORKER split.
 
 Rounds 1 and 2 both lost their number to the axon TPU tunnel:
@@ -415,6 +422,9 @@ def _emit_locked(values, errors, extra_errors=None):
         # detected/uncorrectable counters ride the artifact so SDC
         # activity is auditable from the JSON alone.
         "fault_counters": "fault_counters",
+        # Autotuner comparison (--tuned): cache-dispatched kernel GFLOPS
+        # plus the tile the cache served, next to the heuristic rows.
+        "ft_tuned": "abft_tuned",
     }
     for src, dst in key_map.items():
         if src in values and values[src] is not None:
@@ -1129,6 +1139,33 @@ def _worker_stages(rec):
 
     record_retry("ft_fused", fused_fn, attempts=2)
 
+    if os.environ.get("FT_SGEMM_BENCH_TUNED"):
+        # --tuned: the headline kernel dispatched through the autotuner's
+        # persisted tile cache, side by side with the heuristic rows. The
+        # named-shape factory consults the cache by itself; the explicit
+        # lookup here is to (a) skip the stage honestly when there is no
+        # entry (re-measuring the heuristic would be a lie labeled
+        # "tuned") and (b) record WHICH tile the cache served.
+        def tuned_fn():
+            from ft_sgemm_tpu import tuner
+
+            tile = tuner.lookup_tile(SIZE, SIZE, SIZE, strategy="weighted",
+                                     in_dtype="float32",
+                                     injection_enabled=True)
+            if tile is None:
+                raise RuntimeError(
+                    "no tuned cache entry for "
+                    + tuner.make_key(SIZE, SIZE, SIZE, strategy="weighted",
+                                     in_dtype="float32",
+                                     injection_enabled=True)
+                    + f" in {tuner.cache_path()}; run `python -m"
+                    f" ft_sgemm_tpu.cli tune {SIZE} --inject` first")
+            ft_t = make_ft_sgemm("huge", alpha=1.0, beta=-1.5)
+            val = gf(lambda a, b, x: ft_t(a, b, x, inj).c, a, b, c)
+            return {"gflops": round(val, 1), "tuned_block": list(tile.block)}
+
+        record_retry("ft_tuned", tuned_fn, attempts=2)
+
     # TPU-native bf16 input mode (f32 accumulation + checksums): the MXU's
     # full-rate path — context only; the headline stays f32 for reference
     # parity (the reference is SGEMM).
@@ -1201,4 +1238,8 @@ def _worker_stages(rec):
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         sys.exit(worker_main(sys.argv[2]))
+    if "--tuned" in sys.argv[1:]:
+        # The worker inherits the supervisor's env (attempt launches build
+        # env from os.environ), so one flag covers every relaunch.
+        os.environ["FT_SGEMM_BENCH_TUNED"] = "1"
     sys.exit(main())

@@ -23,7 +23,7 @@ become tile-axis reductions, shared-memory double buffering becomes Mosaic's
 automatically pipelined VMEM blocks.
 """
 
-from ft_sgemm_tpu import telemetry, utils
+from ft_sgemm_tpu import telemetry, tuner, utils
 from ft_sgemm_tpu.configs import (
     KernelShape,
     SHAPES,
@@ -78,4 +78,5 @@ __all__ = [
     "ft_matmul",
     "make_ft_matmul",
     "telemetry",
+    "tuner",
 ]

@@ -29,6 +29,23 @@ Usage:
         [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
         [--telemetry=LOG.jsonl]
     python -m ft_sgemm_tpu.cli telemetry LOG.jsonl
+    python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
+        [--dtype=...] [--plain] [--inject] [--budget=N] [--reps=N] \
+        [--samples=N] [--method=wall|interpret|compile] [--dry-run]
+    python -m ft_sgemm_tpu.cli tune-show
+
+``tune`` runs the autotuner (``ft_sgemm_tpu.tuner``): enumerate the legal
+tile space for the problem, prune candidates the VMEM footprint model
+rejects, measure the survivors (warmup + median-of-k), and persist the
+winner in the tile cache (``FT_SGEMM_TUNER_CACHE`` or
+``~/.cache/ft_sgemm_tpu/tuner_cache.json``) keyed by device kind, size
+bucket, dtype, strategy, and injection. Later dispatches of the same key
+pick the cached tile automatically. ``--dry-run`` stops after the static
+prune and prints the candidate table (no measurement, no cache write —
+runs anywhere, including CPU CI). On a non-TPU backend measurement falls
+back to Pallas interpret mode: the machinery is exercised end to end, and
+the entries land under the CPU device kind (they never serve a TPU).
+``tune-show`` prints the persisted entries.
 
 ``--telemetry=LOG.jsonl`` enables the fault-telemetry subsystem for the
 run (``ft_sgemm_tpu.telemetry``): every FT kernel call appends a
@@ -116,10 +133,13 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     return lambda a, b, c: ft(a, b, c, inj).c
 
 
-def print_device_info(out=sys.stdout) -> None:
+def print_device_info(out=None) -> None:
     """Hardware line before any results — the reference's ``getDetails``
     (``utils/utils.cu:8-13``: device name, clock, memory) adapted to the
-    JAX device model."""
+    JAX device model. ``out`` resolves to stdout at CALL time (a def-time
+    default would pin whatever sys.stdout was at first import — stale
+    under test capture, same hazard run_telemetry_summary documents)."""
+    out = sys.stdout if out is None else out
     try:
         devs = jax.devices()
         kind = getattr(devs[0], "device_kind", devs[0].platform)
@@ -302,10 +322,141 @@ def run_telemetry_summary(log_path: str, out=None) -> int:
     return 0
 
 
+def run_tune(args, flags, out=None) -> int:
+    """``tune`` subcommand: search the tile space, persist the winner."""
+    from ft_sgemm_tpu import tuner
+
+    out = sys.stdout if out is None else out
+    try:
+        sizes = [int(a) for a in args]
+    except ValueError:
+        print(f"ft_sgemm: tune sizes must be integers, got {args}",
+              file=sys.stderr)
+        return 2
+    if len(sizes) == 0:
+        m = n = k = 1024
+    elif len(sizes) == 1:
+        m = n = k = sizes[0]
+    elif len(sizes) == 3:
+        m, n, k = sizes
+    else:
+        print("ft_sgemm: tune takes SIZE or M N K", file=sys.stderr)
+        return 2
+    strategy = "weighted"
+    in_dtype = "float32"
+    budget = 8
+    method = None
+    reps, samples = 3, 3
+    for f in flags:
+        if f.startswith("--strategy="):
+            strategy = f.split("=", 1)[1]
+            if strategy not in STRATEGIES:
+                print(f"--strategy must be one of {STRATEGIES}, got"
+                      f" {strategy!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--dtype="):
+            in_dtype = f.split("=", 1)[1]
+            if in_dtype not in ("float32", "bfloat16"):
+                print(f"--dtype must be float32 or bfloat16, got"
+                      f" {in_dtype!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--budget="):
+            budget = int(f.split("=", 1)[1])
+        elif f.startswith("--reps="):
+            reps = int(f.split("=", 1)[1])
+        elif f.startswith("--samples="):
+            samples = int(f.split("=", 1)[1])
+        elif f.startswith("--method="):
+            method = f.split("=", 1)[1]
+            if method not in tuner.METHODS:
+                print(f"--method must be one of {tuner.METHODS}, got"
+                      f" {method!r}", file=sys.stderr)
+                return 2
+    if "--plain" in flags:
+        strategy = None
+    dry_run = "--dry-run" in flags
+
+    print_device_info()
+
+    def progress(r):
+        if r.ok and r.gflops is not None:
+            print(f"  {str(tuple(r.block)):>18s}  {r.gflops:9.1f} GFLOPS"
+                  f"  [{r.method}]", file=out, flush=True)
+        elif r.ok:
+            print(f"  {str(tuple(r.block)):>18s}  compiled ok"
+                  f"  (grid-step score {r.score:.0f})", file=out, flush=True)
+        else:
+            print(f"  {str(tuple(r.block)):>18s}  FAILED: {r.error}",
+                  file=out, flush=True)
+
+    report = tuner.tune(
+        m, n, k, strategy=strategy, in_dtype=in_dtype,
+        inject="--inject" in flags, method=method, budget=budget,
+        reps=reps, samples=samples, dry_run=dry_run, progress=progress)
+    strat = report["strategy"]
+    print(f"tune {m}x{n}x{k} strategy={strat} dtype={in_dtype}"
+          f" method={report['method']} key={report['key']}", file=out)
+    print(f"candidates: {len(report['feasible'])} feasible,"
+          f" {len(report['pruned'])} pruned", file=out)
+    if dry_run:
+        shown = 0
+        for p in report["pruned"]:
+            if "VMEM" in p["reason"]:
+                print(f"  pruned {str(tuple(p['block'])):>18s}:"
+                      f" {p['reason']}", file=out)
+                shown += 1
+                if shown >= 10:
+                    print(f"  ... ({len(report['pruned']) - shown} more"
+                          " pruned)", file=out)
+                    break
+        print("dry run: nothing measured, nothing written", file=out)
+        return 0
+    best = report.get("best")
+    heur = report.get("heuristic")
+    if best is None:
+        print("tune: no candidate measured successfully", file=sys.stderr)
+        return 1
+    print(f"heuristic {tuple(heur['block'])}: "
+          + (f"{heur['gflops']:.1f} GFLOPS" if heur and heur.get("gflops")
+             else "n/a"), file=out)
+    print(f"best      {tuple(best['block'])}: "
+          + (f"{best['gflops']:.1f} GFLOPS" if best.get("gflops")
+             else f"score {best['score']:.0f}"), file=out)
+    print(f"cache written: {report.get('cache_path')}", file=out)
+    return 0
+
+
+def run_tune_show(out=None) -> int:
+    """``tune-show`` subcommand: print the persisted tile-cache entries."""
+    from ft_sgemm_tpu import tuner
+
+    out = sys.stdout if out is None else out
+    path = tuner.cache_path()
+    entries = tuner.cache.load_entries(path)
+    print(f"tile cache {path} (schema {tuner.cache.SCHEMA_VERSION}):"
+          f" {len(entries)} entries", file=out)
+    for key in sorted(entries):
+        rec = entries[key]
+        gf = rec.get("gflops")
+        hgf = rec.get("heuristic_gflops")
+        extra = ""
+        if gf:
+            extra += f"  {gf:9.1f} GFLOPS"
+        if gf and hgf:
+            extra += f"  (heuristic {hgf:.1f}, x{gf / hgf:.3f})"
+        print(f"  {key}  ->  {tuple(rec['block'])}"
+              f"  [{rec.get('method', '?')}]{extra}", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
     flags = {a for a in argv[1:] if a.startswith("--")}
+    if args and args[0] == "tune":
+        return run_tune(args[1:], flags)
+    if args and args[0] == "tune-show":
+        return run_tune_show()
     if args and args[0] == "telemetry":
         if len(args) < 2:
             print(__doc__)

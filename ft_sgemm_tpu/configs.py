@@ -19,6 +19,8 @@ not the literal numbers — see SURVEY.md §7 "Hard parts".
 from __future__ import annotations
 
 import dataclasses
+import functools
+import re
 from typing import Optional, Tuple
 
 
@@ -94,14 +96,31 @@ def vmem_limit_bytes() -> int:
     per core). Older generations have 16 MiB total — on those, a raised
     compiler bound would only defer the failure from a clear compile-time
     scoped-vmem error to a runtime allocation failure, so the limit is
-    derived from the live device kind. ``FT_SGEMM_VMEM_LIMIT_BYTES``
-    overrides both (trace-time; takes effect on the next compile).
+    derived from the live device kind (matched as a standalone ``v2``/
+    ``v3`` token — a bare substring test would misfire on any future kind
+    string that merely contains the characters). ``FT_SGEMM_VMEM_LIMIT_
+    BYTES`` overrides both (trace-time; takes effect on the next compile).
+    The resolution is cached per env-var value: every kernel trace calls
+    this, and the device query must not be re-paid each time.
     """
     import os
 
-    env = os.environ.get("FT_SGEMM_VMEM_LIMIT_BYTES")
+    return _resolve_vmem_limit(os.environ.get("FT_SGEMM_VMEM_LIMIT_BYTES"))
+
+
+@functools.lru_cache(maxsize=None)
+def _resolve_vmem_limit(env: Optional[str]) -> int:
     if env:
-        return int(env)
+        try:
+            value = int(env)
+        except ValueError:
+            raise ValueError(
+                f"FT_SGEMM_VMEM_LIMIT_BYTES must be an integer byte count,"
+                f" got {env!r}") from None
+        if value <= 0:
+            raise ValueError(
+                f"FT_SGEMM_VMEM_LIMIT_BYTES must be positive, got {env!r}")
+        return value
     kind = ""
     try:
         import jax
@@ -109,7 +128,8 @@ def vmem_limit_bytes() -> int:
         kind = jax.local_devices()[0].device_kind.lower()
     except Exception:  # noqa: BLE001 — no backend yet: assume the default
         pass
-    if "v2" in kind or "v3" in kind:
+    tokens = re.split(r"[^a-z0-9]+", kind)
+    if "v2" in tokens or "v3" in tokens:
         return 16 * 1024 * 1024
     return VMEM_LIMIT_BYTES
 

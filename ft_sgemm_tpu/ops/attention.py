@@ -61,7 +61,11 @@ from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
 # shallow-K tile avoids padding the contraction several-fold. P@V contracts
 # over the (long) key sequence with a narrow output (dv columns): K-deep,
 # bn-minimal. Explicit KernelShape objects are used as-is (no auto-shrink);
-# small problems pad up to these tiles — pass smaller shapes to tune.
+# small problems pad up to these tiles — pass smaller shapes to tune. When
+# these DEFAULTS are in play, the factories opt the inner GEMMs into the
+# autotuner's cache-backed dispatch (ft_sgemm_tpu.tuner): a persisted
+# winner for the QK/PV problem key overrides them, a cache miss changes
+# nothing. A caller-supplied shape is always respected as-is.
 QK_SHAPE = KernelShape("attn_qk", 256, 256, 128, (0,) * 7)
 PV_SHAPE = KernelShape("attn_pv", 256, 128, 512, (0,) * 7)
 
@@ -227,10 +231,10 @@ def make_ft_attention(
     """
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
                        threshold=threshold, in_dtype=in_dtype,
-                       interpret=interpret)
+                       interpret=interpret, tunable=qk_shape is QK_SHAPE)
     pv = make_ft_sgemm(pv_shape, alpha=1.0, beta=0.0, strategy=strategy,
                        threshold=threshold, in_dtype=in_dtype,
-                       interpret=interpret)
+                       interpret=interpret, tunable=pv_shape is PV_SHAPE)
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
         # suppress(): the inner QK/PV GEMMs must not record their own
@@ -320,7 +324,8 @@ def make_ft_attention_diff(
     bthr = threshold if bwd_threshold is None else bwd_threshold
     mk = lambda shp, thr: make_ft_sgemm(  # noqa: E731
         shp, alpha=1.0, beta=0.0, strategy=strategy, threshold=thr,
-        in_dtype=in_dtype, interpret=interpret)
+        in_dtype=in_dtype, interpret=interpret,
+        tunable=shp is QK_SHAPE or shp is PV_SHAPE)
     qk = mk(qk_shape, threshold)
     pv = mk(pv_shape, threshold)
     # Long-contraction grads (dV, dQ, dK) share pv's profile; the

@@ -933,6 +933,7 @@ def make_ft_sgemm(
     in_dtype: str = "float32",
     multifault: Optional[bool] = None,
     interpret: Optional[bool] = None,
+    tunable: Optional[bool] = None,
 ):
     """Build the fused-ABFT SGEMM for one named shape.
 
@@ -976,6 +977,16 @@ def make_ft_sgemm(
     With the reference's quantized inputs at 4096 this lands near 0.02
     instead of 9500: faults five orders of magnitude smaller become
     reliably detectable, at an unchanged false-positive margin.
+
+    ``tunable`` controls whether dispatch consults the autotuner's tile
+    cache (``ft_sgemm_tpu.tuner``). Default ``None`` resolves to "named
+    shapes only": a persisted winner for this call's
+    ``(device, M/N/K bucket, dtype, strategy, injection)`` key then
+    overrides the heuristic block choice; with no cache entry (or tuning
+    disabled) the dispatch path — and the emitted HLO — is untouched.
+    Explicit ``KernelShape`` objects stay un-tuned by default (a tile
+    sweep measures the tile its row label claims); the attention
+    factories opt their default tiles in with ``tunable=True``.
     """
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
@@ -984,6 +995,7 @@ def make_ft_sgemm(
             f"threshold must be a float or 'auto', got {threshold!r}")
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
     named = isinstance(shape, str)
+    tunable = named if tunable is None else bool(tunable)
     if named:
         # Named shapes pick up the dtype-tuned tile; explicit KernelShape
         # objects are always respected as-is — including no auto-shrinking,
@@ -1000,6 +1012,19 @@ def make_ft_sgemm(
         # (placeholder; thresholds are computed after the tile resolves,
         # since the re-check scales depend on bm — see below)
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+        if tunable:
+            # Cache-backed dispatch: a persisted tuned winner for this
+            # exact (device, size bucket, dtype, strategy, injection) key
+            # overrides the heuristic tile. Pure host-side lookup — a miss
+            # (or tuning disabled) leaves eff, and therefore the traced
+            # computation, bit-for-bit unchanged.
+            from ft_sgemm_tpu import tuner as _tuner
+
+            tuned = _tuner.lookup_tile(
+                m, n, a.shape[1], strategy=strategy, in_dtype=in_dtype,
+                injection_enabled=inject.enabled)
+            if tuned is not None:
+                eff = tuned
 
         def resolve_cadence(e):
             """nk and the effective check cadence at tile ``e``.

@@ -124,6 +124,7 @@ def make_sgemm(
     precision: str = "highest",
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    tunable: Optional[bool] = None,
 ):
     """Build the plain SGEMM for one named shape.
 
@@ -135,9 +136,15 @@ def make_sgemm(
     C and the accumulator stay f32. ``precision`` only applies to f32 inputs
     (XLA splits f32 operands into bf16 passes per the precision level; bf16
     operands are already single-pass).
+
+    ``tunable`` (default: named shapes only) lets a persisted autotuner
+    winner (``ft_sgemm_tpu.tuner``) override the heuristic tile; a cache
+    miss or disabled tuning leaves dispatch — and the emitted HLO —
+    untouched (same contract as :func:`make_ft_sgemm`).
     """
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
     named = isinstance(shape, str)
+    tunable = named if tunable is None else bool(tunable)
     if named:
         # Named shapes pick up the dtype-tuned tile; explicit KernelShape
         # objects are always respected as-is — including no auto-shrinking,
@@ -151,6 +158,16 @@ def make_sgemm(
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+        if tunable:
+            # Cache-backed dispatch (see make_ft_sgemm): a persisted tuned
+            # winner overrides the heuristic tile; a miss changes nothing.
+            from ft_sgemm_tpu import tuner as _tuner
+
+            tuned = _tuner.lookup_tile(
+                m, n, a.shape[1], strategy=None, in_dtype=in_dtype,
+                injection_enabled=False)
+            if tuned is not None:
+                eff = tuned
         # Trace-time scoped-VMEM guard (ops/vmem.py): auto-shrink named
         # shapes over the Mosaic budget; warn for explicit ones.
         eff = _fit_block_to_vmem(

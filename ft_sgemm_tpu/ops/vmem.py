@@ -52,10 +52,15 @@ MIB = 1024 * 1024
 # Per-variant temporary footprint, in accumulator-tile units (see module
 # docstring for the calibration provenance). "weighted" is the in-kernel
 # encode body; "weighted_precomp" the deferred-check body with the
-# precomputed expectations operand.
+# precomputed expectations operand. "global" is UNCALIBRATED — no
+# global-strategy compile has landed in a hardware window's records yet,
+# so 6.0 is an interpolation (between plain and rowcol, matching its body
+# weight) with the usual safety margin, and its declared scratch really is
+# ~0 bytes (two SMEM scalars + a counter — no VMEM vectors). Recalibrate
+# against Mosaic's own number when a global compile lands in a window.
 TEMP_TILE_FACTORS = {
     "plain": 3.0,
-    "global": 6.0,
+    "global": 6.0,  # uncalibrated: no recorded Mosaic observation (above)
     "rowcol": 7.0,
     "fused": 9.0,
     "weighted_precomp": 9.0,
@@ -125,8 +130,12 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
     or warns and returns the tile unchanged (explicit shapes: tile sweeps
     must measure what their row label claims; the warning tells the
     operator the compile will likely fail). Shrink order: halve ``bk``
-    (cheapest — K-depth only changes pipeline efficiency), then ``bn``,
-    then ``bm`` (these also shrink the temp tiles), all floored at 128.
+    while ``bk`` alone can absorb the overage (cheapest — K-depth only
+    changes pipeline efficiency); when it cannot (the temps term
+    ``factor * a_rows * bn * 4`` is bk-independent and dominates for the
+    heavy variants — draining bk to 128 would cost all K-depth while
+    barely moving the estimate), halve whichever of ``bn``/``bm``/``bk``
+    yields the largest predicted reduction per step, all floored at 128.
     Every shrink is announced with one loud warning; an unfittable tile
     (over budget at 128^3) raises instead of dying inside Mosaic.
     """
@@ -150,25 +159,46 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
         return max(128, (v // 2) // 128 * 128)
 
     bm, bn, bk = shape.block
-    while True:
-        est = estimate_vmem_bytes(
-            dataclasses.replace(shape, bm=bm, bn=bn, bk=bk), variant,
+
+    def est_at(bm_, bn_, bk_):
+        return estimate_vmem_bytes(
+            dataclasses.replace(shape, bm=bm_, bn=bn_, bk=bk_), variant,
             in_itemsize=in_itemsize)
+
+    while True:
+        est = est_at(bm, bn, bk)
         if est <= limit:
             break
+        steps = {}  # dim -> estimate after halving it once
         if bk > 128:
-            bk = halve(bk)
-        elif bn > 128:
-            bn = halve(bn)
-        elif bm > 128:
-            bm = halve(bm)
-        else:
+            steps["bk"] = est_at(bm, bn, halve(bk))
+        if bn > 128:
+            steps["bn"] = est_at(bm, halve(bn), bk)
+        if bm > 128:
+            steps["bm"] = est_at(halve(bm), bn, bk)
+        if not steps:
             raise ValueError(
                 f"ft_sgemm_tpu: kernel {variant!r} cannot fit the"
                 f" {limit / MIB:.0f} MiB scoped-VMEM limit even at the"
                 f" minimum 128x128x128 tile (predicted"
                 f" ~{est / MIB:.1f} MiB); raise FT_SGEMM_VMEM_LIMIT_BYTES"
                 f" or use a device with more VMEM")
+        if "bk" in steps and est_at(bm, bn, 128) <= limit:
+            # Draining bk alone can absorb the whole overage: keep the
+            # cheap dimension first (K-depth only costs pipeline
+            # efficiency; bn/bm halving also halves MXU-tile amortization).
+            dim = "bk"
+        else:
+            # The bk-independent temps term dominates: take the dimension
+            # with the largest predicted reduction per step (ties break
+            # bk > bn > bm via insertion order — cheapest first).
+            dim = min(steps, key=steps.get)
+        if dim == "bk":
+            bk = halve(bk)
+        elif dim == "bn":
+            bn = halve(bn)
+        else:
+            bm = halve(bm)
     fitted = dataclasses.replace(shape, bm=bm, bn=bn, bk=bk)
     warnings.warn(
         f"ft_sgemm_tpu: tile {shape.block} for kernel {variant!r} predicted"

@@ -1,0 +1,235 @@
+"""Autotuner subsystem: searched + persisted tile configs, cache-backed dispatch.
+
+The kernel family (``configs.SHAPES`` + the ``KernelShape`` parameterization)
+is exactly the reference's generated-kernel family, and like the reference
+the shipped tile choices come from hand-run sweeps at a few sizes. This
+subsystem closes the loop: it searches the family per
+``(device_kind, M/N/K bucket, dtype, strategy, injection)`` and serves the
+winner from a persistent cache on every later dispatch.
+
+Pipeline (:func:`tune`):
+
+1. :mod:`.space` enumerates the legal tile space and prunes infeasible
+   candidates with the calibrated ``ops/vmem`` footprint model — nothing
+   over the Mosaic scoped-VMEM budget is ever compiled.
+2. :mod:`.measure` times the survivors (warmup + median-of-k via
+   ``utils/timing``), clean or injected, recording through the telemetry
+   registry. On CPU it falls back to interpret/compile-only measurement so
+   the whole subsystem runs under ``JAX_PLATFORMS=cpu``.
+3. :mod:`.cache` persists the winner in a versioned, schema-checked JSON
+   document (``FT_SGEMM_TUNER_CACHE`` overrides the path).
+4. Dispatch (:func:`lookup_tile`, called by ``make_sgemm`` /
+   ``make_ft_sgemm`` / the attention factories) overrides the heuristic
+   block choice with a cached winner.
+
+**Zero-regression guarantee.** The lookup is pure host-side Python at
+trace time: with no cache entry (or tuning disabled via
+``FT_SGEMM_TUNING=0`` or :func:`override_disabled`), dispatch returns to
+the heuristic path before touching anything traced, so the emitted HLO is
+byte-identical to the untuned build (pinned in ``tests/test_tuner.py``,
+the ``tests/test_telemetry.py`` technique). Explicit ``KernelShape``
+dispatches are never overridden — a tile sweep measures the tile its row
+label claims, and the tuner's own measurements can never recurse into the
+cache they are filling.
+
+CLI: ``python -m ft_sgemm_tpu.cli tune`` / ``tune-show``;
+``python bench.py --tuned`` reports heuristic-vs-tuned side by side.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.tuner import cache, measure, space
+from ft_sgemm_tpu.tuner.cache import (
+    ENV_CACHE_PATH,
+    cache_path,
+    device_kind,
+    make_key,
+    mnk_bucket,
+)
+from ft_sgemm_tpu.tuner.measure import (
+    METHODS,
+    MeasureResult,
+    best_result,
+    default_method,
+    measure_space,
+)
+from ft_sgemm_tpu.tuner.space import enumerate_space, heuristic_shape
+
+ENV_TUNING = "FT_SGEMM_TUNING"
+_OFF_VALUES = ("0", "off", "false", "no")
+
+_LOCAL = threading.local()
+
+
+def enabled() -> bool:
+    """Whether dispatch consults the tile cache.
+
+    On by default (an empty cache is a no-op by construction); ``FT_SGEMM_
+    TUNING=0`` turns lookup off process-wide, :func:`override_disabled`
+    scopes it off for a block (the measurement path uses this so a search
+    can never serve itself stale winners).
+    """
+    if getattr(_LOCAL, "off_depth", 0) > 0:
+        return False
+    return os.environ.get(ENV_TUNING, "").lower() not in _OFF_VALUES
+
+
+@contextlib.contextmanager
+def override_disabled():
+    """Scope with tuner dispatch off in this thread (measurement, sweeps,
+    HLO-pinning tests)."""
+    _LOCAL.off_depth = getattr(_LOCAL, "off_depth", 0) + 1
+    try:
+        yield
+    finally:
+        _LOCAL.off_depth -= 1
+
+
+def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
+                in_dtype, injection_enabled: bool) -> Optional[KernelShape]:
+    """The cached winning tile for one dispatch site, or None (heuristics).
+
+    Pure host-side and cheap (one ``os.stat`` + dict probe in the steady
+    state); returns None without touching anything when tuning is off, so
+    the no-entry/disabled dispatch path is bit-for-bit the heuristic one.
+    """
+    if not enabled():
+        return None
+    rec = cache.lookup(make_key(m, n, k, strategy=strategy,
+                                in_dtype=in_dtype,
+                                injection_enabled=injection_enabled))
+    if rec is None:
+        return None
+    bm, bn, bk = rec["block"]
+    return KernelShape(space.candidate_name(bm, bn, bk), bm, bn, bk,
+                       (0,) * 7)
+
+
+def tune(
+    m: int, n: Optional[int] = None, k: Optional[int] = None, *,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    inject=False,
+    method: Optional[str] = None,
+    budget: Optional[int] = 8,
+    alpha: float = 1.0, beta: float = -1.5,
+    reps: int = 3, samples: int = 3,
+    dry_run: bool = False,
+    write_cache: bool = True,
+    progress=None,
+) -> dict:
+    """Search the tile family for one problem and persist the winner.
+
+    Returns a report dict: the candidate space (feasible + pruned with
+    reasons), per-candidate measurements, the heuristic baseline row, the
+    winner, and the cache key/path written. ``dry_run`` stops after the
+    static prune (nothing measured, nothing written). ``inject`` is False,
+    True (a reference-like schedule), or an explicit ``InjectionSpec``.
+    ``budget`` caps how many candidates are timed (best-guess-first order);
+    None times them all.
+    """
+    from ft_sgemm_tpu.injection import InjectionSpec
+
+    n = m if n is None else n
+    k = m if k is None else k
+    method = default_method() if method is None else method
+    feasible, pruned = enumerate_space(m, n, k, strategy=strategy,
+                                       in_dtype=in_dtype)
+    key = make_key(m, n, k, strategy=strategy, in_dtype=in_dtype,
+                   injection_enabled=bool(
+                       inject.enabled if isinstance(inject, InjectionSpec)
+                       else inject))
+    report = {
+        "problem": [m, n, k],
+        "strategy": "plain" if strategy is None else strategy,
+        "in_dtype": str(in_dtype),
+        "method": method,
+        "key": key,
+        "feasible": [list(s.block) for s in feasible],
+        "pruned": [{"block": list(p.shape.block), "reason": p.reason}
+                   for p in pruned],
+    }
+    if dry_run:
+        return report
+
+    # The heuristic baseline is measured FIRST (and exempt from the
+    # budget): every persisted winner is a measured comparison against
+    # what dispatch would have done, and the report carries both numbers.
+    heuristic = heuristic_shape(m, n, k, strategy=strategy,
+                                in_dtype=in_dtype)
+    candidates = [heuristic] + [s for s in feasible
+                                if s.block != heuristic.block]
+    budget_n = None if budget is None else budget + 1
+    if isinstance(inject, InjectionSpec):
+        spec = inject
+    elif inject:
+        # One representative reference-like schedule for the whole search
+        # (per-candidate bk-matched schedules would change the injected
+        # fault COUNT between rows and make times incomparable).
+        spec = InjectionSpec.reference_like(k, 512)
+    else:
+        spec = InjectionSpec.none()
+
+    with override_disabled():
+        results = measure_space(
+            candidates, m, n, k, strategy=strategy, in_dtype=in_dtype,
+            inject=spec, method=method, budget=budget_n,
+            alpha=alpha, beta=beta, reps=reps, samples=samples,
+            progress=progress)
+    best = best_result(results)
+    report["results"] = [dataclasses_asdict(r) for r in results]
+    report["heuristic"] = dataclasses_asdict(results[0]) if results else None
+    report["best"] = dataclasses_asdict(best) if best else None
+    if best is not None and write_cache:
+        record = {
+            "block": best.block,
+            "gflops": best.gflops,
+            "seconds_per_call": best.seconds,
+            "method": best.method,
+            "heuristic_block": list(heuristic.block),
+            "heuristic_gflops": (results[0].gflops
+                                 if results and results[0].ok else None),
+            "problem": [m, n, k],
+        }
+        report["cache_path"] = cache.store(key, record)
+    return report
+
+
+def dataclasses_asdict(r: MeasureResult) -> dict:
+    """A JSON-friendly view of one measurement (KernelShape flattened to
+    its block)."""
+    return {
+        "block": r.block, "method": r.method, "ok": r.ok,
+        "seconds_per_call": r.seconds, "gflops": r.gflops,
+        "score": r.score, "error": r.error,
+    }
+
+
+__all__ = [
+    "ENV_CACHE_PATH",
+    "ENV_TUNING",
+    "METHODS",
+    "MeasureResult",
+    "best_result",
+    "cache",
+    "cache_path",
+    "default_method",
+    "device_kind",
+    "enabled",
+    "enumerate_space",
+    "heuristic_shape",
+    "lookup_tile",
+    "make_key",
+    "measure",
+    "measure_space",
+    "mnk_bucket",
+    "override_disabled",
+    "space",
+    "tune",
+]

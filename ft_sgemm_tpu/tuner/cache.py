@@ -1,0 +1,197 @@
+"""Persistent, versioned tile-config cache.
+
+Winners found by the search (:mod:`ft_sgemm_tpu.tuner.measure`) are
+persisted as one JSON document keyed by
+``(device_kind, M/N/K bucket, dtype, strategy, injection-enabled)`` so a
+tuning run's result survives the process and serves every later dispatch
+on the same device class. Design points:
+
+- **Bucketed problem sizes.** Exact (M, N, K) keys would make every new
+  shape a cache miss; each dim is bucketed to the next power of two
+  (floored at the 128 MXU granule), which is also how tile efficiency
+  actually generalizes — a 4096-tuned tile serves 3500 well, a 256-tuned
+  one does not.
+- **Versioned, schema-checked load.** The file carries a schema version;
+  a corrupt file, a foreign JSON document, or an entry whose block fails
+  the MXU legality rules is ignored WITH A WARNING and treated as a miss
+  — a bad cache must never take down (or silently mis-tile) dispatch.
+- **Env-overridable path.** ``FT_SGEMM_TUNER_CACHE`` points dispatch and
+  the CLI at a specific cache file; the default lives under
+  ``~/.cache/ft_sgemm_tpu/``.
+- **Cheap hot-path reads.** Dispatch consults the cache on every call; the
+  parsed document is memoized per ``(mtime, size)`` stat signature, so the
+  steady-state cost is one ``os.stat``.
+- **Atomic writes.** Store is read-merge-replace via a temp file +
+  ``os.replace`` so a crashed writer can never leave a torn document.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import tempfile
+import threading
+import warnings
+from typing import Optional
+
+SCHEMA_VERSION = 1
+ENV_CACHE_PATH = "FT_SGEMM_TUNER_CACHE"
+_DEFAULT_PATH = os.path.join(
+    os.path.expanduser("~"), ".cache", "ft_sgemm_tpu", "tuner_cache.json")
+
+_LOCK = threading.Lock()
+# path -> ((mtime_ns, size), entries dict). Entries are the validated
+# key -> record mapping; an unreadable/invalid file memoizes as {} so the
+# load warning fires once per file state, not once per dispatch.
+_MEMO: dict = {}
+
+
+def cache_path() -> str:
+    """The active cache file path (``FT_SGEMM_TUNER_CACHE`` or default)."""
+    return os.environ.get(ENV_CACHE_PATH) or _DEFAULT_PATH
+
+
+@functools.lru_cache(maxsize=1)
+def device_kind() -> str:
+    """The local accelerator's device kind (cache-key component).
+
+    ``cpu`` on the CPU backend — CPU-tuned entries are real entries (the
+    interpret-mode fallback measures something), they just never collide
+    with any TPU generation's key.
+    """
+    try:
+        import jax
+
+        return str(jax.local_devices()[0].device_kind)
+    except Exception:  # noqa: BLE001 — no backend yet: still a valid key
+        return "unknown"
+
+
+def mnk_bucket(m: int, n: int, k: int) -> tuple:
+    """Bucket each problem dim to the next power of two, floored at 128."""
+
+    def bucket(v: int) -> int:
+        b = 128
+        while b < v:
+            b *= 2
+        return b
+
+    return (bucket(max(1, m)), bucket(max(1, n)), bucket(max(1, k)))
+
+
+def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
+             in_dtype, injection_enabled: bool,
+             device: Optional[str] = None) -> str:
+    """The canonical cache key for one dispatch site."""
+    import jax.numpy as jnp
+
+    bm, bn, bk = mnk_bucket(m, n, k)
+    dev = device_kind() if device is None else device
+    strat = "plain" if strategy is None else strategy
+    return (f"{dev}|{bm}x{bn}x{bk}|{jnp.dtype(in_dtype).name}"
+            f"|{strat}|inj={int(bool(injection_enabled))}")
+
+
+def _valid_block(block) -> bool:
+    return (isinstance(block, (list, tuple)) and len(block) == 3
+            and all(isinstance(v, int) and v > 0 and v % 128 == 0
+                    for v in block))
+
+
+def _load_validated(path: str) -> dict:
+    """Parse + schema-check one cache file; {} (with a warning) on any
+    structural problem. Per-entry validation: a bad entry is dropped, the
+    good ones survive."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except OSError:
+        return {}  # absent file: the ordinary empty-cache case, no warning
+    except ValueError as e:
+        warnings.warn(
+            f"ft_sgemm_tpu tuner: ignoring corrupt tile cache {path!r}"
+            f" ({e}); dispatch falls back to heuristics", stacklevel=3)
+        return {}
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA_VERSION:
+        warnings.warn(
+            f"ft_sgemm_tpu tuner: ignoring tile cache {path!r} with"
+            f" schema {doc.get('schema') if isinstance(doc, dict) else '?'}"
+            f" (this build reads schema {SCHEMA_VERSION}); dispatch falls"
+            " back to heuristics", stacklevel=3)
+        return {}
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        warnings.warn(
+            f"ft_sgemm_tpu tuner: tile cache {path!r} has no 'entries'"
+            " mapping; ignoring it", stacklevel=3)
+        return {}
+    valid = {}
+    for key, rec in entries.items():
+        if isinstance(rec, dict) and _valid_block(rec.get("block")):
+            valid[key] = rec
+        else:
+            warnings.warn(
+                f"ft_sgemm_tpu tuner: dropping invalid cache entry"
+                f" {key!r} in {path!r} (block must be three positive"
+                " multiples of 128)", stacklevel=3)
+    return valid
+
+
+def load_entries(path: Optional[str] = None) -> dict:
+    """The validated entries of the cache file, memoized by stat signature."""
+    path = cache_path() if path is None else path
+    try:
+        st = os.stat(path)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        sig = None  # absent: memoize the miss too (stat already said so)
+    with _LOCK:
+        hit = _MEMO.get(path)
+        if hit is not None and hit[0] == sig:
+            return hit[1]
+    entries = _load_validated(path) if sig is not None else {}
+    with _LOCK:
+        _MEMO[path] = (sig, entries)
+    return entries
+
+
+def lookup(key: str, path: Optional[str] = None) -> Optional[dict]:
+    """The cache record for ``key``, or None (a miss)."""
+    return load_entries(path).get(key)
+
+
+def store(key: str, record: dict, path: Optional[str] = None) -> str:
+    """Insert/overwrite one entry (read-merge-atomic-replace). Returns the
+    path written."""
+    if not _valid_block(record.get("block")):
+        raise ValueError(
+            f"tuner cache record needs a legal 'block' (three positive"
+            f" multiples of 128), got {record.get('block')!r}")
+    path = cache_path() if path is None else path
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with _LOCK:
+        entries = dict(_load_validated(path))
+        entries[key] = record
+        doc = {"schema": SCHEMA_VERSION, "entries": entries}
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, path)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        _MEMO.pop(path, None)
+    return path
+
+
+def clear_memo() -> None:
+    """Drop the in-process memo (tests; after external cache edits the
+    stat signature normally handles invalidation by itself)."""
+    with _LOCK:
+        _MEMO.clear()

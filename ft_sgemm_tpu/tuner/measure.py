@@ -1,0 +1,213 @@
+"""Candidate measurement: time the survivors of the static prune.
+
+Each candidate is built as an EXPLICIT :class:`~ft_sgemm_tpu.configs
+.KernelShape` (explicit shapes bypass both the named-shape auto-shrink and
+the tuner's own cache lookup, so a measurement can never recurse into the
+cache it is trying to fill, and the row measured is exactly the tile its
+label claims — the ``scripts/tune_tiles.py`` invariant) and timed with the
+warmup/median-of-k discipline of
+:func:`ft_sgemm_tpu.utils.timing.median_seconds_per_call`.
+
+Three measurement methods, because the search must run everywhere:
+
+- ``"wall"`` — real device timing (the TPU path; also honest on any
+  backend that executes compiled kernels).
+- ``"interpret"`` — forces Pallas interpret mode: the CPU fallback that
+  exercises the identical dispatch/measure/persist machinery without a
+  TPU. Interpret wall time is an emulation-cost ranking, not hardware
+  truth — entries it produces are keyed under the CPU ``device_kind`` and
+  can never serve a TPU dispatch.
+- ``"compile"`` — AOT lower+compile only (no execution): proves each
+  candidate clears Mosaic (the scoped-VMEM gate the static model can only
+  predict) and ranks by a grid-step proxy. For chipless compile-service
+  windows (``scripts/hw_watch.sh``'s probe stage).
+
+Results are recorded through the PR-1 telemetry registry (when telemetry
+is enabled): per-candidate ``tuner_candidate_gflops`` gauges plus
+``tuner_measurements``/``tuner_failures`` counters, under a
+``tuner_measure`` profiler span — a tuning run shows up in traces and
+scrapes like any other fault-tolerance work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ft_sgemm_tpu.configs import KernelShape
+
+METHODS = ("wall", "interpret", "compile")
+
+
+@dataclasses.dataclass
+class MeasureResult:
+    """One measured candidate."""
+
+    shape: KernelShape
+    method: str
+    ok: bool
+    seconds: Optional[float] = None   # per call; None for compile-only
+    gflops: Optional[float] = None
+    score: float = float("inf")       # lower is better, any method
+    error: Optional[str] = None
+
+    @property
+    def block(self):
+        return list(self.shape.block)
+
+
+def default_method() -> str:
+    """``wall`` on a real TPU backend, ``interpret`` everywhere else."""
+    import jax
+
+    return "wall" if jax.default_backend() == "tpu" else "interpret"
+
+
+def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
+              inject, alpha: float, beta: float, interpret: Optional[bool]):
+    """fn(a, b, c) -> array for one candidate, clean or injected."""
+    from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+    from ft_sgemm_tpu.ops.sgemm import make_sgemm
+
+    if strategy is None:
+        return make_sgemm(shape, alpha=alpha, beta=beta, in_dtype=in_dtype,
+                          interpret=interpret)
+    ft = make_ft_sgemm(shape, alpha=alpha, beta=beta, strategy=strategy,
+                       in_dtype=in_dtype, interpret=interpret)
+    return lambda a, b, c: ft(a, b, c, inject).c
+
+
+def make_inputs(m: int, n: int, k: int, in_dtype: str = "float32"):
+    """Device-resident (a, b, c) operands for measurement (one set for the
+    whole search; the reference driver's quantized distribution)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ft_sgemm_tpu.utils.matrices import generate_random_matrix
+
+    rng = np.random.default_rng(10)
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    if jnp.dtype(in_dtype) != jnp.float32:
+        # Pre-cast so the wrappers' casts trace to no-ops (timing.py).
+        a = jnp.asarray(a, in_dtype)
+        b = jnp.asarray(b, in_dtype)
+    return tuple(map(jax.device_put, (a, b, c)))
+
+
+def measure_candidate(
+    shape: KernelShape, a, b, c, *,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    inject=None,
+    method: Optional[str] = None,
+    alpha: float = 1.0, beta: float = -1.5,
+    reps: int = 3, samples: int = 3,
+) -> MeasureResult:
+    """Measure ONE candidate tile; failures are recorded, never raised
+    (a search must survive a candidate the static model wrongly admitted).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ft_sgemm_tpu.injection import InjectionSpec
+    from ft_sgemm_tpu.utils.timing import median_seconds_per_call
+
+    method = default_method() if method is None else method
+    if method not in METHODS:
+        raise ValueError(f"unknown method {method!r}; pick from {METHODS}")
+    inject = inject or InjectionSpec.none()
+    m, n = c.shape
+    k = a.shape[1]
+    interpret = True if method == "interpret" else None
+    try:
+        fn = _build_fn(shape, strategy=strategy, in_dtype=in_dtype,
+                       inject=inject, alpha=alpha, beta=beta,
+                       interpret=interpret)
+        if method == "compile":
+            args = (jax.ShapeDtypeStruct(a.shape, jnp.dtype(in_dtype)),
+                    jax.ShapeDtypeStruct(b.shape, jnp.dtype(in_dtype)),
+                    jax.ShapeDtypeStruct(c.shape, jnp.float32))
+            jax.jit(fn).lower(*args).compile()
+            # Rank compiled-only candidates by grid-step count: fewer,
+            # bigger steps is the measured direction at every swept size
+            # (configs.SHAPES provenance). A proxy, not a measurement —
+            # the record says so via method="compile".
+            steps = (-(-m // shape.bm)) * (-(-n // shape.bn)) * (
+                -(-k // shape.bk))
+            return MeasureResult(shape, method, ok=True, score=float(steps))
+        sec = median_seconds_per_call(fn, a, b, c, reps=reps,
+                                      samples=samples)
+        gf = 2.0 * m * n * k / 1e9 / sec
+        return MeasureResult(shape, method, ok=True, seconds=sec,
+                             gflops=gf, score=sec)
+    except Exception as e:  # noqa: BLE001 — sweep must survive bad tiles
+        return MeasureResult(shape, method, ok=False,
+                             error=f"{type(e).__name__}: {str(e)[:200]}")
+
+
+def measure_space(
+    candidates: Sequence[KernelShape], m: int, n: int, k: int, *,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    inject=None,
+    method: Optional[str] = None,
+    budget: Optional[int] = None,
+    alpha: float = 1.0, beta: float = -1.5,
+    reps: int = 3, samples: int = 3,
+    progress=None,
+) -> list:
+    """Measure up to ``budget`` candidates (order preserved — callers pass
+    the best-guess-first list from :func:`..space.enumerate_space`).
+    Returns the list of :class:`MeasureResult`. ``progress`` is an optional
+    ``fn(result)`` callback (the CLI streams rows as they land, so a
+    killed search still printed everything it measured).
+    """
+    from ft_sgemm_tpu import telemetry
+
+    method = default_method() if method is None else method
+    picked = list(candidates if budget is None else candidates[:budget])
+    results = []
+    strat_label = "plain" if strategy is None else strategy
+    with telemetry.trace_span("tuner_measure"):
+        for shape in picked:
+            a, b, c = _inputs_memo(m, n, k, in_dtype)
+            res = measure_candidate(
+                shape, a, b, c, strategy=strategy, in_dtype=in_dtype,
+                inject=inject, method=method, alpha=alpha, beta=beta,
+                reps=reps, samples=samples)
+            results.append(res)
+            if telemetry.enabled():
+                reg = telemetry.get_registry()
+                labels = dict(op="tuner", strategy=strat_label,
+                              method=method)
+                reg.counter("tuner_measurements", **labels).inc()
+                if not res.ok:
+                    reg.counter("tuner_failures", **labels).inc()
+                elif res.gflops is not None:
+                    reg.gauge("tuner_candidate_gflops",
+                              tile=shape.name, **labels).set(res.gflops)
+            if progress is not None:
+                progress(res)
+    return results
+
+
+# One operand set per (problem, dtype) per process: measurement loops call
+# measure_space repeatedly from the CLI and tests.
+_INPUT_MEMO: dict = {}
+
+
+def _inputs_memo(m, n, k, in_dtype):
+    key = (m, n, k, str(in_dtype))
+    if key not in _INPUT_MEMO:
+        _INPUT_MEMO.clear()  # hold at most one problem's operands resident
+        _INPUT_MEMO[key] = make_inputs(m, n, k, in_dtype)
+    return _INPUT_MEMO[key]
+
+
+def best_result(results: Sequence[MeasureResult]) -> Optional[MeasureResult]:
+    """The winning measurement (lowest score among ok results), or None."""
+    ok = [r for r in results if r.ok]
+    return min(ok, key=lambda r: r.score) if ok else None

@@ -1,0 +1,149 @@
+"""Tile-config search-space enumeration and static pruning.
+
+The kernel family is parameterized by one block tile ``(bm, bn, bk)``
+(``configs.KernelShape``); the reference picks its per-size winners by a
+hand-run sweep of the generated family (``code_gen/main.py``,
+``scripts/tune_tiles.py`` here). This module makes that space a first-class
+object the autotuner can search: it enumerates every legal MXU tile within
+a curated dimension menu, drops tiles that are strictly wasteful for the
+problem (a block dim larger than the 128-padded problem dim only buys
+padding FLOPs), and rejects candidates the :mod:`ft_sgemm_tpu.ops.vmem`
+footprint model predicts over the Mosaic scoped-VMEM budget — BEFORE
+anything is compiled or timed, so a search never burns measurement budget
+(or a scarce TPU tunnel window) dying inside the compiler.
+
+Candidates are returned best-guess-first: descending block FLOPs-per-byte
+(larger output tiles amortize the FT checksum VPU work — encode cost per
+FLOP ~ 1/bm + 1/bn — and deeper K means fewer detect/correct epilogues), so
+a budget-capped measurement pass spends its calls on the likely winners.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
+from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes
+
+# Dimension menus: multiples of 128 spanning the shipped family and the
+# live-sweep candidates of scripts/tune_tiles.py. Curated, not exhaustive —
+# the sub-128 and non-multiple tiles are illegal on the MXU, and dims past
+# 2048 exceed the 64 MiB budget for every variant at f32.
+BM_MENU = (128, 256, 384, 512, 768, 1024, 1536, 2048)
+BN_MENU = (128, 256, 384, 512, 768, 1024, 1536, 2048)
+BK_MENU = (128, 256, 512, 1024, 2048)
+
+
+def variant_for(strategy: Optional[str], *, single_check: bool = True) -> str:
+    """The :data:`~ft_sgemm_tpu.ops.vmem.TEMP_TILE_FACTORS` key a strategy's
+    dispatch will actually run at the tuner's measurement settings.
+
+    Mirrors ``make_ft_sgemm``'s ``resolve_cadence`` decision: the weighted
+    strategy at its default single-final-check cadence runs the lighter
+    precomputed-expectations body. ``None`` is the plain (non-FT) kernel.
+    """
+    if strategy is None:
+        return "plain"
+    if strategy == "weighted" and single_check:
+        return "weighted_precomp"
+    return strategy
+
+
+def _round_up(v: int, mult: int) -> int:
+    return -(-v // mult) * mult
+
+
+def candidate_name(bm: int, bn: int, bk: int) -> str:
+    return f"tuned_{bm}x{bn}x{bk}"
+
+
+@dataclasses.dataclass(frozen=True)
+class PrunedCandidate:
+    """A candidate rejected before measurement, with the reason."""
+
+    shape: KernelShape
+    reason: str
+    est_bytes: Optional[int] = None
+
+
+def heuristic_shape(m: int, n: int, k: int, *, strategy: Optional[str],
+                    in_dtype: str = "float32",
+                    name: str = "huge") -> KernelShape:
+    """The tile today's static dispatch would run for this problem — the
+    baseline every search measures first, so a tuned winner is always a
+    measured improvement over (or tie with) the shipped heuristic."""
+    from ft_sgemm_tpu.ops.common import shrink_block
+
+    shape = shape_for_dtype(SHAPES[name], strategy is not None, in_dtype)
+    return shrink_block(shape, m, n, k)
+
+
+def enumerate_space(
+    m: int, n: int, k: int, *,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    limit: Optional[int] = None,
+    bm_menu: Sequence[int] = BM_MENU,
+    bn_menu: Sequence[int] = BN_MENU,
+    bk_menu: Sequence[int] = BK_MENU,
+) -> Tuple[list, list]:
+    """Enumerate and statically prune the tile space for one problem.
+
+    Returns ``(feasible, pruned)``: ``feasible`` is a best-guess-first list
+    of :class:`~ft_sgemm_tpu.configs.KernelShape`; ``pruned`` a list of
+    :class:`PrunedCandidate` explaining every rejection (a search report
+    must say what it did NOT try — silent truncation reads as coverage).
+
+    Pruning, in order:
+      1. **Problem fit** — a block dim beyond the 128-padded problem dim
+         pads pure waste (padded FLOPs are real FLOPs; ``shrink_block``
+         exists to undo exactly this for the shipped tiles).
+      2. **VMEM footprint** — the calibrated ``ops/vmem`` model at the
+         variant the dispatch would run; over-``limit`` candidates are a
+         compile-time Mosaic OOM on hardware and must never reach
+         measurement.
+    """
+    from ft_sgemm_tpu.configs import vmem_limit_bytes
+
+    if limit is None:
+        limit = vmem_limit_bytes()
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(in_dtype).itemsize
+    variant = variant_for(strategy)
+    max_bm = _round_up(m, 128)
+    max_bn = _round_up(n, 128)
+    max_bk = _round_up(k, 128)
+
+    feasible, pruned = [], []
+    for bm in bm_menu:
+        for bn in bn_menu:
+            for bk in bk_menu:
+                shape = KernelShape(candidate_name(bm, bn, bk),
+                                    bm, bn, bk, (0,) * 7)
+                if bm > max_bm or bn > max_bn or bk > max_bk:
+                    pruned.append(PrunedCandidate(
+                        shape, "exceeds 128-padded problem"
+                        f" ({max_bm}x{max_bn}x{max_bk})"))
+                    continue
+                est = estimate_vmem_bytes(shape, variant,
+                                          in_itemsize=itemsize)
+                if est > limit:
+                    pruned.append(PrunedCandidate(
+                        shape,
+                        f"predicted ~{est / MIB:.1f} MiB scoped VMEM >"
+                        f" {limit / MIB:.0f} MiB limit ({variant})",
+                        est_bytes=est))
+                    continue
+                feasible.append(shape)
+
+    # Best-guess-first: big output tiles and deep K amortize per-check and
+    # per-grid-step overheads; among equals prefer squarer aspect (the
+    # sweep-measured winners are square-ish at every size, configs.SHAPES).
+    def score(s: KernelShape):
+        aspect = max(s.bm, s.bn) / min(s.bm, s.bn)
+        return (-(s.bm * s.bn * min(s.bk, max_bk)), aspect)
+
+    feasible.sort(key=score)
+    return feasible, pruned

@@ -46,6 +46,28 @@ def time_fn(fn, *args, reps: int = NUM_TESTS, warmup: int = 1) -> float:
     return time.perf_counter() - t0
 
 
+def median_seconds_per_call(fn, *args, reps: int = NUM_TESTS,
+                            samples: int = 3, warmup: int = 1) -> float:
+    """Median-of-``samples`` seconds-per-call of ``fn(*args)``.
+
+    The autotuner's measurement discipline (``ft_sgemm_tpu.tuner.measure``):
+    ``warmup`` excluded runs absorb compilation and caches, then each
+    sample times ``reps`` synchronous executions (:func:`time_fn`) and the
+    median sample divided by ``reps`` is returned — the median is robust
+    to the one-off scheduling hiccups that poison a min- or mean-of-one
+    reading, while staying far cheaper than the full
+    :func:`bench_seconds_per_call` protocol (which exists for tunnel-grade
+    dispatch overhead, not for ranking dozens of candidates).
+    """
+    import statistics
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = [time_fn(fn, *args, reps=reps, warmup=0)
+             for _ in range(max(1, samples))]
+    return statistics.median(times) / max(1, reps)
+
+
 def gflops(m: int, n: int, k: int, seconds: float, reps: int = NUM_TESTS) -> float:
     """GFLOPS under the reference's formula (``sgemm.cu:431-434``)."""
     if seconds <= 0:

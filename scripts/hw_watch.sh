@@ -196,6 +196,46 @@ refresh_keys() {
   done
 }
 
+# The process group of the stage currently running (its setsid leader's
+# pid), so a TERM/INT to this inner loop can kill the whole stage tree —
+# stages run in their OWN sessions now, out of reach of the supervisor's
+# group kill.
+CUR_STAGE_PG=
+on_inner_sig() {
+  [ -n "$CUR_STAGE_PG" ] && kill -TERM -- "-$CUR_STAGE_PG" 2>/dev/null
+  exit 143
+}
+trap on_inner_sig INT TERM
+
+run_staged_cmd() {  # run_staged_cmd <timeout> <log> <cmd...>
+  # Each timed stage gets its OWN process group (setsid) and the timeout
+  # escalation kills the GROUP: `timeout -k` signals only its direct
+  # child, so a compound stage (e.g. gen's `a && b && c` wrapper bash)
+  # that got TERM/KILLed would orphan the in-flight python child — which
+  # keeps holding the tunnel/chip while every later stage's gate and
+  # timeout runs against it (ADVICE r5).
+  local tmo=$1 log=$2; shift 2
+  setsid bash -c "$*" > "$log" 2>&1 &
+  local pid=$!
+  CUR_STAGE_PG=$pid
+  (
+    sleep "$tmo"
+    kill -TERM -- "-$pid" 2>/dev/null
+    sleep 15
+    kill -KILL -- "-$pid" 2>/dev/null
+  ) &
+  local watchdog=$!
+  local rc
+  wait "$pid"; rc=$?
+  CUR_STAGE_PG=
+  # Stage finished first: stop the watchdog shell so its pending kills
+  # can never fire at a (possibly reused) pgid. Its in-flight sleep may
+  # linger as an orphan; with the shell dead, nothing runs after it.
+  kill "$watchdog" 2>/dev/null
+  wait "$watchdog" 2>/dev/null
+  return "$rc"
+}
+
 run_stage() {  # run_stage <name> — cmd/timeout/key from the stage tables
   local name=$1
   local tmo; tmo=$(stage_timeout "$name")
@@ -218,7 +258,7 @@ run_stage() {  # run_stage <name> — cmd/timeout/key from the stage tables
     return 1
   fi
   echo "[watch] $(date -u +%H:%M:%S) running $name (timeout ${tmo}s)"
-  if timeout -k 15 "$tmo" bash -c "$(stage_cmd "$name")" > ".bench/${name}.log" 2>&1; then
+  if run_staged_cmd "$tmo" ".bench/${name}.log" "$(stage_cmd "$name")"; then
     touch "$marker"
     echo "[watch] $(date -u +%H:%M:%S) $name OK"
   else

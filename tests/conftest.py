@@ -6,11 +6,19 @@ TPU), sharding tests run over 8 virtual CPU devices.
 """
 
 import os
+import tempfile
 
 # Force CPU even when a TPU platform is configured in the environment: the
 # suite must pass with no TPU attached. TPU validation runs live separately
 # (scripts/validate_tpu.py, bench.py).
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Hermetic autotuner: dispatch consults the tile cache by default
+# (ft_sgemm_tpu.tuner), and a developer's ~/.cache entries must never leak
+# tuned tiles — and therefore different HLO — into the suite. Tests that
+# exercise the tuner monkeypatch this to their own tmp path.
+os.environ["FT_SGEMM_TUNER_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="ft_sgemm_test_tuner_"), "tuner_cache.json")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

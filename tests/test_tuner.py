@@ -1,0 +1,362 @@
+"""Autotuner subsystem (ft_sgemm_tpu.tuner): space, cache, dispatch.
+
+Pins the subsystem's four contract points:
+
+1. the candidate space is pruned by the calibrated VMEM model BEFORE any
+   compile/measure work, and known-infeasible tiles never survive;
+2. the cache round-trips: a tuned winner persists, loads back, and
+   dispatch provably selects the cached block config (the lowered HLO of
+   a tuned named-shape call is byte-identical to an explicit KernelShape
+   call at the cached tile — grid/block introspection at its strongest);
+3. corrupt / wrong-schema / invalid-entry cache files are ignored with a
+   warning and dispatch falls back to heuristics;
+4. zero-regression: with an empty or absent cache (or tuning disabled),
+   the lowered HLO of the ft_sgemm and attention entry points is
+   byte-identical to the heuristic path (the tests/test_telemetry.py
+   pinning technique).
+"""
+
+import json
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import ft_sgemm_tpu as ft
+from ft_sgemm_tpu import tuner
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes
+from ft_sgemm_tpu.tuner import cache as tcache
+
+
+@pytest.fixture(autouse=True)
+def _own_cache(tmp_path, monkeypatch):
+    """Every test gets a private cache file and a clean memo."""
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH,
+                       str(tmp_path / "tuner_cache.json"))
+    tcache.clear_memo()
+    yield
+    tcache.clear_memo()
+
+
+def _inputs(rng, m=256, n=256, k=256):
+    return (rng.standard_normal((m, k)).astype(np.float32),
+            rng.standard_normal((n, k)).astype(np.float32),
+            rng.standard_normal((m, n)).astype(np.float32))
+
+
+def _lower_ft(fn, a, b, c):
+    return jax.jit(lambda a, b, c: fn(a, b, c).c).lower(a, b, c).as_text()
+
+
+# -- space: enumeration + static pruning ------------------------------------
+
+
+def test_space_prunes_vmem_infeasible_candidates():
+    feasible, pruned = tuner.enumerate_space(
+        4096, 4096, 4096, strategy="weighted", limit=16 * MIB)
+    # The recorded round-4 OOM (weighted @ 512^3 f32, ~17.9 MiB predicted
+    # by the calibrated model — tests/test_vmem.py) must be pruned, with
+    # the reason naming the budget.
+    assert all(s.block != (512, 512, 512) for s in feasible)
+    reasons = {tuple(p.shape.block): p.reason for p in pruned}
+    assert "VMEM" in reasons[(512, 512, 512)]
+    # Everything that survived really is predicted to fit.
+    for s in feasible:
+        assert estimate_vmem_bytes(s, "weighted_precomp") <= 16 * MIB
+
+
+def test_space_prunes_tiles_beyond_padded_problem():
+    feasible, pruned = tuner.enumerate_space(256, 256, 256,
+                                             strategy="weighted")
+    assert all(max(s.block) <= 256 for s in feasible)
+    assert any("padded problem" in p.reason for p in pruned)
+
+
+def test_space_orders_best_guess_first():
+    feasible, _ = tuner.enumerate_space(1024, 1024, 1024,
+                                        strategy="weighted")
+    # Biggest block volume first (the measurement budget spends itself on
+    # likely winners).
+    vols = [s.bm * s.bn * s.bk for s in feasible]
+    assert vols[0] == max(vols)
+
+
+# -- cache: round-trip, corruption, schema ----------------------------------
+
+
+def test_cache_round_trip_and_dispatch_selects_cached_config(rng):
+    a, b, c = _inputs(rng)
+    key = tuner.make_key(256, 256, 256, strategy="weighted",
+                         in_dtype="float32", injection_enabled=False)
+    kfn = ft.make_ft_sgemm("huge")
+    heuristic_hlo = _lower_ft(kfn, a, b, c)
+    tcache.store(key, {"block": [128, 256, 256]})
+
+    tuned_hlo = _lower_ft(kfn, a, b, c)
+    explicit = ft.make_ft_sgemm(
+        KernelShape("tuned_128x256x256", 128, 256, 256, (0,) * 7))
+    explicit_hlo = _lower_ft(explicit, a, b, c)
+    # Dispatch provably selected the cached tile: the tuned named-shape
+    # call lowers to EXACTLY the explicit-KernelShape program at the
+    # cached block (grid + block shapes included), and differs from the
+    # heuristic program.
+    assert tuned_hlo == explicit_hlo
+    assert tuned_hlo != heuristic_hlo
+    # ...and still computes the right answer.
+    want = np.asarray(ft.sgemm_reference(a, b, c, 1.0, -1.5))
+    got = np.asarray(kfn(a, b, c).c)
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_lookup_tile_miss_and_disabled(monkeypatch):
+    assert tuner.lookup_tile(256, 256, 256, strategy="weighted",
+                             in_dtype="float32",
+                             injection_enabled=False) is None
+    key = tuner.make_key(256, 256, 256, strategy="weighted",
+                         in_dtype="float32", injection_enabled=False)
+    tcache.store(key, {"block": [128, 128, 128]})
+    assert tuner.lookup_tile(256, 256, 256, strategy="weighted",
+                             in_dtype="float32",
+                             injection_enabled=False).block == (128, 128, 128)
+    with tuner.override_disabled():
+        assert tuner.lookup_tile(256, 256, 256, strategy="weighted",
+                                 in_dtype="float32",
+                                 injection_enabled=False) is None
+    monkeypatch.setenv(tuner.ENV_TUNING, "0")
+    assert tuner.lookup_tile(256, 256, 256, strategy="weighted",
+                             in_dtype="float32",
+                             injection_enabled=False) is None
+
+
+def test_key_separates_injection_strategy_dtype():
+    kws = dict(in_dtype="float32", injection_enabled=False)
+    base = tuner.make_key(256, 256, 256, strategy="weighted", **kws)
+    assert tuner.make_key(256, 256, 256, strategy="rowcol", **kws) != base
+    assert tuner.make_key(256, 256, 256, strategy="weighted",
+                          in_dtype="bfloat16",
+                          injection_enabled=False) != base
+    assert tuner.make_key(256, 256, 256, strategy="weighted",
+                          in_dtype="float32",
+                          injection_enabled=True) != base
+    # Bucketing: nearby sizes share a key, far ones don't.
+    assert tuner.make_key(250, 201, 256, strategy="weighted", **kws) == base
+    assert tuner.make_key(512, 256, 256, strategy="weighted", **kws) != base
+
+
+def test_corrupt_cache_ignored_with_warning(tmp_path, monkeypatch):
+    path = tmp_path / "corrupt.json"
+    path.write_text("{this is not json")
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert tcache.load_entries() == {}
+    # Memoized: the second read is silent (and still a miss).
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert tuner.lookup_tile(256, 256, 256, strategy="weighted",
+                                 in_dtype="float32",
+                                 injection_enabled=False) is None
+
+
+def test_mismatched_schema_cache_ignored_with_warning(tmp_path, monkeypatch):
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps({"schema": 999, "entries": {
+        "k": {"block": [128, 128, 128]}}}))
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    with pytest.warns(UserWarning, match="schema"):
+        assert tcache.load_entries() == {}
+
+
+def test_invalid_entry_dropped_with_warning(tmp_path, monkeypatch):
+    path = tmp_path / "mixed.json"
+    path.write_text(json.dumps({"schema": tcache.SCHEMA_VERSION, "entries": {
+        "good": {"block": [128, 256, 128]},
+        "bad": {"block": [100, 256, 128]},       # not a multiple of 128
+        "worse": {"block": "512x512x512"}}}))
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    with pytest.warns(UserWarning, match="invalid cache entry"):
+        entries = tcache.load_entries()
+    assert set(entries) == {"good"}
+
+
+def test_store_rejects_illegal_block():
+    with pytest.raises(ValueError, match="block"):
+        tcache.store("k", {"block": [100, 128, 128]})
+
+
+def test_store_is_atomic_and_merges(tmp_path, monkeypatch):
+    path = tmp_path / "c.json"
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    tcache.store("k1", {"block": [128, 128, 128]})
+    tcache.store("k2", {"block": [256, 128, 128]})
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == tcache.SCHEMA_VERSION
+    assert set(doc["entries"]) == {"k1", "k2"}
+
+
+# -- zero-regression: empty/absent cache -> byte-identical HLO ---------------
+
+
+def test_no_cache_hlo_identical_ft_sgemm(rng):
+    a, b, c = _inputs(rng)
+    kfn = ft.make_ft_sgemm("huge")
+    with tuner.override_disabled():
+        baseline = _lower_ft(kfn, a, b, c)  # the heuristic-only path
+    assert _lower_ft(kfn, a, b, c) == baseline, (
+        "empty-cache tuned dispatch changed the ft_sgemm HLO")
+
+
+def test_no_cache_hlo_identical_attention(rng):
+    from ft_sgemm_tpu.ops.attention import make_ft_attention
+
+    q = rng.standard_normal((128, 64)).astype(np.float32)
+    k = rng.standard_normal((128, 64)).astype(np.float32)
+    v = rng.standard_normal((128, 64)).astype(np.float32)
+    attn = make_ft_attention()
+
+    def lower():
+        return jax.jit(lambda q, k, v: attn(q, k, v).out).lower(
+            q, k, v).as_text()
+
+    with tuner.override_disabled():
+        baseline = lower()
+    assert lower() == baseline, (
+        "empty-cache tuned dispatch changed the attention HLO")
+
+
+def test_attention_picks_cached_tile_for_default_shapes(rng):
+    from ft_sgemm_tpu.ops.attention import make_ft_attention
+
+    q = rng.standard_normal((128, 64)).astype(np.float32)
+    k = rng.standard_normal((128, 64)).astype(np.float32)
+    v = rng.standard_normal((128, 64)).astype(np.float32)
+    attn = make_ft_attention()
+
+    def lower():
+        return jax.jit(lambda q, k, v: attn(q, k, v).out).lower(
+            q, k, v).as_text()
+
+    baseline = lower()
+    # Seed the QK GEMM's key: (L, Lk, d) = (128, 128, 64) -> bucket
+    # (128, 128, 128); beta=0 attention GEMMs, clean run.
+    key = tuner.make_key(128, 128, 64, strategy="weighted",
+                         in_dtype="float32", injection_enabled=False)
+    tcache.store(key, {"block": [128, 128, 128]})
+    assert lower() != baseline, (
+        "seeded cache entry did not reach attention's QK/PV dispatch")
+    # Caller-supplied explicit shapes are never overridden.
+    custom = make_ft_attention(
+        qk_shape=KernelShape("qk", 256, 256, 128, (0,) * 7),
+        pv_shape=KernelShape("pv", 256, 128, 512, (0,) * 7))
+    with tuner.override_disabled():
+        custom_base = jax.jit(
+            lambda q, k, v: custom(q, k, v).out).lower(q, k, v).as_text()
+    assert jax.jit(lambda q, k, v: custom(q, k, v).out).lower(
+        q, k, v).as_text() == custom_base
+
+
+def test_explicit_shape_dispatch_never_tuned(rng):
+    a, b, c = _inputs(rng)
+    shape = KernelShape("sweep_tile", 256, 256, 256, (0,) * 7)
+    kfn = ft.make_ft_sgemm(shape)
+    baseline = _lower_ft(kfn, a, b, c)
+    key = tuner.make_key(256, 256, 256, strategy="weighted",
+                         in_dtype="float32", injection_enabled=False)
+    tcache.store(key, {"block": [128, 128, 128]})
+    assert _lower_ft(kfn, a, b, c) == baseline, (
+        "explicit KernelShape dispatch consulted the tile cache")
+
+
+# -- tune(): search + persist + telemetry ------------------------------------
+
+
+def test_tune_persists_winner_and_dispatch_uses_it(rng):
+    report = tuner.tune(128, budget=2, reps=1, samples=1,
+                        method="interpret")
+    assert report["best"] is not None
+    assert report["heuristic"] is not None
+    best_block = tuple(report["best"]["block"])
+    tile = tuner.lookup_tile(128, 128, 128, strategy="weighted",
+                             in_dtype="float32", injection_enabled=False)
+    assert tile is not None and tile.block == best_block
+    # The search itself must not have been served by the cache it wrote:
+    # re-tuning with the entry present measures the same candidate list.
+    report2 = tuner.tune(128, budget=2, reps=1, samples=1,
+                         method="interpret")
+    assert [r["block"] for r in report2["results"]] == \
+        [r["block"] for r in report["results"]]
+
+
+def test_tune_dry_run_measures_nothing(tmp_path, monkeypatch):
+    path = tmp_path / "never_written.json"
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    report = tuner.tune(512, dry_run=True)
+    assert "results" not in report and "best" not in report
+    assert report["feasible"] and report["pruned"]
+    assert not path.exists()
+
+
+def test_tune_records_through_telemetry_registry(rng):
+    from ft_sgemm_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.configure(None)
+    try:
+        tuner.tune(128, budget=1, reps=1, samples=1, method="interpret")
+        reg = telemetry.get_registry()
+        assert reg.total("tuner_measurements") >= 2  # heuristic + 1
+        names = {s["name"] for s in reg.collect()}
+        assert "tuner_candidate_gflops" in names
+    finally:
+        telemetry.reset()
+
+
+# -- CLI: tune / tune-show round-trip ----------------------------------------
+
+
+def test_cli_tune_roundtrips_via_tune_show(capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "tune", "128", "--budget=1", "--reps=1",
+                   "--samples=1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "cache written:" in out
+    assert "heuristic" in out and "best" in out
+
+    rc = cli.main(["cli", "tune-show"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 entries" in out or "2 entries" in out
+    assert "weighted|inj=0" in out
+
+
+def test_cli_tune_dry_run(capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "tune", "512", "--dry-run"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dry run: nothing measured" in out
+    assert "feasible" in out
+
+    rc = cli.main(["cli", "tune-show"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 entries" in out
+
+
+def test_cli_tune_rejects_bad_args(capsys):
+    from ft_sgemm_tpu import cli
+
+    assert cli.main(["cli", "tune", "x"]) == 2
+    assert cli.main(["cli", "tune", "128", "256"]) == 2
+    assert cli.main(["cli", "tune", "--strategy=warp"]) == 2
+    assert cli.main(["cli", "tune", "--method=magic"]) == 2
+    capsys.readouterr()

@@ -145,6 +145,85 @@ def test_vmem_limit_env_override(monkeypatch):
     assert vmem_limit_bytes() == VMEM_LIMIT_BYTES  # cpu backend: default
 
 
+def test_vmem_limit_malformed_env_names_the_variable(monkeypatch):
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", "64MiB")
+    with pytest.raises(ValueError, match="FT_SGEMM_VMEM_LIMIT_BYTES"):
+        vmem_limit_bytes()
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", "-1")
+    with pytest.raises(ValueError, match="FT_SGEMM_VMEM_LIMIT_BYTES"):
+        vmem_limit_bytes()
+
+
+def test_vmem_limit_matches_generation_as_standalone_token():
+    """v2/v3 detection tokenizes the device kind: 'TPU v3' drops to the
+    16 MiB physical budget, while kinds that merely CONTAIN the characters
+    (v23, v35lite) keep the default. Exercised through the cached
+    resolver's device branch by faking the device query."""
+    import unittest.mock as mock
+
+    from ft_sgemm_tpu.configs import _resolve_vmem_limit
+
+    def limit_for(kind):
+        _resolve_vmem_limit.cache_clear()
+        dev = mock.Mock()
+        dev.device_kind = kind
+        with mock.patch("jax.local_devices", return_value=[dev]):
+            try:
+                return _resolve_vmem_limit(None)
+            finally:
+                _resolve_vmem_limit.cache_clear()
+
+    assert limit_for("TPU v2") == 16 * MIB
+    assert limit_for("TPU v3") == 16 * MIB
+    assert limit_for("TPU v4") == VMEM_LIMIT_BYTES
+    assert limit_for("TPU v5 lite") == VMEM_LIMIT_BYTES
+    assert limit_for("TPU v23") == VMEM_LIMIT_BYTES   # not a v2/v3 token
+    assert limit_for("tpuv35x") == VMEM_LIMIT_BYTES
+
+
+def test_vmem_limit_resolution_is_cached(monkeypatch):
+    """The env-keyed resolver must not re-pay the device query per kernel
+    trace: same env value -> same cached resolution object path."""
+    from ft_sgemm_tpu.configs import _resolve_vmem_limit
+
+    monkeypatch.setenv("FT_SGEMM_VMEM_LIMIT_BYTES", str(48 * MIB))
+    before = _resolve_vmem_limit.cache_info().hits
+    assert vmem_limit_bytes() == 48 * MIB
+    assert vmem_limit_bytes() == 48 * MIB
+    assert _resolve_vmem_limit.cache_info().hits > before
+
+
+def test_fit_keeps_k_depth_when_temps_dominate():
+    """ADVICE r5: the weighted temps term (factor * a_rows * bn * 4) is
+    bk-independent; when draining bk to 128 cannot absorb the overage the
+    fitter must shrink the dimension with the largest predicted reduction
+    (bn here) instead of futilely spending all K-depth first."""
+    wide = dataclasses.replace(HUGE, bm=512, bn=1024, bk=512)
+    # bk floor can't fix it: ~31.5 MiB at bk=128 vs the 24 MiB limit.
+    assert estimate_vmem_bytes(
+        dataclasses.replace(wide, bk=128), "weighted") > 24 * MIB
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        fitted = fit_block_to_vmem(
+            wide, "weighted", limit=24 * MIB, allow_shrink=True)
+    assert estimate_vmem_bytes(fitted, "weighted") <= 24 * MIB
+    assert fitted.bk == 512, (
+        f"K-depth drained to {fitted.bk} though bk cannot fix the overage")
+    assert fitted.bn < 1024
+
+
+def test_fit_still_prefers_bk_when_it_suffices():
+    """When bk alone CAN absorb the overage, it stays the first (cheapest)
+    dimension shrunk — bm/bn untouched."""
+    deep = dataclasses.replace(HUGE, bm=512, bn=512, bk=2048)
+    limit = estimate_vmem_bytes(
+        dataclasses.replace(deep, bk=1024), "plain", in_itemsize=4)
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        fitted = fit_block_to_vmem(
+            deep, None, limit=limit, allow_shrink=True)
+    assert (fitted.bm, fitted.bn) == (512, 512)
+    assert fitted.bk < 2048
+
+
 def test_oversized_named_shape_shrinks_end_to_end(monkeypatch, rng):
     """The wire-level guarantee: a named-shape call over budget produces a
     shrunk compile + warning and a CORRECT result — never an exception.
