@@ -155,8 +155,8 @@ def _read_records(path):
 # Context stages the worker wants beyond the headline; _worker_rc derives
 # the supervisor-facing exit status from the records alone.
 WANTED_STAGES = ("backend", "xla_dot", "plain_huge", "ft_rowcol",
-                 "ft_fused", "bf16_abft", "bf16_fused", "bf16_plain",
-                 "bf16_xla")
+                 "ft_rowcol_mxu", "ft_fused", "bf16_abft", "bf16_fused",
+                 "bf16_plain", "bf16_xla")
 
 
 def _worker_rc(rec):
@@ -412,6 +412,7 @@ def _emit_locked(values, errors, extra_errors=None):
         "xla_dot": "xla_dot_gflops",
         "plain_huge": "kernel_sgemm_huge_gflops",
         "ft_rowcol": "abft_rowcol_gflops",
+        "ft_rowcol_mxu": "abft_rowcol_mxu_gflops",
         "ft_fused": "abft_fused_gflops",
         "bf16_abft": "bf16_abft_huge_gflops",
         "bf16_fused": "bf16_abft_fused_gflops",
@@ -430,6 +431,31 @@ def _emit_locked(values, errors, extra_errors=None):
         if src in values and values[src] is not None:
             v = values[src]
             context[dst] = round(v, 1) if isinstance(v, float) else v
+
+    # VPU-vs-MXU encode comparison: the same strategy measured under both
+    # checksum-encode modes at this size, so the artifact answers "did the
+    # augmented-operand encode pay off?" without cross-referencing stages.
+    # rowcol pairs its two stages directly; the weighted pair is the
+    # ladder's weighted measurement (VPU/precomp) vs the fused stage
+    # (weighted's MXU encode under its historical strategy name).
+    enc_cmp = {}
+    rc_pair = {}
+    if isinstance(values.get("ft_rowcol"), (int, float)):
+        rc_pair["vpu"] = round(values["ft_rowcol"], 1)
+    if isinstance(values.get("ft_rowcol_mxu"), (int, float)):
+        rc_pair["mxu"] = round(values["ft_rowcol_mxu"], 1)
+    if rc_pair:
+        enc_cmp["rowcol"] = rc_pair
+    w_pair = {}
+    if isinstance(ladder_gflops, (int, float)) and (
+            ladder_strategy is None or "rowcol" not in ladder_strategy):
+        w_pair["vpu"] = round(ladder_gflops, 1)
+    if isinstance(values.get("ft_fused"), (int, float)):
+        w_pair["mxu"] = round(values["ft_fused"], 1)
+    if w_pair:
+        enc_cmp["weighted"] = w_pair
+    if enc_cmp:
+        context["encode_comparison"] = {"size": SIZE, **enc_cmp}
 
     xla = values.get("xla_dot")
     plain = values.get("plain_huge")
@@ -497,6 +523,7 @@ def _best_measurement(vals):
     ft = rec.get("gflops") if isinstance(rec, dict) else rec
     strategy = rec.get("strategy") if isinstance(rec, dict) else None
     for stage, label in (("ft_rowcol", "rowcol"),
+                         ("ft_rowcol_mxu", "rowcol (MXU-augmented encode)"),
                          ("ft_fused", "fused (MXU-augmented)")):
         v = vals.get(stage)
         if isinstance(v, (int, float)) and (ft is None or v > ft):
@@ -846,6 +873,17 @@ def main():
 # Worker
 # --------------------------------------------------------------------------
 
+def _stage_need(est_seconds, stage_max):
+    """Wall-clock budget a new stage must fit before it launches.
+
+    1.5x the largest completed stage's wall time (headroom for variance),
+    floored at the historical 20 s guard, capped by
+    ``FT_SGEMM_BENCH_STAGE_MAX`` so one pathologically slow stage cannot
+    make the guard refuse every later stage.
+    """
+    return min(max(20.0, 1.5 * est_seconds), stage_max)
+
+
 def _retry(what, fn, errors, attempts=4, base=3.0):
     """Run fn() with exponential-backoff retries; record failure and return
     None instead of raising (transient axon tunnel errors: compile-helper
@@ -958,13 +996,35 @@ def _worker_stages(rec):
 
     errors = {}
 
+    # Per-stage wall-clock budget (graceful early-stop): BENCH_r05 lost
+    # its number because the supervisor's deadline landed MID-stage —
+    # the kill discarded the whole attempt's in-flight work and the
+    # artifact read null. A stage that probably cannot finish in the
+    # remaining budget is now SKIPPED WITH A RECORD instead of started:
+    # each completed stage's wall time updates a running estimate, and a
+    # new stage only launches when ~1.5x that estimate (floored at the
+    # old 20 s guard, capped by FT_SGEMM_BENCH_STAGE_MAX) still fits.
+    # Every completed record is fsync'd immediately (Recorder), so a
+    # slow stage degrades the artifact to "skipped: ..." rows rather
+    # than nulling it.
+    stage_max = float(os.environ.get("FT_SGEMM_BENCH_STAGE_MAX", 300.0))
+    stage_est = {"seconds": 20.0}  # prior: the old flat guard
+
     def record_retry(name, fn, attempts=3, base=2.0):
         if rec.done(name):
             return rec.values[name]
-        if left() < 20:
-            rec.fail(name, "skipped: worker deadline reached")
+        need = _stage_need(stage_est["seconds"], stage_max)
+        if left() < need:
+            rec.fail(name, f"skipped: worker deadline within ~{need:.0f}s"
+                           " stage budget (graceful early-stop)")
             return None
+        t_stage = time.monotonic()
         out = _retry(name, fn, errors, attempts=attempts, base=base)
+        elapsed = time.monotonic() - t_stage
+        if out is not None:
+            # Only successful stages update the estimate: a failed stage's
+            # wall time is retry backoff, not measurement cost.
+            stage_est["seconds"] = max(stage_est["seconds"], elapsed)
         if out is None:
             rec.fail(name, errors.get(name, "unknown"))
         else:
@@ -1053,7 +1113,11 @@ def _worker_stages(rec):
     a, b, c = inputs
 
     def gf(fn, *args):
-        sec = bench_seconds_per_call(fn, *args, min_device_time=2.0)
+        # Tight remaining budget: trade a little timing variance (shorter
+        # device-time floor) for finishing the stage inside the deadline —
+        # a slightly noisier measured row beats a killed-mid-stage null.
+        mdt = 2.0 if left() > 180.0 else 1.0
+        sec = bench_seconds_per_call(fn, *args, min_device_time=mdt)
         return flop / 1e9 / sec
 
     inj = InjectionSpec.reference_like(SIZE, SHAPES["huge"].bk)
@@ -1131,6 +1195,16 @@ def _worker_stages(rec):
         return gf(lambda a, b, x: ft_rc(a, b, x, inj).c, a, b, c)
 
     record_retry("ft_rowcol", rowcol_fn, attempts=2)
+
+    def rowcol_mxu_fn():
+        # The VPU-vs-MXU encode comparison row (emit pairs it with
+        # ft_rowcol): same strategy, same injection, expected checksums
+        # riding the augmented dot instead of per-step VPU reductions.
+        ft_rm = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                              strategy="rowcol", encode="mxu")
+        return gf(lambda a, b, x: ft_rm(a, b, x, inj).c, a, b, c)
+
+    record_retry("ft_rowcol_mxu", rowcol_mxu_fn, attempts=2)
 
     def fused_fn():
         ft_fu = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
@@ -1235,9 +1309,80 @@ def _worker_stages(rec):
     return _worker_rc(rec)
 
 
+def smoke_main():
+    """``--smoke``: one tiny size, both encode modes, any backend.
+
+    A CI-runnable liveness check for the bench entrypoint: no supervisor,
+    no TPU requirement, no records file — just the import path, the FT
+    kernel factories under BOTH checksum-encode modes (injected faults
+    must be corrected), and one JSON line on stdout. Keeps the bench
+    entrypoint from silently rotting between hardware windows: a broken
+    import, factory, or encode path fails CI in seconds instead of
+    surfacing as a null artifact in the next scarce TPU tunnel.
+    """
+    import numpy as np
+
+    t0 = time.monotonic()
+    try:
+        import jax
+
+        from ft_sgemm_tpu import InjectionSpec, make_ft_sgemm
+        from ft_sgemm_tpu.configs import KernelShape
+        from ft_sgemm_tpu.ops.reference import sgemm_reference
+        from ft_sgemm_tpu.utils.matrices import (
+            generate_random_matrix, verify_matrix)
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        print(json.dumps({"metric": "bench_smoke", "value": 0, "unit": "ok",
+                          "vs_baseline": None,
+                          "context": {"smoke": True, "errors": {
+                              "import": f"{type(e).__name__}: {e}"}}}),
+              flush=True)
+        sys.stderr.write(traceback.format_exc())
+        return 1
+
+    size = 256
+    tile = KernelShape("smoke", 128, 128, 128, (0,) * 7)
+    rng = np.random.default_rng(10)
+    a = generate_random_matrix(size, size, rng=rng)
+    b = generate_random_matrix(size, size, rng=rng)
+    c = generate_random_matrix(size, size, rng=rng)
+    want = np.asarray(sgemm_reference(a, b, c, 1.0, -1.5))
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    context = {"smoke": True, "size": size,
+               "backend": jax.default_backend(), "encode_modes": {},
+               "errors": {}}
+    ok_all = True
+    for enc in ("vpu", "mxu"):
+        try:
+            ft = make_ft_sgemm(tile, alpha=1.0, beta=-1.5,
+                               strategy="rowcol", encode=enc)
+            t1 = time.monotonic()
+            res = ft(a, b, c, inj)
+            jax.block_until_ready(res.c)
+            dt = time.monotonic() - t1
+            ok, nbad, _ = verify_matrix(want, np.asarray(res.c),
+                                        verbose=False)
+            unc = int(res.num_uncorrectable)
+            context["encode_modes"][enc] = {
+                "corrected_ok": bool(ok), "detections": int(res.num_detected),
+                "uncorrectable": unc, "seconds": round(dt, 3)}
+            ok_all &= bool(ok) and unc == 0
+        except Exception as e:  # noqa: BLE001 — record per-mode, keep going
+            context["errors"][enc] = f"{type(e).__name__}: {e}"
+            sys.stderr.write(traceback.format_exc())
+            ok_all = False
+    context["seconds_total"] = round(time.monotonic() - t0, 3)
+    print(json.dumps({"metric": "bench_smoke", "value": 1 if ok_all else 0,
+                      "unit": "ok", "vs_baseline": None,
+                      "context": context}), flush=True)
+    return 0 if ok_all else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         sys.exit(worker_main(sys.argv[2]))
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(smoke_main())
     if "--tuned" in sys.argv[1:]:
         # The worker inherits the supervisor's env (attempt launches build
         # env from os.environ), so one flag covers every relaunch.

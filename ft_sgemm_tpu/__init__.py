@@ -27,6 +27,7 @@ from ft_sgemm_tpu import telemetry, tuner, utils
 from ft_sgemm_tpu.configs import (
     KernelShape,
     SHAPES,
+    ENCODE_MODES,
     KERNEL_TABLE,
     kernel_for_id,
 )
@@ -68,6 +69,7 @@ __all__ = [
     "ft_sgemm",
     "FtMatmulResult",
     "FtSgemmResult",
+    "ENCODE_MODES",
     "STRATEGIES",
     "abft_baseline_sgemm",
     "FtAttentionResult",

@@ -27,11 +27,12 @@ Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
         [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
-        [--telemetry=LOG.jsonl]
+        [--encode=vpu|mxu] [--telemetry=LOG.jsonl]
     python -m ft_sgemm_tpu.cli telemetry LOG.jsonl
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
-        [--dtype=...] [--plain] [--inject] [--budget=N] [--reps=N] \
-        [--samples=N] [--method=wall|interpret|compile] [--dry-run]
+        [--encode=vpu|mxu] [--dtype=...] [--plain] [--inject] [--budget=N] \
+        [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
+        [--dry-run]
     python -m ft_sgemm_tpu.cli tune-show
 
 ``tune`` runs the autotuner (``ft_sgemm_tpu.tuner``): enumerate the legal
@@ -71,6 +72,14 @@ excluded from the verification gate since corruption is left in the
 output by design), or ``fused`` (checksum moments ride extra A rows
 through the same MXU dot — the warp-level design's TPU analog).
 
+``--encode`` picks the checksum-encode mode for the FT rows
+(``ops/ft_sgemm.py`` "Encode modes"): ``vpu`` (default — per-K-step VPU
+reductions, the original design) or ``mxu`` (expected checksums ride the
+systolic array as augmented operand rows: one dot per K step yields the
+product AND the expected checksums). Applies to every strategy; the
+``tune`` subcommand searches and caches the two modes under separate
+keys.
+
 ``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
 analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
 "Tracing"); open DIR with TensorBoard or Perfetto.
@@ -87,7 +96,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ft_sgemm_tpu.configs import KERNEL_TABLE, PERF_ROW_IDS, kernel_for_id
+from ft_sgemm_tpu.configs import (
+    ENCODE_MODES,
+    KERNEL_TABLE,
+    PERF_ROW_IDS,
+    kernel_for_id,
+)
 from ft_sgemm_tpu.injection import InjectionSpec
 from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
 from ft_sgemm_tpu.ops.ft_sgemm import STRATEGIES, make_ft_sgemm
@@ -100,20 +114,22 @@ ALPHA = 1.0   # sgemm.cu:22
 BETA = -1.5   # sgemm.cu:24,234
 
 
-def _build_ft(kernel_id: int, size: int, in_dtype: str, strategy: str):
+def _build_ft(kernel_id: int, size: int, in_dtype: str, strategy: str,
+              encode: str = "vpu"):
     """The fused-ABFT kernel + reference-like injection for one kernel id —
     the ONE place the verification and perf paths get their FT recipe
     (kernel from the shape NAME so per-dtype tile overrides apply;
     injection cadence following the tile the kernel actually runs)."""
     _, shape, _ = kernel_for_id(kernel_id)
     ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA, in_dtype=in_dtype,
-                       strategy=strategy)
+                       strategy=strategy, encode=encode)
     inj = InjectionSpec.reference_like(size, ft.shape_config.bk)
     return ft, inj
 
 
 def _build_callable(kernel_id: int, size: int, inject_ft: bool,
-                    in_dtype: str = "float32", strategy: str = "weighted"):
+                    in_dtype: str = "float32", strategy: str = "weighted",
+                    encode: str = "vpu"):
     """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
     name, shape, is_abft = kernel_for_id(kernel_id)
     if kernel_id == 0:
@@ -127,7 +143,7 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     if not is_abft:
         return make_sgemm(shape.name, alpha=ALPHA, beta=BETA,
                           in_dtype=in_dtype)
-    ft, inj = _build_ft(kernel_id, size, in_dtype, strategy)
+    ft, inj = _build_ft(kernel_id, size, in_dtype, strategy, encode)
     if not inject_ft:
         inj = InjectionSpec.none()
     return lambda a, b, c: ft(a, b, c, inj).c
@@ -166,7 +182,7 @@ def _host_inputs(size: int):
 
 
 def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
-                            in_dtype: str):
+                            in_dtype: str, encode: str = "vpu"):
     """Verification gate for the detect-only ``global`` design: the output
     keeps injected corruption by definition, so the diff gate moves to
     (a) exact fault-event counting with injection ON and (b) a clean-run
@@ -175,7 +191,7 @@ def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
 
     _, shape, _ = kernel_for_id(kernel_id)
     ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA,
-                       in_dtype=in_dtype, strategy="global")
+                       in_dtype=in_dtype, strategy="global", encode=encode)
     eff = shrink_block(ft.shape_config, end_size, end_size, end_size)
     inj = InjectionSpec.reference_like(end_size, eff.bk)
     res = ft(a, b, c, inj)
@@ -197,7 +213,8 @@ def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
 
 def run_verification(end_size: int, st_kernel: int, end_kernel: int,
                      out=sys.stdout, in_dtype: str = "float32",
-                     strategy: str = "weighted") -> bool:
+                     strategy: str = "weighted",
+                     encode: str = "vpu") -> bool:
     """Pass 1: diff every selected kernel against the XLA oracle (for bf16
     mode: the XLA dot over the same bf16-rounded inputs).
 
@@ -219,13 +236,14 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
         name, _, is_abft = kernel_for_id(kernel_id)
         if is_abft and kernel_id != 10 and strategy == "global":
             ok, status = _verify_global_strategy(
-                kernel_id, end_size, a, b, c, want, in_dtype)
+                kernel_id, end_size, a, b, c, want, in_dtype, encode)
             all_ok &= ok
         elif is_abft and kernel_id != 10:
             # Correcting FT rows: diff gate PLUS the residual-after-correct
             # re-check — an interval the kernel itself could not verify
             # fails the row even if the diff happens to pass.
-            ft, inj = _build_ft(kernel_id, end_size, in_dtype, strategy)
+            ft, inj = _build_ft(kernel_id, end_size, in_dtype, strategy,
+                                encode)
             res = ft(a, b, c, inj)
             ok, nbad, first = verify_matrix(want, np.asarray(res.c),
                                             verbose=False)
@@ -240,7 +258,8 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
             all_ok &= ok
         else:
             fn = _build_callable(kernel_id, end_size, inject_ft=True,
-                                 in_dtype=in_dtype, strategy=strategy)
+                                 in_dtype=in_dtype, strategy=strategy,
+                                 encode=encode)
             got = np.asarray(fn(a, b, c))
             ok, nbad, first = verify_matrix(want, got, verbose=False)
             status = "pass" if ok else f"FAIL ({nbad} bad, first at {first})"
@@ -254,7 +273,8 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
                    st_kernel: int, end_kernel: int,
                    min_device_time: float = 1.0, out=sys.stdout,
                    in_dtype: str = "float32",
-                   strategy: str = "weighted") -> dict:
+                   strategy: str = "weighted",
+                   encode: str = "vpu") -> dict:
     """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439).
 
     The sweep runs SIZE-major — all kernel rows measured per size — so
@@ -276,7 +296,8 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
         a, b, c = map(jax.device_put, (ah, bh, ch))
         for kernel_id in row_ids:
             fn = _build_callable(kernel_id, size, inject_ft=True,
-                                 in_dtype=in_dtype, strategy=strategy)
+                                 in_dtype=in_dtype, strategy=strategy,
+                                 encode=encode)
             sec_per_rep = bench_seconds_per_call(
                 fn, a, b, c, min_device_time=min_device_time)
             gf = 2.0 * size**3 / 1e9 / sec_per_rep
@@ -343,6 +364,7 @@ def run_tune(args, flags, out=None) -> int:
         print("ft_sgemm: tune takes SIZE or M N K", file=sys.stderr)
         return 2
     strategy = "weighted"
+    encode = "vpu"
     in_dtype = "float32"
     budget = 8
     method = None
@@ -353,6 +375,12 @@ def run_tune(args, flags, out=None) -> int:
             if strategy not in STRATEGIES:
                 print(f"--strategy must be one of {STRATEGIES}, got"
                       f" {strategy!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--encode="):
+            encode = f.split("=", 1)[1]
+            if encode not in ENCODE_MODES:
+                print(f"--encode must be one of {ENCODE_MODES}, got"
+                      f" {encode!r}", file=sys.stderr)
                 return 2
         elif f.startswith("--dtype="):
             in_dtype = f.split("=", 1)[1]
@@ -390,11 +418,12 @@ def run_tune(args, flags, out=None) -> int:
                   file=out, flush=True)
 
     report = tuner.tune(
-        m, n, k, strategy=strategy, in_dtype=in_dtype,
+        m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
         inject="--inject" in flags, method=method, budget=budget,
         reps=reps, samples=samples, dry_run=dry_run, progress=progress)
     strat = report["strategy"]
-    print(f"tune {m}x{n}x{k} strategy={strat} dtype={in_dtype}"
+    print(f"tune {m}x{n}x{k} strategy={strat} encode={report['encode']}"
+          f" dtype={in_dtype}"
           f" method={report['method']} key={report['key']}", file=out)
     print(f"candidates: {len(report['feasible'])} feasible,"
           f" {len(report['pruned'])} pruned", file=out)
@@ -476,6 +505,7 @@ def main(argv=None) -> int:
     trace_dir = None
     in_dtype = "float32"
     strategy = "weighted"
+    encode = "vpu"
     telemetry_log = None
     for f in flags:
         if f.startswith("--mintime="):
@@ -496,6 +526,12 @@ def main(argv=None) -> int:
                 print(f"--strategy must be one of {STRATEGIES}, got"
                       f" {strategy!r}", file=sys.stderr)
                 return 2
+        elif f.startswith("--encode="):
+            encode = f.split("=", 1)[1]
+            if encode not in ENCODE_MODES:
+                print(f"--encode must be one of {ENCODE_MODES}, got"
+                      f" {encode!r}", file=sys.stderr)
+                return 2
 
     if telemetry_log is not None:
         # Observability mode: events + host-side residual measurements
@@ -510,7 +546,8 @@ def main(argv=None) -> int:
     try:
         if "--no-verify" not in flags:
             ok = run_verification(end_size, st_kernel, end_kernel,
-                                  in_dtype=in_dtype, strategy=strategy)
+                                  in_dtype=in_dtype, strategy=strategy,
+                                  encode=encode)
         if "--no-perf" not in flags:
             import contextlib
 
@@ -519,7 +556,8 @@ def main(argv=None) -> int:
             with ctx:
                 run_perf_table(start_size, end_size, gap_size, st_kernel,
                                end_kernel, min_device_time=min_device_time,
-                               in_dtype=in_dtype, strategy=strategy)
+                               in_dtype=in_dtype, strategy=strategy,
+                               encode=encode)
     finally:
         if telemetry_log is not None:
             from ft_sgemm_tpu import telemetry

@@ -55,6 +55,47 @@ class KernelShape:
     def block(self) -> Tuple[int, int, int]:
         return (self.bm, self.bn, self.bk)
 
+    def aug_block(self, aug_a: int = 0, aug_b: int = 0) -> Tuple[int, int, int]:
+        """The (a_rows, b_rows, bk) the kernel's BlockSpecs use when operand
+        augmentation rides checksum rows on the A/B tiles (``encode="mxu"``
+        and the fused strategy).
+
+        ``aug_a``/``aug_b`` are the appended checksum-row counts (see
+        ``aug_rows``). Validates that the augmented sublane dims stay
+        legal Mosaic tiles — every block dim is a multiple of 128, so any
+        augmentation that is itself a multiple of the dtype's sublane
+        granule (8 for f32, 16 for bf16 — which ``aug_rows`` guarantees)
+        is legal; a hand-rolled augmentation that is not gets a loud error
+        here instead of an opaque Mosaic layout failure.
+        """
+        for label, aug in (("aug_a", aug_a), ("aug_b", aug_b)):
+            if aug < 0 or aug % 8 != 0:
+                raise ValueError(
+                    f"KernelShape.aug_block: {label}={aug} must be a"
+                    " non-negative multiple of 8 (f32 sublane granule);"
+                    " use configs.aug_rows for the dtype-correct count")
+        return (self.bm + aug_a, self.bn + aug_b, self.bk)
+
+
+# Checksum-row augmentation ("mxu" encode / the fused strategy): moment rows
+# appended to an operand tile must keep the tile's sublane dim aligned, so
+# the row count is padded to the dtype's sublane granule — 8 rows for f32
+# (3 moment rows padded), 16 for bf16 (up to 9 hi/lo/lo2 term rows padded;
+# bf16 sublane tiling is 16). One source for the kernels (ops/ft_sgemm) and
+# the VMEM footprint model (ops/vmem).
+def aug_rows(in_itemsize: int) -> int:
+    """Sublane-aligned augmented-row count for one operand's checksum rows."""
+    return 8 if in_itemsize == 4 else 16
+
+
+# Checksum-encode modes of the FT kernel family (ops/ft_sgemm):
+#   "vpu" — per-K-step whole-tile VPU reductions build the expected
+#           checksums (the original design; the default).
+#   "mxu" — the expected checksums ride the systolic array as augmented
+#           operand rows: one dot_general per K step yields the partial
+#           product AND the expected-checksum accumulators.
+ENCODE_MODES = ("vpu", "mxu")
+
 
 # The 6 shipped shapes (+ the reference's unused "test" shape), mirroring the
 # canonical table at reference code_gen/main.py:8-16. TPU tile choices:

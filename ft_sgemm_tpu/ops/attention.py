@@ -201,6 +201,7 @@ def make_ft_attention(
     scale: Optional[float] = None,
     causal: bool = False,
     strategy: str = "weighted",
+    encode: str = "vpu",
     threshold: float | str = REFERENCE_THRESHOLD,
     softmax_threshold: float = SOFTMAX_RESIDUAL_THRESHOLD,
     softmax_recheck_rows: int = SOFTMAX_RECHECK_ROWS,
@@ -228,12 +229,20 @@ def make_ft_attention(
     recompute (0 disables, leaving only the rowsum invariant);
     ``softmax_fault`` is that stage's self-test hook — see
     :func:`_checked_softmax`.
+
+    ``encode`` selects the protected GEMMs' checksum-encode mode
+    (``make_ft_sgemm``): ``"mxu"`` rides the expected checksums through
+    the QK/PV dots as augmented operand rows instead of per-K-step VPU
+    reductions; the default ``"vpu"`` leaves both kernels bit-for-bit
+    unchanged.
     """
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
-                       threshold=threshold, in_dtype=in_dtype,
+                       encode=encode, threshold=threshold,
+                       in_dtype=in_dtype,
                        interpret=interpret, tunable=qk_shape is QK_SHAPE)
     pv = make_ft_sgemm(pv_shape, alpha=1.0, beta=0.0, strategy=strategy,
-                       threshold=threshold, in_dtype=in_dtype,
+                       encode=encode, threshold=threshold,
+                       in_dtype=in_dtype,
                        interpret=interpret, tunable=pv_shape is PV_SHAPE)
 
     def fn(q, k, v, inject: Optional[InjectionSpec] = None) -> FtAttentionResult:
@@ -245,10 +254,11 @@ def make_ft_attention(
                 softmax_recheck_rows, softmax_fault)
         if telemetry.enabled():
             telemetry.record_attention("ft_attention", res,
-                                       strategy=strategy)
+                                       strategy=strategy, encode=encode)
         return res
 
     fn.strategy = strategy
+    fn.encode = encode
     fn.in_dtype = in_dtype
     fn.causal = causal
     return fn
@@ -265,6 +275,7 @@ def make_ft_attention_diff(
     scale: Optional[float] = None,
     causal: bool = False,
     strategy: str = "weighted",
+    encode: str = "vpu",
     threshold: float | str = REFERENCE_THRESHOLD,
     bwd_threshold: Optional[float | str] = None,
     inject: Optional[InjectionSpec] = None,
@@ -323,8 +334,8 @@ def make_ft_attention_diff(
     inj_b = inj if inject_bwd is None else inject_bwd
     bthr = threshold if bwd_threshold is None else bwd_threshold
     mk = lambda shp, thr: make_ft_sgemm(  # noqa: E731
-        shp, alpha=1.0, beta=0.0, strategy=strategy, threshold=thr,
-        in_dtype=in_dtype, interpret=interpret,
+        shp, alpha=1.0, beta=0.0, strategy=strategy, encode=encode,
+        threshold=thr, in_dtype=in_dtype, interpret=interpret,
         tunable=shp is QK_SHAPE or shp is PV_SHAPE)
     qk = mk(qk_shape, threshold)
     pv = mk(pv_shape, threshold)
@@ -343,7 +354,7 @@ def make_ft_attention_diff(
             # Skips itself under a caller's jit/grad trace (tracers);
             # eager calls record the forward pass's materialized report.
             telemetry.record_attention("ft_attention_diff", res,
-                                       strategy=strategy)
+                                       strategy=strategy, encode=encode)
         return (res if with_counts else res.out), p, sc
 
     def _bwd_products(res, g):

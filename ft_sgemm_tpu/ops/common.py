@@ -133,14 +133,86 @@ def dtype_suffix(in_dtype) -> str:
     return "" if dt == jnp.float32 else f"_{dt.name}"
 
 
-def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int):
+def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
+                       block=None, strategy=None, multifault: bool = False,
+                       check_every=None):
     """FLOPs / bytes for one ``C = alpha*A@B.T + beta*C`` pass: A and B at
-    their input width, C read+written in f32."""
+    their input width, C read+written in f32.
+
+    The FT kernels pass ``block``/``strategy``/``multifault``/
+    ``check_every`` so the estimate covers what the plain model ignores —
+    Mosaic's scheduler must see honest costs for FT kernels:
+
+    - **Checksum-encode flops.** VPU encode (``rowcol``/``global``/
+      ``weighted``) re-reduces each operand block once per grid step, so
+      its cost scales as ``m*n*k*(c_a/bn + c_b/bm)`` with per-strategy
+      stream counts; MXU encode (``fused``/``*_mxu``) instead widens the
+      dot by the sublane-aligned augmented rows (``configs.aug_rows``):
+      ``2*k*(aug_a*n + aug_b*m)`` extra MXU flops plus the one-time
+      wrapper reduction over the augmented operand(s).
+    - **Detect/correct epilogue.** Each check reduces the (bm, bn)
+      accumulator per residual stream and applies the masked correction:
+      ``streams * m * n`` flops per check, ``ceil(nk/check_every)``
+      checks.
+    - **Epilogue bytes.** The augmented operand copies are real HBM
+      traffic (``aug * k`` rows per tile row/column), as are the
+      per-tile detection/uncorrectable counter outputs and the precomp
+      path's expected-checksum operand.
+
+    ``strategy`` takes the KERNEL-level value (``resolve_kernel_strategy``
+    — ``weighted`` with ``check_every >= nk`` is costed as the precomp
+    body). Plain callers keep the original 4-argument form and the
+    original numbers.
+    """
     import jax.experimental.pallas as pl
 
+    flops = 2 * m * n * k
+    bytes_accessed = in_itemsize * (m * k + n * k) + 4 * 2 * m * n
+    if strategy is not None:
+        from ft_sgemm_tpu.configs import aug_rows
+
+        bm, bn, bk = block
+        nk = max(1, -(-k // bk))
+        ce = nk if check_every is None else max(1, min(check_every, nk))
+        n_checks = -(-nk // ce)
+        precomp = strategy == "weighted" and ce >= nk
+        aug = aug_rows(in_itemsize)
+        # Encode flops + augmented-operand bytes per encode style.
+        if strategy in ("fused", "rowcol_mxu", "global_mxu"):
+            aug_a = aug
+            aug_b = aug if strategy in ("rowcol_mxu", "global_mxu") else 0
+            # Widened dot rows ride the MXU; the wrapper's one-time moment
+            # reduction costs ~2 flops per operand element per moment row.
+            flops += 2 * k * (aug_a * n + aug_b * m)
+            flops += 2 * (aug_a * m * k // max(bm, 1)
+                          + aug_b * n * k // max(bn, 1))
+            bytes_accessed += in_itemsize * k * (
+                aug_a * (m // bm) + aug_b * (n // bn))
+        elif precomp:
+            # Expected checksums via one stacked XLA dot OUTSIDE the
+            # kernel; in-kernel extra cost is only the (8, bn) expected-
+            # checksum operand window per tile.
+            bytes_accessed += 4 * 8 * (m // bm) * n
+        else:
+            # VPU encode streams per grid step: s_a/s_b reductions plus
+            # one elementwise multiply-reduce per expected-checksum
+            # stream ("weighted" carries 3 column streams, multifault
+            # rowcol 2 + 1 row stream, plain rowcol 1 + 1, global 1 + 1).
+            streams_a = {"rowcol": 2 if multifault else 1,
+                         "global": 1, "weighted": 3}[strategy]
+            streams_b = 1
+            flops += 3 * k * (streams_a * n + streams_b * m)
+        # Detect/correct epilogue: per check, ~2 flops per accumulator
+        # element per residual stream (reduce + masked correct/re-check).
+        streams = {"rowcol": 3 if multifault else 2, "rowcol_mxu": 3,
+                   "global": 1, "global_mxu": 1,
+                   "weighted": 3, "fused": 3}.get(strategy, 2)
+        flops += 2 * streams * m * n * n_checks
+        # det/unc counter outputs.
+        bytes_accessed += 2 * 4 * (m // bm) * (n // bn)
     return pl.CostEstimate(
-        flops=2 * m * n * k,
-        bytes_accessed=in_itemsize * (m * k + n * k) + 4 * 2 * m * n,
+        flops=int(flops),
+        bytes_accessed=int(bytes_accessed),
         transcendentals=0,
     )
 

@@ -75,13 +75,36 @@ the default.
     rows. Zero per-panel VPU encode work INSIDE the kernel; the costs are
     8/bm extra MXU rows (~1.6% FLOPs at bm=512) for f32 or 16/bm (~3.1%)
     for bf16 (moment rows ride as hi/lo/lo2 triples, ``_tile_moments``),
-    plus a per-call wrapper prep: ``_augment_a`` reduces A's moments
+    plus a per-call wrapper prep: ``_augment_tiles`` reduces A's moments
     (O(M*K) VPU) and materializes the augmented A copy in HBM (~one extra
     read+write of A) — cheap next to the GEMM at large K but, unlike the
     in-kernel encode strategies, not free; bench rows time it. Correction
     semantics match ``weighted`` (per-column localization + three-moment
     re-check) at ANY cadence — intermediate checks cost no extra encode,
     unlike weighted's running-sum variant.
+
+**Encode modes.** The operand-augmentation trick generalizes beyond the
+fused strategy: ``make_ft_sgemm(..., encode="mxu")`` computes EVERY
+strategy's expected checksums via augmented MXU operands instead of
+per-K-step VPU reductions, so one ``dot_general`` per K step yields both
+the partial product and the expected-checksum accumulators — the encode
+rides the systolic array nearly free while detection/correction stay
+unchanged at the ``check_every`` cadence:
+
+  - ``weighted`` + ``"mxu"`` runs the fused kernel (augmented A rows) at
+    any cadence — ``strategy="fused"`` is exactly this combination.
+  - ``rowcol`` + ``"mxu"`` augments BOTH operands
+    (:func:`_ft_kernel_rowcol_mxu`): A's tail rows carry its plain and
+    row-index-weighted checksum rows, B's tail rows its plain checksum
+    rows, and the one augmented dot's extra output rows/columns are the
+    expected column/row sums the VPU encode used to build elementwise.
+  - ``global`` + ``"mxu"`` augments both with plain checksum rows and
+    reads the expected whole-tile sum off the dot's corner block
+    (:func:`_ft_kernel_global_mxu`).
+
+``encode="vpu"`` (the default) is the original per-step VPU encode,
+bit-for-bit: the encode axis changes nothing unless selected (HLO pinned
+in ``tests/test_encode_mxu.py``).
 """
 
 from __future__ import annotations
@@ -99,8 +122,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import (
+    ENCODE_MODES,
     SHAPES,
     KernelShape,
+    aug_rows as _aug_rows,
     shape_for_dtype,
     vmem_limit_bytes,
 )
@@ -296,6 +321,81 @@ def _correction_pads(delta, axis, *weights):
     return pads
 
 
+def _rowcol_detect_correct(out_ref, count_ref, unc_count_ref, res_r, res_c,
+                           thresholds, bm, bn, multifault, moments_fn):
+    """Shared rowcol detect / correct / re-check, from residuals to stores.
+
+    The VPU-encode and MXU-encode rowcol kernels differ ONLY in where
+    their expected row/column sums come from (running elementwise VPU
+    accumulation vs augmented-dot output rows); everything from detection
+    through the residual-after-correct re-check is this one function so
+    the two encodes' correction and reporting behavior stays in lockstep.
+    ``thresholds`` is ``(thr, thr_m1)``; ``moments_fn()`` returns
+    ``(w_col, res_cw)`` — the weighted-residual pieces, evaluated only in
+    multifault mode so the plain kernel traces no weighted-moment ops.
+    """
+    threshold, thr_m1 = thresholds
+    det_r = jnp.abs(res_r) > threshold
+    det_c = jnp.abs(res_c) > threshold
+    hit = jnp.logical_and(det_r, det_c)                 # (bm, bn)
+    # Residual source: with exactly one flagged row and several flagged
+    # columns, the faults all sit in that row and the *column* residuals
+    # carry the per-fault values (and vice versa). The reference always
+    # uses the row residual (col for the wide shape, code_gen.py:417-424)
+    # and miscorrects that case; disambiguating costs two scalar counts.
+    n_rows_flagged = jnp.sum(det_r.astype(jnp.int32))
+    n_cols_flagged = jnp.sum(det_c.astype(jnp.int32))
+    use_col = (n_rows_flagged == 1) & (n_cols_flagged > 1)
+    corr = jnp.where(use_col, jnp.broadcast_to(res_c, hit.shape),
+                     jnp.broadcast_to(res_r, hit.shape))
+    if multifault:
+        # >1 row AND >1 col flagged: intersection is ambiguous (the
+        # wrong fault pairing has identical row/col sums). Localize
+        # each flagged column's fault row by the weighted-residual
+        # ratio instead — exact while each corrupted column holds at
+        # most one fault (the rotating injector guarantees distinct
+        # columns for up to bn faults per interval).
+        w_col, res_cw = moments_fn()
+        hit_w = _weighted_localize(res_c, res_cw, det_c, bm, bn)
+        ambiguous = (n_rows_flagged > 1) & (n_cols_flagged > 1)
+        hit = jnp.where(ambiguous, hit_w, hit)
+        corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
+                         corr)
+    delta = jnp.where(hit, corr, 0.0)
+    out_ref[:] += delta
+    count_ref[0] += jnp.sum(hit.astype(jnp.int32))
+    # Residual-after-correct re-check: residuals are linear in the
+    # accumulator, so the post-correction residuals are the pre-
+    # correction ones minus delta's row/col sums — no accumulator
+    # re-read. Anything still above threshold means a correction
+    # assumption broke (e.g. two same-column faults in the ambiguous
+    # >1-row/>1-col case): REPORT instead of staying silent.
+    res_r2 = res_r - jnp.sum(delta, axis=1, keepdims=True)
+    res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
+    # Correction-rounding floors shared with the moment kernels
+    # (_correction_pads): remnants of large corrected faults must not
+    # false-flag tiny auto thresholds.
+    (pad_r,) = _correction_pads(delta, 1)
+    (pad_c,) = _correction_pads(delta, 0)
+    bad_c = jnp.abs(res_c2) > threshold + pad_c
+    bad = (jnp.sum((jnp.abs(res_r2) > threshold + pad_r)
+                   .astype(jnp.int32))
+           + jnp.sum(bad_c.astype(jnp.int32)))
+    if multifault:
+        # The weighted residual exposes corrections that balanced the
+        # plain column sum on the WRONG row (its own noise-scaled
+        # threshold: see _moment_detect_correct).
+        res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
+        _, pad_w = _correction_pads(delta, 0, w_col)
+        bad += jnp.sum(((jnp.abs(res_cw2) > thr_m1 + pad_w)
+                        & ~bad_c).astype(jnp.int32))
+    # LEVEL, not accumulation: residuals are cumulative over K, so a
+    # stale broken interval stays visible at every later check —
+    # accumulating would re-count it once per check and inflate with
+    # cadence. The value reported is the state after the FINAL check.
+    unc_count_ref[0] = bad
+
+
 def _weighted_localize(res_c, res_cw, det_c, bm, bn):
     """Per-column fault-row localization by the weighted-residual ratio.
 
@@ -382,74 +482,161 @@ def _ft_kernel_rowcol(
         cs = jnp.sum(acc, axis=0, keepdims=True)            # (1, bn)
         res_r = r_exp_ref[:] - rs                           # (bm, 1)
         res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs       # (1, bn)
-        det_r = jnp.abs(res_r) > threshold
-        det_c = jnp.abs(res_c) > threshold
-        hit = jnp.logical_and(det_r, det_c)                 # (bm, bn)
-        # Residual source: with exactly one flagged row and several flagged
-        # columns, the faults all sit in that row and the *column* residuals
-        # carry the per-fault values (and vice versa). The reference always
-        # uses the row residual (col for the wide shape, code_gen.py:417-424)
-        # and miscorrects that case; disambiguating costs two scalar counts.
-        n_rows_flagged = jnp.sum(det_r.astype(jnp.int32))
-        n_cols_flagged = jnp.sum(det_c.astype(jnp.int32))
-        use_col = (n_rows_flagged == 1) & (n_cols_flagged > 1)
-        corr = jnp.where(use_col, jnp.broadcast_to(res_c, hit.shape),
-                         jnp.broadcast_to(res_r, hit.shape))
-        if multifault:
-            # >1 row AND >1 col flagged: intersection is ambiguous (the
-            # wrong fault pairing has identical row/col sums). Localize
-            # each flagged column's fault row by the weighted-residual
-            # ratio instead — exact while each corrupted column holds at
-            # most one fault (the rotating injector guarantees distinct
-            # columns for up to bn faults per interval).
+
+        def moments():
             w_col = jax.lax.broadcasted_iota(
                 jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
             csw = jnp.sum(acc * w_col, axis=0, keepdims=True)    # (1, bn)
             res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
-            hit_w = _weighted_localize(res_c, res_cw, det_c, bm, bn)
-            ambiguous = (n_rows_flagged > 1) & (n_cols_flagged > 1)
-            hit = jnp.where(ambiguous, hit_w, hit)
-            corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
-                             corr)
-        delta = jnp.where(hit, corr, 0.0)
-        out_ref[:] += delta
-        count_ref[0] += jnp.sum(hit.astype(jnp.int32))
-        # Residual-after-correct re-check: residuals are linear in the
-        # accumulator, so the post-correction residuals are the pre-
-        # correction ones minus delta's row/col sums — no accumulator
-        # re-read. Anything still above threshold means a correction
-        # assumption broke (e.g. two same-column faults in the ambiguous
-        # >1-row/>1-col case): REPORT instead of staying silent.
-        res_r2 = res_r - jnp.sum(delta, axis=1, keepdims=True)
-        res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
-        # Correction-rounding floors shared with the moment kernels
-        # (_correction_pads): remnants of large corrected faults must not
-        # false-flag tiny auto thresholds.
-        (pad_r,) = _correction_pads(delta, 1)
-        (pad_c,) = _correction_pads(delta, 0)
-        bad_c = jnp.abs(res_c2) > threshold + pad_c
-        bad = (jnp.sum((jnp.abs(res_r2) > threshold + pad_r)
-                       .astype(jnp.int32))
-               + jnp.sum(bad_c.astype(jnp.int32)))
-        if multifault:
-            # The weighted residual exposes corrections that balanced the
-            # plain column sum on the WRONG row (its own noise-scaled
-            # threshold: see _moment_detect_correct).
-            res_cw2 = res_cw - jnp.sum(delta * w_col, axis=0, keepdims=True)
-            _, pad_w = _correction_pads(delta, 0, w_col)
-            bad += jnp.sum(((jnp.abs(res_cw2) > thr_m1 + pad_w)
-                            & ~bad_c).astype(jnp.int32))
-        # LEVEL, not accumulation: residuals are cumulative over K, so a
-        # stale broken interval stays visible at every later check —
-        # accumulating would re-count it once per check and inflate with
-        # cadence. The value reported is the state after the FINAL check.
-        unc_count_ref[0] = bad
+            return w_col, res_cw
+
+        _rowcol_detect_correct(out_ref, count_ref, unc_count_ref,
+                               res_r, res_c, (threshold, thr_m1), bm, bn,
+                               multifault, moments)
 
     @pl.when(k == nk - 1)
     def _epilogue():
         out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
+
+
+def _ft_kernel_rowcol_mxu(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
+    r_exp_ref, c_exp_ref, count_ref, unc_count_ref,
+    *, alpha, beta, nk, prec, check_every, bm, bn, multifault, n_terms,
+):
+    """Rowcol with MXU-fused encode (``encode="mxu"`` — module docstring).
+
+    ``a_ref`` blocks are (bm + aug_a, bk): the tail rows hold A's plain
+    and row-index-weighted checksum rows (``_augment_tiles`` with 2
+    moments — row ``2*t + mi`` for term t, moment mi). ``b_ref`` blocks
+    are (bn + aug_b, bk): tail rows hold B's plain checksum rows (1
+    moment, row = term index). The ONE augmented dot therefore yields,
+    beyond the (bm, bn) partial product: the expected column-sum /
+    weighted-column-sum rows (``prod[bm:, :bn]``, accumulated in
+    ``c_exp_ref``) and the expected row-sum columns (``prod[:bm, bn:]``,
+    accumulated in ``r_exp_ref``); the (aug_a, aug_b) corner is unused.
+    Zero per-K-step VPU encode work; detection/correction/reporting is
+    byte-for-byte the rowcol kernel's (:func:`_rowcol_detect_correct`)
+    at the same cadence. SDC landing in a checksum row/column itself
+    surfaces as a residual with no consistent intersection: the re-check
+    flags the interval as uncorrectable (those rows never touch C).
+    """
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    threshold = inj_ref[4]  # runtime scalars: per-call thresholds
+    thr_m1 = inj_ref[5]     # weighted-moment re-check (multifault mode)
+
+    @pl.when(k == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        r_exp_ref[:] = jnp.zeros_like(r_exp_ref)
+        c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
+        count_ref[0] = 0
+        unc_count_ref[0] = 0
+
+    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+
+    prod = jax.lax.dot_general(
+        a_ref[:], b_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )                             # (bm + aug_a, bn + aug_b)
+    out_ref[:] += prod[:bm, :bn]
+    c_exp_ref[:] += prod[bm:, :bn]
+    r_exp_ref[:] += prod[:bm, bn:]
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect_correct():
+        acc = out_ref[:]
+        rs = jnp.sum(acc, axis=1, keepdims=True)            # (bm, 1)
+        cs = jnp.sum(acc, axis=0, keepdims=True)            # (1, bn)
+        # Term-summed expected moments: r_exp's columns are B's plain-sum
+        # terms (hi/lo/lo2 for bf16), c_exp's rows interleave A's (plain,
+        # weighted) moments at row 2*t + mi; zero pad rows add nothing.
+        res_r = jnp.sum(r_exp_ref[:], axis=1, keepdims=True) - rs
+        c_exp = c_exp_ref[0:1, :]
+        cw_exp = c_exp_ref[1:2, :]
+        for t in range(1, n_terms):
+            c_exp = c_exp + c_exp_ref[2 * t:2 * t + 1, :]
+            cw_exp = cw_exp + c_exp_ref[2 * t + 1:2 * t + 2, :]
+        res_c = c_exp - cs
+
+        def moments():
+            w_col = jax.lax.broadcasted_iota(
+                jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
+            csw = jnp.sum(acc * w_col, axis=0, keepdims=True)   # (1, bn)
+            return w_col, cw_exp - csw
+
+        _rowcol_detect_correct(out_ref, count_ref, unc_count_ref,
+                               res_r, res_c, (threshold, thr_m1), bm, bn,
+                               multifault, moments)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+        unc_ref[i, j] = unc_count_ref[0]
+
+
+def _ft_kernel_global_mxu(
+    inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
+    t_exp_ref, prev_ref, count_ref,
+    *, alpha, beta, nk, prec, check_every, bm, bn,
+):
+    """Global (scalar-checksum, detect-only) with MXU-fused encode.
+
+    Both operands carry their plain checksum rows (``_augment_tiles``
+    with 1 moment), so the augmented dot's (aug_a, aug_b) corner holds
+    every (A-sum term) x (B-sum term) product — its total IS the panel
+    product's expected sum (zero pad rows/columns contribute nothing).
+    Detection is byte-for-byte :func:`_ft_kernel_global`'s.
+    """
+    k = pl.program_id(2)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    threshold = inj_ref[4]  # runtime scalar (no moment re-checks here)
+
+    @pl.when(k == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        t_exp_ref[0] = 0.0
+        prev_ref[0] = 0.0
+        count_ref[0] = 0
+
+    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+
+    prod = jax.lax.dot_general(
+        a_ref[:], b_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )                             # (bm + aug, bn + aug)
+    out_ref[:] += prod[:bm, :bn]
+    t_exp_ref[0] += jnp.sum(prod[bm:, bn:])
+
+    do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
+
+    @pl.when(do_check)
+    def _detect():
+        # Fault EVENTS, not failed checks — see _ft_kernel_global.
+        res = t_exp_ref[0] - jnp.sum(out_ref[:])
+        count_ref[0] += (jnp.abs(res - prev_ref[0]) > threshold).astype(
+            jnp.int32)
+        prev_ref[0] = res
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        det_ref[i, j] = count_ref[0]
+        # Detect-only strategy: every detection is by definition
+        # uncorrected (FtSgemmResult docstring).
+        unc_ref[i, j] = count_ref[0]
 
 
 def _ft_kernel_global(
@@ -652,7 +839,7 @@ def _ft_kernel_fused(
     """MXU-fused checksum variant (warp-level analog — module docstring).
 
     ``a_ref`` blocks are (bm + aug, bk): the augmented tail rows hold the
-    input checksum moments (``_augment_a`` layout: for term t and moment
+    input checksum moments (``_augment_tiles`` layout: for term t and moment
     mi, tail row ``3*t + mi``), so the very same MXU dot that accumulates
     the C tile produces the EXPECTED column-moment rows — there is no
     separate encode path to corrupt independently. The moment rows
@@ -693,7 +880,7 @@ def _ft_kernel_fused(
     @pl.when(do_check)
     def _detect_correct():
         # Expected moments: sum the per-term scratch rows (1 term f32, 3
-        # for bf16 hi/lo/lo2 — _augment_a).
+        # for bf16 hi/lo/lo2 — _augment_tiles).
         exp = [exp_ref[mi:mi + 1, :] for mi in range(3)]
         for t in range(1, n_terms):
             exp = [e + exp_ref[3 * t + mi:3 * t + mi + 1, :]
@@ -712,48 +899,56 @@ def _ft_kernel_fused(
         unc_ref[i, j] = unc_count_ref[0]
 
 
-def _tile_moments(ap, bm):
-    """Per-row-tile checksum-moment rows of A, in ``ap``'s dtype.
+def _tile_moments(ap, bm, n_moments=3):
+    """Per-row-tile checksum-moment rows of an operand, in ``ap``'s dtype.
 
-    Returns (gm, R, K): for f32 inputs R=3 rows — the plain / w / w^2
-    column moments (weights {1..bm}) of each (bm, K) row tile; for bf16
-    R=9 — each moment expanded to bf16 hi+lo+lo2 terms at row ``3*t + mi``
+    Returns (gm, R, K): for f32 inputs R=``n_moments`` rows — the first
+    ``n_moments`` of the plain / w / w^2 column moments (weights
+    {1..bm}) of each (bm, K) row tile; for bf16 R=``3*n_moments`` — each
+    moment expanded to bf16 hi+lo+lo2 terms at row ``n_moments*t + mi``
     (term t, moment mi). The 3-term split matters because a single bf16
     cast of ``w^T A_i`` (magnitudes ~1e4) leaves ~0.3-1.4 of expectation
     noise — deposited INTO corrected elements, failing the 0.01/0.01
     verify tolerance — and the w^2 row reaches ~bm^2-scale magnitudes
     where even a 2-term split's noise could graze the 9500 detection
     threshold at K=6144; three terms put every row's error in the f32
-    accumulation-noise class. Shared by ``_augment_a`` (fused strategy)
-    and ``_expected_col_checksums`` (weighted precomp) so the encode
-    numerics of both MXU-side checksum paths stay in lockstep.
+    accumulation-noise class. Shared by ``_augment_tiles`` (every MXU
+    encode) and ``_expected_col_checksums`` (weighted precomp) so the
+    encode numerics of all MXU-side checksum paths stay in lockstep.
     """
     m, kdim = ap.shape
     gm = m // bm
     af = ap.reshape(gm, bm, kdim).astype(jnp.float32)
     w = (jnp.arange(bm, dtype=jnp.float32) + 1.0)[None, :, None]
-    moments = jnp.stack(
-        [jnp.sum(af, axis=1), jnp.sum(af * w, axis=1),
-         jnp.sum(af * (w * w), axis=1)], axis=1)          # (gm, 3, K)
+    cols = [jnp.sum(af, axis=1)]
+    if n_moments >= 2:
+        cols.append(jnp.sum(af * w, axis=1))
+    if n_moments >= 3:
+        cols.append(jnp.sum(af * (w * w), axis=1))
+    moments = jnp.stack(cols, axis=1)            # (gm, n_moments, K)
     if ap.dtype == jnp.bfloat16:
         hi = moments.astype(jnp.bfloat16)
         rem = moments - hi.astype(jnp.float32)
         lo = rem.astype(jnp.bfloat16)
         lo2 = (rem - lo.astype(jnp.float32)).astype(jnp.bfloat16)
-        return jnp.concatenate([hi, lo, lo2], axis=1)     # (gm, 9, K) bf16
-    return moments                                        # (gm, 3, K) f32
+        return jnp.concatenate([hi, lo, lo2], axis=1)  # (gm, 3R, K) bf16
+    return moments                               # (gm, n_moments, K) f32
 
 
-def _augment_a(ap, bm, aug):
-    """Append per-row-tile checksum-moment rows to A (``fused`` strategy).
+def _augment_tiles(ap, bm, aug, n_moments=3):
+    """Append per-row-tile checksum-moment rows to one operand.
 
     Returns (gm * (bm + aug), K) in ``ap``'s dtype: each tile's tail
-    ``aug`` rows hold the ``_tile_moments`` rows (3 for f32, 9 for bf16),
-    zero-padded to the sublane-aligned ``aug``.
+    ``aug`` rows hold the ``_tile_moments`` rows (``n_moments`` for f32,
+    ``3*n_moments`` hi/lo/lo2 terms for bf16), zero-padded to the
+    sublane-aligned ``aug`` (``configs.aug_rows``). Used on A by the
+    fused/weighted-mxu (3 moments) and rowcol-mxu (2) paths, and on B by
+    the rowcol-mxu and global-mxu paths (1 — B only ever contributes its
+    plain sums).
     """
     m, kdim = ap.shape
     gm = m // bm
-    rows = _tile_moments(ap, bm)
+    rows = _tile_moments(ap, bm, n_moments)
     tail = jnp.zeros((gm, aug, kdim), ap.dtype)
     tail = tail.at[:, :rows.shape[1], :].set(rows.astype(ap.dtype))
     return jnp.concatenate(
@@ -814,6 +1009,25 @@ _KERNELS = {
     "weighted": _ft_kernel_weighted,
 }
 
+# User-facing (strategy, encode) -> the kernel-level strategy value
+# _ft_sgemm_padded dispatches on. The fused strategy IS the weighted
+# design's MXU encode, so the two spellings share one kernel body.
+_MXU_KERNEL_STRATEGY = {
+    "weighted": "fused",
+    "fused": "fused",
+    "rowcol": "rowcol_mxu",
+    "global": "global_mxu",
+}
+
+
+def resolve_kernel_strategy(strategy: str, encode: str) -> str:
+    """The kernel/variant name a (strategy, encode) pair runs — shared
+    with the VMEM footprint model and the tuner's variant mapping (the
+    fitting variant must be the body that runs)."""
+    if encode == "mxu" or strategy == "fused":
+        return _MXU_KERNEL_STRATEGY[strategy]
+    return strategy
+
 
 @functools.partial(
     jax.jit,
@@ -853,11 +1067,13 @@ def _ft_sgemm_padded(
     # the running in-kernel encode.
     precomp = strategy == "weighted" and check_every >= nk
 
-    a_rows = bm  # A block / output block row count (augmented for "fused")
+    a_rows = bm  # A block / output block row count (augmented for MXU encode)
+    b_rows = bn  # B block row count (augmented when B carries checksum rows)
+    n_terms = 3 if a.dtype == jnp.bfloat16 else 1
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # inj spec + thresholds (7,)
         None,  # A spec placed below once a_rows is final
-        pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+        None,  # B spec placed below once b_rows is final
         pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
     ]
     operands = [inj, a, b, c]
@@ -871,10 +1087,9 @@ def _ft_sgemm_padded(
         operands += [exp]
         scratch = [pltpu.SMEM((1,), jnp.int32)]
     elif strategy == "fused":
-        n_terms = 3 if a.dtype == jnp.bfloat16 else 1
-        aug = 16 if n_terms == 3 else 8
+        aug = _aug_rows(a.dtype.itemsize)
         a_rows = bm + aug
-        operands[1] = _augment_a(a, bm, aug)
+        operands[1] = _augment_tiles(a, bm, aug)
         kernel = functools.partial(
             _ft_kernel_fused,
             alpha=alpha, beta=beta, nk=nk, prec=prec,
@@ -882,6 +1097,32 @@ def _ft_sgemm_padded(
         )
         scratch = [pltpu.VMEM((aug, bn), jnp.float32),
                    pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
+    elif strategy == "rowcol_mxu":
+        aug = _aug_rows(a.dtype.itemsize)
+        a_rows, b_rows, _ = shape.aug_block(aug, aug)
+        operands[1] = _augment_tiles(a, bm, aug, n_moments=2)
+        operands[2] = _augment_tiles(b, bn, aug, n_moments=1)
+        kernel = functools.partial(
+            _ft_kernel_rowcol_mxu,
+            alpha=alpha, beta=beta, nk=nk, prec=prec,
+            check_every=check_every, bm=bm, bn=bn,
+            multifault=multifault, n_terms=n_terms,
+        )
+        scratch = [pltpu.VMEM((bm, aug), jnp.float32),   # r_exp term cols
+                   pltpu.VMEM((aug, bn), jnp.float32),   # c_exp moment rows
+                   pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
+    elif strategy == "global_mxu":
+        aug = _aug_rows(a.dtype.itemsize)
+        a_rows, b_rows, _ = shape.aug_block(aug, aug)
+        operands[1] = _augment_tiles(a, bm, aug, n_moments=1)
+        operands[2] = _augment_tiles(b, bn, aug, n_moments=1)
+        kernel = functools.partial(
+            _ft_kernel_global_mxu,
+            alpha=alpha, beta=beta, nk=nk, prec=prec,
+            check_every=check_every, bm=bm, bn=bn,
+        )
+        scratch = [pltpu.SMEM((1,), jnp.float32),
+                   pltpu.SMEM((1,), jnp.float32), pltpu.SMEM((1,), jnp.int32)]
     else:
         extra = {"multifault": multifault} if strategy == "rowcol" else {}
         kernel = functools.partial(
@@ -892,6 +1133,7 @@ def _ft_sgemm_padded(
         )
         scratch = _scratch_for(strategy, bm, bn, multifault)
     in_specs[1] = pl.BlockSpec((a_rows, bk), lambda i, j, kk: (i, kk))
+    in_specs[2] = pl.BlockSpec((b_rows, bk), lambda i, j, kk: (j, kk))
 
     out, det, unc = pl.pallas_call(
         kernel,
@@ -910,11 +1152,18 @@ def _ft_sgemm_padded(
             jax.ShapeDtypeStruct((gm, gn), jnp.int32),
         ],
         scratch_shapes=scratch,
+        # The C operand aliases the f32 output: the beta*C epilogue reads
+        # each C tile in the same grid step that retires its output tile,
+        # so under jit XLA reuses the buffer instead of allocating and
+        # copying a second (M, N) HBM array (pinned in tests).
+        input_output_aliases={3: 0},
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),
         ),
-        cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
+        cost_estimate=_gemm_cost_estimate(
+            m, n, k, a.dtype.itemsize, block=shape.block, strategy=strategy,
+            multifault=multifault, check_every=check_every),
         interpret=interpret,
     )(*operands)
     return out, det, unc
@@ -926,6 +1175,7 @@ def make_ft_sgemm(
     alpha: float = 1.0,
     beta: float = -1.5,
     strategy: str = "weighted",
+    encode: str = "vpu",
     threshold: float | str = REFERENCE_THRESHOLD,
     threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
     check_every: Optional[int] = None,
@@ -968,6 +1218,17 @@ def make_ft_sgemm(
     checksum moments ride extra A rows through the same dot — weighted-
     class correction at any cadence with zero per-panel encode work.
 
+    ``encode`` selects how expected checksums are produced for the WHOLE
+    strategy family (module docstring "Encode modes"): ``"vpu"`` (default)
+    keeps the original per-K-step VPU reductions — the emitted HLO is
+    byte-identical to not passing ``encode`` at all; ``"mxu"`` appends the
+    panel checksum rows to the A (and, for rowcol/global, B) tiles so ONE
+    ``dot_general`` per K step yields the partial product and the
+    expected-checksum accumulators. ``strategy="fused"`` is the
+    ``("weighted", "mxu")`` combination under its historical name and
+    always encodes on the MXU. Detection, correction, cadence, threshold,
+    and reporting semantics are identical across encodes.
+
     ``threshold="auto"`` computes the detection threshold PER CALL from
     the inputs' moments: ``threshold_margin`` x the calibrated
     closed-form noise-floor bound (``analysis.estimate_noise_floor``; the
@@ -990,6 +1251,12 @@ def make_ft_sgemm(
     """
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
+    if encode not in ENCODE_MODES:
+        raise ValueError(
+            f"unknown encode mode {encode!r}; pick from {ENCODE_MODES}")
+    if strategy == "fused":
+        encode = "mxu"  # the fused strategy IS the weighted MXU encode
+    kernel_strategy = resolve_kernel_strategy(strategy, encode)
     if isinstance(threshold, str) and threshold != "auto":
         raise ValueError(
             f"threshold must be a float or 'auto', got {threshold!r}")
@@ -1021,7 +1288,9 @@ def make_ft_sgemm(
             from ft_sgemm_tpu import tuner as _tuner
 
             tuned = _tuner.lookup_tile(
-                m, n, a.shape[1], strategy=strategy, in_dtype=in_dtype,
+                m, n, a.shape[1],
+                strategy=("weighted" if strategy == "fused" else strategy),
+                encode=encode, in_dtype=in_dtype,
                 injection_enabled=inject.enabled)
             if tuned is not None:
                 eff = tuned
@@ -1071,8 +1340,8 @@ def make_ft_sgemm(
         # the real kernel fits — the tuner's pre-filter makes the same
         # call, scripts/tune_tiles.py).
         nk0, ce0 = resolve_cadence(eff)
-        variant = strategy
-        if strategy == "weighted" and ce0 >= nk0:
+        variant = kernel_strategy
+        if kernel_strategy == "weighted" and ce0 >= nk0:
             variant = "weighted_precomp"
         limit = vmem_limit_bytes()
         itemsize = jnp.dtype(in_dtype).itemsize
@@ -1128,7 +1397,7 @@ def make_ft_sgemm(
                 ap, bp, cp, jnp.asarray(inject.as_operand()),
                 shape=eff, alpha=alpha, beta=beta, precision=precision,
                 threshold=(thr, thr_m1, thr_m2), check_every=ce,
-                strategy=strategy, multifault=mf,
+                strategy=kernel_strategy, multifault=mf,
                 interpret=_should_interpret(interpret),
             )
         result = FtSgemmResult(out[:m, :n], det, unc)
@@ -1137,21 +1406,24 @@ def make_ft_sgemm(
             # (skipped automatically when they are tracers — a caller's
             # jit); the jitted computation above is untouched either way.
             telemetry.record_gemm(
-                op_name, result, strategy=strategy, threshold=thr,
-                operands=(a, b, c), alpha=alpha, beta=beta)
+                op_name, result, strategy=strategy, encode=encode,
+                threshold=thr, operands=(a, b, c), alpha=alpha, beta=beta)
         return result
 
-    op_name = f"ft_sgemm_{shape.name}_{strategy}" + _dtype_suffix(in_dtype)
+    op_name = (f"ft_sgemm_{shape.name}_{strategy}"
+               + ("_mxu" if encode == "mxu" and strategy != "fused" else "")
+               + _dtype_suffix(in_dtype))
     fn.__name__ = op_name
     fn.shape_config = shape
     fn.strategy = strategy
+    fn.encode = encode
     fn.in_dtype = in_dtype
     return fn
 
 
 def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              beta=-1.5, inject: Optional[InjectionSpec] = None,
-             strategy: str = "weighted",
+             strategy: str = "weighted", encode: str = "vpu",
              threshold: float | str = REFERENCE_THRESHOLD,
              threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
              check_every: Optional[int] = None, precision: str = "highest",
@@ -1159,7 +1431,8 @@ def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              interpret: Optional[bool] = None) -> FtSgemmResult:
     """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
     return make_ft_sgemm(
-        shape, alpha=alpha, beta=beta, strategy=strategy, threshold=threshold,
+        shape, alpha=alpha, beta=beta, strategy=strategy, encode=encode,
+        threshold=threshold,
         threshold_margin=threshold_margin, check_every=check_every,
         precision=precision, in_dtype=in_dtype,
         multifault=multifault, interpret=interpret,

@@ -107,6 +107,11 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        # The C operand aliases the output: the beta*C epilogue reads each
+        # C tile in the same grid step that retires its output tile, so
+        # under jit XLA reuses the buffer instead of allocating and
+        # copying a second (M, N) HBM array (pinned in tests).
+        input_output_aliases={2: 0},
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),

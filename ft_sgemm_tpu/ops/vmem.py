@@ -45,33 +45,64 @@ from __future__ import annotations
 import dataclasses
 import warnings
 
-from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.configs import KernelShape, aug_rows
 
 MIB = 1024 * 1024
 
 # Per-variant temporary footprint, in accumulator-tile units (see module
 # docstring for the calibration provenance). "weighted" is the in-kernel
 # encode body; "weighted_precomp" the deferred-check body with the
-# precomputed expectations operand. "global" is UNCALIBRATED — no
-# global-strategy compile has landed in a hardware window's records yet,
-# so 6.0 is an interpolation (between plain and rowcol, matching its body
-# weight) with the usual safety margin, and its declared scratch really is
-# ~0 bytes (two SMEM scalars + a counter — no VMEM vectors). Recalibrate
-# against Mosaic's own number when a global compile lands in a window.
+# precomputed expectations operand; "rowcol_mxu"/"global_mxu" the
+# augmented-operand MXU-encode bodies (ops/ft_sgemm "Encode modes").
+#
+# "global" is UNCALIBRATED — no global-strategy compile has landed in a
+# hardware window's records yet, so 6.0 is an interpolation with the
+# usual safety margin, MEASURED-BOUNDED on both sides by the same
+# window's records: its body is strictly lighter than weighted's
+# (observed 9.9 — one scalar residual vs three (bn,) moment streams) and
+# strictly heavier than plain's (observed < 3.9 — it adds the panel-sum
+# reduction and the residual compare), so the true factor lies in
+# (3.9, 9.9) and 6.0 sits mid-interval; its declared scratch really is
+# ~0 VMEM bytes (two SMEM scalars + a counter, modeled below as SMEM).
+# Recalibrate against Mosaic's own number when a global compile lands in
+# a window. The MXU-encode variants are likewise uncalibrated:
+# "rowcol_mxu" takes rowcol's 7.0 + 1 for the augmented dot result slices
+# (temps already scale with a_rows * b_rows below); "global_mxu" global's
+# 6.0 + 1 for the corner-block slice.
 TEMP_TILE_FACTORS = {
     "plain": 3.0,
-    "global": 6.0,  # uncalibrated: no recorded Mosaic observation (above)
+    "global": 6.0,   # uncalibrated: bounded (3.9, 9.9) by the round-4
+                     # window's plain/weighted observations (above)
+    "global_mxu": 7.0,   # uncalibrated: global + augmented-dot slicing
     "rowcol": 7.0,
+    "rowcol_mxu": 8.0,   # uncalibrated: rowcol + augmented-dot slicing
     "fused": 9.0,
     "weighted_precomp": 9.0,
     "weighted": 11.0,
 }
 
+# SMEM scalar scratch per variant (bytes): counters and scalar residual
+# state. A different memory class than scoped VMEM, but Mosaic accounts
+# them against the kernel too — modeled so the "every declared scratch is
+# counted" claim holds for the scalar-only global variants as well
+# (ADVICE.md round 5).
+_SMEM_SCRATCH_BYTES = {
+    "plain": 0,
+    "global": 12,       # t_exp + prev (f32) + count (i32)
+    "global_mxu": 12,
+    "rowcol": 8,        # count + unc (i32)
+    "rowcol_mxu": 8,
+    "fused": 8,
+    "weighted_precomp": 4,
+    "weighted": 8,
+}
+
 
 def fused_aug_rows(in_itemsize: int) -> int:
-    """Sublane-aligned augmented-row count of the fused strategy (3 moment
-    rows for f32; 9 hi/lo/lo2 term rows for bf16 — ``_augment_a``)."""
-    return 8 if in_itemsize == 4 else 16
+    """Sublane-aligned augmented-row count for one operand's checksum rows
+    (kept as an alias of :func:`ft_sgemm_tpu.configs.aug_rows`, the
+    canonical home since the encode-mode axis made it family-wide)."""
+    return aug_rows(in_itemsize)
 
 
 def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
@@ -86,25 +117,29 @@ def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
             f"unknown kernel variant {variant!r}; pick from"
             f" {tuple(TEMP_TILE_FACTORS)}")
     bm, bn, bk = shape.block
-    aug = fused_aug_rows(in_itemsize) if variant == "fused" else 0
-    a_rows = bm + aug
+    aug = aug_rows(in_itemsize)
+    aug_a = aug if variant in ("fused", "rowcol_mxu", "global_mxu") else 0
+    aug_b = aug if variant in ("rowcol_mxu", "global_mxu") else 0
+    a_rows, b_rows, _ = shape.aug_block(aug_a, aug_b)
 
     buffers = 2 * a_rows * bk * in_itemsize     # A window
-    buffers += 2 * bn * bk * in_itemsize        # B window
+    buffers += 2 * b_rows * bk * in_itemsize    # B window
     buffers += 2 * bm * bn * 4                  # C operand window
     buffers += 2 * bm * bn * 4                  # output window
     if variant == "weighted_precomp":
         buffers += 2 * 8 * bn * 4               # expected-checksum window
 
-    scratch = 0
+    scratch = _SMEM_SCRATCH_BYTES[variant]
     if variant == "rowcol":
-        scratch = (bm + (2 if multifault else 1) * bn) * 4
+        scratch += (bm + (2 if multifault else 1) * bn) * 4
+    elif variant == "rowcol_mxu":
+        scratch += (bm * aug_b + aug_a * bn) * 4   # r_exp + c_exp
     elif variant == "weighted":
-        scratch = 3 * bn * 4
+        scratch += 3 * bn * 4
     elif variant == "fused":
-        scratch = aug * bn * 4
+        scratch += aug_a * bn * 4
 
-    temps = int(TEMP_TILE_FACTORS[variant] * a_rows * bn * 4)
+    temps = int(TEMP_TILE_FACTORS[variant] * a_rows * b_rows * 4)
     return buffers + scratch + temps
 
 

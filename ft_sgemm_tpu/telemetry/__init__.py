@@ -291,10 +291,12 @@ def _emit(event: FaultEvent) -> None:
         sink.write(event)
 
 
-def _series_labels(op, strategy, layer, device) -> dict:
+def _series_labels(op, strategy, layer, device, encode=None) -> dict:
     labels = {"op": op}
     if strategy:
         labels["strategy"] = strategy
+    if encode:
+        labels["encode"] = encode
     if layer:
         labels["layer"] = layer
     if device:
@@ -303,6 +305,7 @@ def _series_labels(op, strategy, layer, device) -> dict:
 
 
 def record_gemm(op: str, result, *, strategy: Optional[str] = None,
+                encode: Optional[str] = None,
                 step: Optional[int] = None, layer: Optional[str] = None,
                 device: Optional[str] = None, threshold=None,
                 operands=None, alpha: float = 1.0, beta: float = 0.0,
@@ -334,6 +337,9 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
             c_out, operands[0], operands[1],
             operands[2] if len(operands) > 2 else None,
             alpha=alpha, beta=beta)
+    if encode is not None:
+        extra = dict(extra or {})
+        extra["encode"] = encode
     event = FaultEvent(
         outcome=outcome, op=op, detected=det, corrected=corrected,
         uncorrectable=unc,
@@ -343,7 +349,7 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
         tiles=_nonzero_tiles(getattr(result, "detections", None)),
         extra=extra)
     reg = _STATE.registry
-    labels = _series_labels(op, strategy, layer, device)
+    labels = _series_labels(op, strategy, layer, device, encode)
     reg.counter("ft_calls", **labels).inc()
     reg.counter("ft_detections", **labels).inc(det)
     reg.counter("ft_corrected", **labels).inc(corrected)
@@ -355,6 +361,7 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
 
 
 def record_attention(op: str, result, *, strategy: Optional[str] = None,
+                     encode: Optional[str] = None,
                      step: Optional[int] = None,
                      layer: Optional[str] = None,
                      device: Optional[str] = None,
@@ -374,13 +381,15 @@ def record_attention(op: str, result, *, strategy: Optional[str] = None,
                "corrected" if det else "clean")
     merged = dict(extra or {})
     merged["softmax_flags"] = flags
+    if encode is not None:
+        merged["encode"] = encode
     event = FaultEvent(
         outcome=outcome, op=op, detected=det, corrected=det,
         uncorrectable=unc,
         step=_STATE.step if step is None else step,
         strategy=strategy, layer=layer, device=device, extra=merged)
     reg = _STATE.registry
-    labels = _series_labels(op, strategy, layer, device)
+    labels = _series_labels(op, strategy, layer, device, encode)
     reg.counter("ft_calls", **labels).inc()
     reg.counter("ft_detections", **labels).inc(det)
     reg.counter("ft_corrected", **labels).inc(det)

@@ -92,17 +92,20 @@ def override_disabled():
 
 
 def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
-                in_dtype, injection_enabled: bool) -> Optional[KernelShape]:
+                in_dtype, injection_enabled: bool,
+                encode: str = "vpu") -> Optional[KernelShape]:
     """The cached winning tile for one dispatch site, or None (heuristics).
 
     Pure host-side and cheap (one ``os.stat`` + dict probe in the steady
     state); returns None without touching anything when tuning is off, so
     the no-entry/disabled dispatch path is bit-for-bit the heuristic one.
+    ``encode`` is the checksum-encode mode the dispatch will run — a key
+    component since schema 2 (MXU-encode winners differ).
     """
     if not enabled():
         return None
     rec = cache.lookup(make_key(m, n, k, strategy=strategy,
-                                in_dtype=in_dtype,
+                                in_dtype=in_dtype, encode=encode,
                                 injection_enabled=injection_enabled))
     if rec is None:
         return None
@@ -114,6 +117,7 @@ def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
 def tune(
     m: int, n: Optional[int] = None, k: Optional[int] = None, *,
     strategy: Optional[str] = "weighted",
+    encode: str = "vpu",
     in_dtype: str = "float32",
     inject=False,
     method: Optional[str] = None,
@@ -132,7 +136,8 @@ def tune(
     static prune (nothing measured, nothing written). ``inject`` is False,
     True (a reference-like schedule), or an explicit ``InjectionSpec``.
     ``budget`` caps how many candidates are timed (best-guess-first order);
-    None times them all.
+    None times them all. ``encode`` is a searched dimension since schema
+    2: the same problem tunes (and caches) separately per encode mode.
     """
     from ft_sgemm_tpu.injection import InjectionSpec
 
@@ -140,14 +145,16 @@ def tune(
     k = m if k is None else k
     method = default_method() if method is None else method
     feasible, pruned = enumerate_space(m, n, k, strategy=strategy,
-                                       in_dtype=in_dtype)
+                                       encode=encode, in_dtype=in_dtype)
     key = make_key(m, n, k, strategy=strategy, in_dtype=in_dtype,
+                   encode=encode,
                    injection_enabled=bool(
                        inject.enabled if isinstance(inject, InjectionSpec)
                        else inject))
     report = {
         "problem": [m, n, k],
         "strategy": "plain" if strategy is None else strategy,
+        "encode": "vpu" if strategy is None else encode,
         "in_dtype": str(in_dtype),
         "method": method,
         "key": key,
@@ -178,8 +185,8 @@ def tune(
 
     with override_disabled():
         results = measure_space(
-            candidates, m, n, k, strategy=strategy, in_dtype=in_dtype,
-            inject=spec, method=method, budget=budget_n,
+            candidates, m, n, k, strategy=strategy, encode=encode,
+            in_dtype=in_dtype, inject=spec, method=method, budget=budget_n,
             alpha=alpha, beta=beta, reps=reps, samples=samples,
             progress=progress)
     best = best_result(results)

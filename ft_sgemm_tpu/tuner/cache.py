@@ -35,7 +35,12 @@ import threading
 import warnings
 from typing import Optional
 
-SCHEMA_VERSION = 1
+# Schema 2: the checksum-encode mode joined the cache key (``enc=vpu`` /
+# ``enc=mxu``) when ``encode`` became a searched dimension — schema-1
+# files carry keys that would silently collide the two encode modes'
+# winners, so they are ignored (with the standard warning) rather than
+# migrated.
+SCHEMA_VERSION = 2
 ENV_CACHE_PATH = "FT_SGEMM_TUNER_CACHE"
 _DEFAULT_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "ft_sgemm_tpu", "tuner_cache.json")
@@ -81,16 +86,24 @@ def mnk_bucket(m: int, n: int, k: int) -> tuple:
 
 
 def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
-             in_dtype, injection_enabled: bool,
+             in_dtype, injection_enabled: bool, encode: str = "vpu",
              device: Optional[str] = None) -> str:
-    """The canonical cache key for one dispatch site."""
+    """The canonical cache key for one dispatch site.
+
+    ``encode`` is the checksum-encode mode (``configs.ENCODE_MODES``) —
+    a first-class key component since the winning tile genuinely differs
+    between encodes (MXU encode trades VPU reductions for augmented tile
+    rows, shifting the VMEM/efficiency balance). The plain (non-FT)
+    kernel has no encode axis and always keys as ``vpu``.
+    """
     import jax.numpy as jnp
 
     bm, bn, bk = mnk_bucket(m, n, k)
     dev = device_kind() if device is None else device
     strat = "plain" if strategy is None else strategy
+    enc = "vpu" if strategy is None else encode
     return (f"{dev}|{bm}x{bn}x{bk}|{jnp.dtype(in_dtype).name}"
-            f"|{strat}|inj={int(bool(injection_enabled))}")
+            f"|{strat}|enc={enc}|inj={int(bool(injection_enabled))}")
 
 
 def _valid_block(block) -> bool:

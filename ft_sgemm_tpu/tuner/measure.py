@@ -64,7 +64,8 @@ def default_method() -> str:
 
 
 def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
-              inject, alpha: float, beta: float, interpret: Optional[bool]):
+              inject, alpha: float, beta: float, interpret: Optional[bool],
+              encode: str = "vpu"):
     """fn(a, b, c) -> array for one candidate, clean or injected."""
     from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
     from ft_sgemm_tpu.ops.sgemm import make_sgemm
@@ -73,7 +74,7 @@ def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
         return make_sgemm(shape, alpha=alpha, beta=beta, in_dtype=in_dtype,
                           interpret=interpret)
     ft = make_ft_sgemm(shape, alpha=alpha, beta=beta, strategy=strategy,
-                       in_dtype=in_dtype, interpret=interpret)
+                       encode=encode, in_dtype=in_dtype, interpret=interpret)
     return lambda a, b, c: ft(a, b, c, inject).c
 
 
@@ -100,6 +101,7 @@ def make_inputs(m: int, n: int, k: int, in_dtype: str = "float32"):
 def measure_candidate(
     shape: KernelShape, a, b, c, *,
     strategy: Optional[str] = "weighted",
+    encode: str = "vpu",
     in_dtype: str = "float32",
     inject=None,
     method: Optional[str] = None,
@@ -123,9 +125,9 @@ def measure_candidate(
     k = a.shape[1]
     interpret = True if method == "interpret" else None
     try:
-        fn = _build_fn(shape, strategy=strategy, in_dtype=in_dtype,
-                       inject=inject, alpha=alpha, beta=beta,
-                       interpret=interpret)
+        fn = _build_fn(shape, strategy=strategy, encode=encode,
+                       in_dtype=in_dtype, inject=inject, alpha=alpha,
+                       beta=beta, interpret=interpret)
         if method == "compile":
             args = (jax.ShapeDtypeStruct(a.shape, jnp.dtype(in_dtype)),
                     jax.ShapeDtypeStruct(b.shape, jnp.dtype(in_dtype)),
@@ -151,6 +153,7 @@ def measure_candidate(
 def measure_space(
     candidates: Sequence[KernelShape], m: int, n: int, k: int, *,
     strategy: Optional[str] = "weighted",
+    encode: str = "vpu",
     in_dtype: str = "float32",
     inject=None,
     method: Optional[str] = None,
@@ -175,14 +178,14 @@ def measure_space(
         for shape in picked:
             a, b, c = _inputs_memo(m, n, k, in_dtype)
             res = measure_candidate(
-                shape, a, b, c, strategy=strategy, in_dtype=in_dtype,
-                inject=inject, method=method, alpha=alpha, beta=beta,
-                reps=reps, samples=samples)
+                shape, a, b, c, strategy=strategy, encode=encode,
+                in_dtype=in_dtype, inject=inject, method=method,
+                alpha=alpha, beta=beta, reps=reps, samples=samples)
             results.append(res)
             if telemetry.enabled():
                 reg = telemetry.get_registry()
                 labels = dict(op="tuner", strategy=strat_label,
-                              method=method)
+                              encode=encode, method=method)
                 reg.counter("tuner_measurements", **labels).inc()
                 if not res.ok:
                     reg.counter("tuner_failures", **labels).inc()

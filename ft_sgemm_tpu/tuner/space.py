@@ -35,19 +35,25 @@ BN_MENU = (128, 256, 384, 512, 768, 1024, 1536, 2048)
 BK_MENU = (128, 256, 512, 1024, 2048)
 
 
-def variant_for(strategy: Optional[str], *, single_check: bool = True) -> str:
+def variant_for(strategy: Optional[str], *, single_check: bool = True,
+                encode: str = "vpu") -> str:
     """The :data:`~ft_sgemm_tpu.ops.vmem.TEMP_TILE_FACTORS` key a strategy's
     dispatch will actually run at the tuner's measurement settings.
 
-    Mirrors ``make_ft_sgemm``'s ``resolve_cadence`` decision: the weighted
-    strategy at its default single-final-check cadence runs the lighter
+    Mirrors ``make_ft_sgemm``'s resolution: ``encode`` maps through
+    ``resolve_kernel_strategy`` (the MXU-encode bodies have their own
+    footprints — augmented tiles cost VMEM), and the weighted strategy at
+    its default single-final-check VPU cadence runs the lighter
     precomputed-expectations body. ``None`` is the plain (non-FT) kernel.
     """
+    from ft_sgemm_tpu.ops.ft_sgemm import resolve_kernel_strategy
+
     if strategy is None:
         return "plain"
-    if strategy == "weighted" and single_check:
+    kernel_strategy = resolve_kernel_strategy(strategy, encode)
+    if kernel_strategy == "weighted" and single_check:
         return "weighted_precomp"
-    return strategy
+    return kernel_strategy
 
 
 def _round_up(v: int, mult: int) -> int:
@@ -82,6 +88,7 @@ def heuristic_shape(m: int, n: int, k: int, *, strategy: Optional[str],
 def enumerate_space(
     m: int, n: int, k: int, *,
     strategy: Optional[str] = "weighted",
+    encode: str = "vpu",
     in_dtype: str = "float32",
     limit: Optional[int] = None,
     bm_menu: Sequence[int] = BM_MENU,
@@ -111,7 +118,7 @@ def enumerate_space(
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(in_dtype).itemsize
-    variant = variant_for(strategy)
+    variant = variant_for(strategy, encode=encode)
     max_bm = _round_up(m, 128)
     max_bn = _round_up(n, 128)
     max_bk = _round_up(k, 128)

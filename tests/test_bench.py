@@ -416,6 +416,65 @@ def test_resumed_stages_suppressed_after_reset(tmp_path):
     assert "resumed_stages" not in payload["context"], payload["context"]
 
 
+def test_smoke_mode_runs_both_encodes_on_cpu(tmp_path):
+    """``--smoke``: the CI liveness check — one tiny size, both encode
+    modes, valid JSON, rc 0 — must run without a TPU (the CPU interpret
+    path) and without the supervisor machinery."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FT_SGEMM_TUNER_CACHE"] = str(tmp_path / "tuner_cache.json")
+    proc = subprocess.run([sys.executable, str(BENCH), "--smoke"], env=env,
+                          capture_output=True, text=True, timeout=240)
+    payload = _payload(proc)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert payload["metric"] == "bench_smoke"
+    assert payload["value"] == 1
+    modes = payload["context"]["encode_modes"]
+    assert set(modes) == {"vpu", "mxu"}
+    for mode, rec in modes.items():
+        assert rec["corrected_ok"], (mode, rec)
+        assert rec["detections"] > 0 and rec["uncorrectable"] == 0, (
+            mode, rec)
+
+
+def test_encode_comparison_context_from_partial_records(tmp_path):
+    """The VPU-vs-MXU comparison context assembles from whatever stage
+    records landed — including a partial sweep killed mid-run (here the
+    MXU weighted pair is missing entirely): the JSON stays valid and the
+    pairs that exist are reported."""
+    records = tmp_path / "records.jsonl"
+    records.write_text(
+        json.dumps({"name": "ft_headline", "ok": True,
+                    "value": {"gflops": 30000.0, "strategy": "weighted"}})
+        + "\n"
+        + json.dumps({"name": "ft_rowcol", "ok": True, "value": 25600.0})
+        + "\n"
+        + json.dumps({"name": "ft_rowcol_mxu", "ok": True,
+                      "value": 28100.0})
+        + "\n")
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="5",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="99"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    cmp_ctx = payload["context"]["encode_comparison"]
+    assert cmp_ctx["size"] == 4096
+    assert cmp_ctx["rowcol"] == {"vpu": 25600.0, "mxu": 28100.0}
+    # weighted pair: the ladder VPU number is present, the MXU (fused)
+    # stage never landed — the half that exists is still reported.
+    assert cmp_ctx["weighted"] == {"vpu": 30000.0}
+    assert payload["context"]["abft_rowcol_mxu_gflops"] == 28100.0
+
+
+def test_stage_budget_sizing():
+    """Per-stage wall budget (graceful early-stop): 1.5x the largest
+    completed stage, floored at the old 20 s guard, capped by
+    FT_SGEMM_BENCH_STAGE_MAX."""
+    bench = _load_bench()
+    assert bench._stage_need(1.0, 300.0) == 20.0      # floor
+    assert bench._stage_need(100.0, 300.0) == 150.0   # 1.5x estimate
+    assert bench._stage_need(1000.0, 300.0) == 300.0  # cap
+
+
 def test_code_version_paths_cover_worker_imports(tmp_path):
     """ADVICE r4: every repo-local module the worker imports must live
     under a CODE_VERSION_PATHS entry — a measurement-relevant module

@@ -1,0 +1,481 @@
+"""MXU-fused checksum encode (``encode="mxu"``) across the kernel family.
+
+Pins the encode axis's four contract points:
+
+1. **Default is untouched** — ``encode="vpu"`` (and not passing ``encode``
+   at all) lowers to BYTE-IDENTICAL HLO per strategy: the new axis changes
+   nothing unless selected (the tests/test_telemetry.py pinning
+   technique).
+2. **One dot per K step** — under ``encode="mxu"`` the whole lowered
+   module contains exactly ONE ``dot_general``: the expected checksums
+   ride the kernel's augmented dot, with no second encode dot anywhere
+   (the VPU weighted path, by contrast, shows its separate precompute
+   dot).
+3. **Correction parity** — injected single/multi faults are detected and
+   corrected at ``check_every in {1, 2, nk}`` for all four strategies, on
+   f32 and bf16 inputs, exactly as under the VPU encode; adversarial
+   same-column schedules are REPORTED, never silent.
+4. **C-operand aliasing** — the plain and FT pallas_calls alias the C
+   input to the f32 output (the ``beta != 0`` epilogue must not allocate
+   and copy a second HBM output buffer), pinned at the jaxpr-params level
+   since interpret-mode lowering rewrites the alias functionally.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import (
+    InjectionSpec,
+    make_ft_sgemm,
+    make_sgemm,
+    sgemm_reference,
+)
+from ft_sgemm_tpu.configs import ENCODE_MODES, KernelShape, aug_rows
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+STRATEGIES = ("rowcol", "global", "weighted", "fused")
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+def _lower(fn, a, b, c):
+    return jax.jit(lambda a, b, c: fn(a, b, c).c).lower(a, b, c).as_text()
+
+
+def _oracle(a, b, c, in_dtype):
+    if in_dtype == "float32":
+        return np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    return np.asarray(
+        sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="bfloat16"))
+
+
+# -- 1. default-path pin: encode="vpu" is byte-for-byte the default ----------
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "global", "weighted"])
+def test_default_encode_hlo_byte_identical(strategy, rng):
+    a, b, c = _inputs(256, 128, 512)
+    default = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy)
+    explicit = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                             encode="vpu")
+    assert _lower(default, a, b, c) == _lower(explicit, a, b, c), (
+        f"{strategy}: explicit encode='vpu' changed the default HLO")
+    mxu = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                        encode="mxu")
+    assert _lower(mxu, a, b, c) != _lower(default, a, b, c), (
+        f"{strategy}: encode='mxu' lowered to the VPU program — the axis"
+        " did nothing")
+
+
+def test_fused_strategy_is_weighted_mxu():
+    """``strategy="fused"`` and ``("weighted", encode="mxu")`` are one
+    program — the historical spelling and the axis spelling must never
+    drift apart."""
+    a, b, c = _inputs(256, 128, 512)
+    fused = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="fused")
+    wmxu = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="weighted",
+                         encode="mxu")
+    assert _lower(fused, a, b, c) == _lower(wmxu, a, b, c)
+    assert fused.encode == "mxu"
+
+
+def test_unknown_encode_rejected():
+    with pytest.raises(ValueError, match="encode"):
+        make_ft_sgemm(TILE, encode="warp")
+    assert "vpu" in ENCODE_MODES and "mxu" in ENCODE_MODES
+
+
+# -- 2. one dot_general per K step under encode="mxu" ------------------------
+
+
+@pytest.mark.parametrize("in_dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_mxu_encode_emits_exactly_one_dot(strategy, in_dtype):
+    """The whole lowered module holds ONE dot_general: the kernel's
+    augmented per-K-step dot. No VPU-encode elementwise streams, no
+    out-of-kernel precompute dot (the weighted VPU default shows 2)."""
+    a, b, c = _inputs(256, 128, 512)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                       encode="mxu", in_dtype=in_dtype)
+    txt = _lower(ft, a, b, c)
+    assert txt.count("stablehlo.dot_general") == 1, (
+        f"{strategy}/{in_dtype}: expected exactly one dot_general")
+    # The dot really is augmented: its lhs carries the checksum tail rows.
+    aug = aug_rows(4 if in_dtype == "float32" else 2)
+    assert f"tensor<{TILE.bm + aug}x{TILE.bk}x" in txt, (
+        f"{strategy}/{in_dtype}: no augmented ({TILE.bm + aug}, {TILE.bk})"
+        " A block in the lowered module")
+
+
+def test_weighted_vpu_precomp_has_separate_encode_dot():
+    """Contrast pin for the one-dot assertion: the VPU weighted default
+    precomputes expectations with a SECOND dot outside the kernel."""
+    a, b, c = _inputs(256, 128, 512)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="weighted")
+    assert _lower(ft, a, b, c).count("stablehlo.dot_general") == 2
+
+
+# -- 3. correction parity: cadence sweep x strategy x dtype ------------------
+
+
+@pytest.mark.parametrize("in_dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("check_every", [1, 2, 4])  # 4 == nk at k=512
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_mxu_cadence_sweep_multi_fault(strategy, check_every, in_dtype):
+    """Dense injection (every=1: nk faults, multiple per interval at
+    coarse cadences) under encode="mxu": correcting strategies restore
+    the oracle exactly and report zero uncorrectable; the detect-only
+    global strategy counts every fault and reports all uncorrected."""
+    m = n = 128
+    k = 512  # nk = 4 at bk=128
+    a, b, c = _inputs(m, n, k, seed=7)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                       encode="mxu", check_every=check_every,
+                       in_dtype=in_dtype)
+    res = ft(a, b, c, inject=inj)
+    want = _oracle(a, b, c, in_dtype)
+    if strategy == "global":
+        # Event semantics (FtSgemmResult): same-interval faults collapse
+        # into one event, so every=1 yields one event per CHECK.
+        assert int(res.num_detected) == -(-4 // check_every)
+        assert int(res.num_uncorrectable) == int(res.num_detected)
+        return
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, (f"{strategy}/mxu/ce={check_every}/{in_dtype}: {nbad}"
+                " corrupted elements survived")
+    assert int(res.num_detected) == 4
+    assert int(res.num_uncorrectable) == 0
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "weighted"])
+def test_mxu_single_fault_corrected(strategy):
+    """One fault per run (every = nk): the single-fault baseline cell."""
+    m = n = 128
+    k = 512
+    a, b, c = _inputs(m, n, k, seed=9)
+    inj = InjectionSpec(enabled=True, every=4, magnitude=10000.0)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                       encode="mxu")
+    res = ft(a, b, c, inject=inj)
+    want = _oracle(a, b, c, "float32")
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{strategy}/mxu single fault: {nbad} corrupted"
+    assert int(res.num_detected) == 1
+    assert int(res.num_uncorrectable) == 0
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "weighted"])
+def test_mxu_same_column_faults_reported_not_silent(strategy):
+    """The adversarial col_stride=0 schedule (multiple faults in ONE
+    column per interval) defeats per-column localization under either
+    encode — the MXU re-check must report it exactly like the VPU one."""
+    a, b, c = _inputs(128, 128, 512, seed=8)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                        col_stride=0)
+    kw = dict(check_every=4) if strategy == "weighted" else {}
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                       encode="mxu", **kw)
+    res = ft(a, b, c, inject=inj)
+    want = _oracle(a, b, c, "float32")
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    if not ok:
+        assert int(res.num_uncorrectable) > 0, (
+            f"{strategy}/mxu: {nbad} corrupted elements with NO report —"
+            " silent corruption")
+
+
+def test_mxu_rectangular_with_padding_and_injection():
+    a, b, c = _inputs(300, 200, 520, seed=13)
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="rowcol",
+                       encode="mxu")
+    res = ft(a, b, c, inject=inj)
+    want = _oracle(a, b, c, "float32")
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"mxu/rect: {nbad} corrupted elements survived"
+    assert int(res.num_detected) > 0
+    assert int(res.num_uncorrectable) == 0
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "global"])
+def test_mxu_auto_threshold_catches_tiny_faults(strategy):
+    """Adaptive thresholds compose with the MXU encode: magnitude-5
+    faults (5 orders under the reference 9500) are caught."""
+    a, b, c = _inputs(128, 128, 512, seed=17)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    res = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                        encode="mxu", threshold="auto")(a, b, c, inject=inj)
+    if strategy == "global":
+        assert int(res.num_detected) == 4
+        return
+    want = _oracle(a, b, c, "float32")
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} tiny faults survived auto threshold under mxu"
+    assert int(res.num_detected) == 4
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_mxu_clean_runs_report_zero(rng):
+    for strategy in ("rowcol", "global", "weighted"):
+        res = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                            encode="mxu")(*_inputs(256, 128, 512, seed=2))
+        assert int(res.num_detected) == 0, strategy
+        assert int(res.num_uncorrectable) == 0, strategy
+
+
+def test_attention_mxu_encode_matches_reference(rng):
+    """The protected QK/PV paths accept the encode axis; clean outputs
+    match the XLA oracle and injected faults are corrected in-kernel."""
+    from ft_sgemm_tpu.ops.attention import (
+        attention_reference, make_ft_attention)
+
+    q = rng.standard_normal((128, 64)).astype(np.float32)
+    k = rng.standard_normal((128, 64)).astype(np.float32)
+    v = rng.standard_normal((128, 64)).astype(np.float32)
+    attn = make_ft_attention(encode="mxu")
+    assert attn.encode == "mxu"
+    res = attn(q, k, v)
+    want = np.asarray(attention_reference(q, k, v))
+    np.testing.assert_allclose(np.asarray(res.out), want, atol=2e-4)
+    assert int(res.detections) == 0
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res_inj = attn(q, k, v, inject=inj)
+    np.testing.assert_allclose(np.asarray(res_inj.out), want, atol=2e-2)
+    assert int(res_inj.detections) > 0
+    assert int(res_inj.uncorrectable) == 0
+
+
+# -- 4. C-operand aliasing (beta != 0 epilogue reuses the buffer) ------------
+
+
+def _pallas_call_params(jaxpr):
+    """Every pallas_call eqn's params in a (possibly nested) jaxpr."""
+    found = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            found.append(eqn.params)
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                found.extend(_pallas_call_params(inner))
+    return found
+
+
+def _alias_pairs(params):
+    alias = params.get("input_output_aliases")
+    return tuple(tuple(p) for p in alias) if alias else ()
+
+
+def test_ft_c_operand_aliases_output(rng):
+    a, b, c = _inputs(256, 128, 512)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA)
+    jaxpr = jax.make_jaxpr(lambda a, b, c: ft(a, b, c).c)(a, b, c)
+    (params,) = _pallas_call_params(jaxpr.jaxpr)
+    # Operand order (inj, a, b, c): the C input aliases f32 output 0, so
+    # the beta*C epilogue never allocates a second (M, N) HBM buffer.
+    assert _alias_pairs(params) == ((3, 0),), params.get(
+        "input_output_aliases")
+
+
+def test_plain_c_operand_aliases_output(rng):
+    a, b, c = _inputs(256, 128, 512)
+    plain = make_sgemm(TILE, alpha=ALPHA, beta=BETA)
+    jaxpr = jax.make_jaxpr(plain)(a, b, c)
+    (params,) = _pallas_call_params(jaxpr.jaxpr)
+    assert _alias_pairs(params) == ((2, 0),), params.get(
+        "input_output_aliases")
+
+
+def test_aliased_epilogue_still_reads_original_c(rng):
+    """Semantics pin for the alias: the epilogue's beta*C must see the
+    ORIGINAL C values (the kernel reads each C tile before its output
+    tile retires), including under an outer jit where XLA may truly
+    reuse the buffer."""
+    a, b, c = _inputs(256, 256, 512, seed=3)
+    plain = make_sgemm(TILE, alpha=ALPHA, beta=BETA)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    got = np.asarray(jax.jit(plain)(a, b, c))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+# -- cost model: FT kernels report honest flops/bytes ------------------------
+
+
+def test_gemm_cost_estimate_ft_terms():
+    from ft_sgemm_tpu.ops.common import gemm_cost_estimate
+
+    m = n = k = 1024
+    block = (128, 128, 128)
+    plain = gemm_cost_estimate(m, n, k, 4)
+    assert plain.flops == 2 * m * n * k  # the original 4-arg form
+    vpu = gemm_cost_estimate(m, n, k, 4, block=block, strategy="rowcol",
+                             multifault=True, check_every=1)
+    mxu = gemm_cost_estimate(m, n, k, 4, block=block, strategy="rowcol_mxu",
+                             multifault=True, check_every=1)
+    for est in (vpu, mxu):
+        assert est.flops > plain.flops, "encode/check flops missing"
+        assert est.bytes_accessed >= plain.bytes_accessed
+    # MXU-encode augments the operands: its extra HBM bytes must show.
+    assert mxu.bytes_accessed > plain.bytes_accessed
+    # Coarser cadence -> fewer detect/correct epilogues -> fewer flops.
+    sparse = gemm_cost_estimate(m, n, k, 4, block=block, strategy="rowcol",
+                                multifault=True, check_every=8)
+    assert sparse.flops < vpu.flops
+    # The precomp body has no in-kernel encode streams.
+    precomp = gemm_cost_estimate(m, n, k, 4, block=block,
+                                 strategy="weighted", check_every=None)
+    inkernel = gemm_cost_estimate(m, n, k, 4, block=block,
+                                  strategy="weighted", check_every=2)
+    assert precomp.flops < inkernel.flops
+
+
+# -- vmem model + configs: the new variants are first-class ------------------
+
+
+def test_vmem_model_covers_mxu_variants():
+    from ft_sgemm_tpu.ops.vmem import estimate_vmem_bytes
+
+    base = estimate_vmem_bytes(TILE, "rowcol")
+    mxu = estimate_vmem_bytes(TILE, "rowcol_mxu")
+    assert mxu > base, "augmented tiles must cost VMEM in the model"
+    gbase = estimate_vmem_bytes(TILE, "global")
+    gmxu = estimate_vmem_bytes(TILE, "global_mxu")
+    assert gmxu > gbase
+    # bf16 halves the input itemsize but doubles the augmented rows.
+    assert estimate_vmem_bytes(TILE, "rowcol_mxu", in_itemsize=2) > 0
+
+
+def test_aug_block_legality():
+    assert TILE.aug_block(8, 8) == (136, 136, 128)
+    assert TILE.aug_block() == (128, 128, 128)
+    with pytest.raises(ValueError, match="aug_a"):
+        TILE.aug_block(3, 0)
+    with pytest.raises(ValueError, match="aug_b"):
+        TILE.aug_block(0, -8)
+    assert aug_rows(4) == 8 and aug_rows(2) == 16
+
+
+def test_fit_block_to_vmem_handles_mxu_variant():
+    from ft_sgemm_tpu.ops.vmem import MIB, fit_block_to_vmem
+
+    big = dataclasses.replace(TILE, bm=1024, bn=1024, bk=2048)
+    with pytest.warns(UserWarning, match="auto-shrunk"):
+        fitted = fit_block_to_vmem(big, "rowcol_mxu", limit=64 * MIB,
+                                   allow_shrink=True)
+    assert fitted.block != big.block
+
+
+# -- tuner: encode is a searched, cached, schema-bumped dimension ------------
+
+
+def test_tuner_key_separates_encode_modes(tmp_path, monkeypatch):
+    from ft_sgemm_tpu import tuner
+
+    kws = dict(strategy="rowcol", in_dtype="float32",
+               injection_enabled=False)
+    assert (tuner.make_key(256, 256, 256, encode="vpu", **kws)
+            != tuner.make_key(256, 256, 256, encode="mxu", **kws))
+    # The plain kernel has no encode axis: both spellings share a key.
+    assert (tuner.make_key(256, 256, 256, strategy=None, encode="mxu",
+                           in_dtype="float32", injection_enabled=False)
+            == tuner.make_key(256, 256, 256, strategy=None, encode="vpu",
+                              in_dtype="float32", injection_enabled=False))
+
+
+def test_tuner_variant_maps_encode_to_kernel_bodies():
+    from ft_sgemm_tpu.tuner.space import variant_for
+
+    assert variant_for("rowcol", encode="mxu") == "rowcol_mxu"
+    assert variant_for("global", encode="mxu") == "global_mxu"
+    assert variant_for("weighted", encode="mxu") == "fused"
+    assert variant_for("weighted", encode="vpu") == "weighted_precomp"
+    assert variant_for("rowcol", encode="vpu") == "rowcol"
+    assert variant_for(None) == "plain"
+
+
+def test_schema1_cache_ignored_after_bump(tmp_path, monkeypatch):
+    """Pre-encode-axis cache files (schema 1) would collide the two
+    encode modes' winners under one key: the bumped loader must ignore
+    them (with the standard warning), falling back to heuristics."""
+    import json
+    import warnings
+
+    from ft_sgemm_tpu.tuner import cache as tcache
+
+    path = tmp_path / "old_schema.json"
+    path.write_text(json.dumps(
+        {"schema": 1, "entries": {
+            "cpu|256x256x256|float32|weighted|inj=0": {
+                "block": [128, 128, 128]}}}))
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    try:
+        with pytest.warns(UserWarning, match="schema"):
+            assert tcache.load_entries() == {}
+    finally:
+        tcache.clear_memo()
+
+
+def test_tune_mxu_persists_and_dispatch_uses_it(tmp_path, monkeypatch):
+    from ft_sgemm_tpu import tuner
+    from ft_sgemm_tpu.tuner import cache as tcache
+
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH,
+                       str(tmp_path / "tuner_cache.json"))
+    tcache.clear_memo()
+    try:
+        report = tuner.tune(128, strategy="rowcol", encode="mxu", budget=1,
+                            reps=1, samples=1, method="interpret")
+        assert report["best"] is not None
+        assert report["encode"] == "mxu"
+        assert "enc=mxu" in report["key"]
+        tile = tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 encode="mxu", in_dtype="float32",
+                                 injection_enabled=False)
+        assert tile is not None
+        assert tile.block == tuple(report["best"]["block"])
+        # The other encode's key stays a miss: no cross-mode bleed.
+        assert tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 encode="vpu", in_dtype="float32",
+                                 injection_enabled=False) is None
+    finally:
+        tcache.clear_memo()
+
+
+# -- telemetry: per-encode-mode counters -------------------------------------
+
+
+def test_telemetry_counters_keyed_by_encode(rng, tmp_path):
+    from ft_sgemm_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.configure(tmp_path / "enc.jsonl")
+    try:
+        a, b, c = _inputs(128, 128, 256, seed=4)
+        inj = InjectionSpec(enabled=True, every=1)
+        for enc in ("vpu", "mxu"):
+            ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA,
+                               strategy="rowcol", encode=enc)
+            ft(a, b, c, inject=inj)
+        reg = telemetry.get_registry()
+        assert reg.total("ft_calls", encode="vpu") == 1
+        assert reg.total("ft_calls", encode="mxu") == 1
+        assert reg.total("ft_detections", encode="mxu") > 0
+        telemetry.disable()
+        events = list(telemetry.read_events(tmp_path / "enc.jsonl"))
+        assert {e.extra["encode"] for e in events} == {"vpu", "mxu"}
+    finally:
+        telemetry.reset()

@@ -259,10 +259,28 @@ def test_explicit_shape_is_never_shrunk_end_to_end(monkeypatch, rng):
 
 def test_factors_cover_every_strategy():
     """Every wrapper-level strategy (plus the plain kernel and the precomp
-    body) has a calibrated factor — a new strategy must add one."""
+    body) has a calibrated factor — a new strategy must add one. Since
+    the encode axis, every (strategy, encode) kernel-level resolution
+    must be covered too."""
     import ft_sgemm_tpu.ops.ft_sgemm as mod
 
     for strategy in mod.STRATEGIES:
         assert strategy in TEMP_TILE_FACTORS
+        for encode in ("vpu", "mxu"):
+            assert mod.resolve_kernel_strategy(
+                strategy, encode) in TEMP_TILE_FACTORS, (strategy, encode)
     assert "plain" in TEMP_TILE_FACTORS
     assert "weighted_precomp" in TEMP_TILE_FACTORS
+
+
+def test_every_shipped_config_fits_default_budget_mxu_variants():
+    """The MXU-encode bodies (augmented A AND B tiles) must also clear
+    the 64 MiB budget at every shipped named shape x dtype."""
+    for name in SHAPE_ORDER:
+        for itemsize, dtype in ((4, "float32"), (2, "bfloat16")):
+            shape = shape_for_dtype(SHAPES[name], True, dtype)
+            for variant in ("rowcol_mxu", "global_mxu"):
+                est = estimate_vmem_bytes(shape, variant,
+                                          in_itemsize=itemsize)
+                assert est <= VMEM_LIMIT_BYTES, (
+                    name, variant, dtype, est / MIB)
