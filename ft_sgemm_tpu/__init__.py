@@ -23,7 +23,7 @@ become tile-axis reductions, shared-memory double buffering becomes Mosaic's
 automatically pipelined VMEM blocks.
 """
 
-from ft_sgemm_tpu import telemetry, tuner, utils
+from ft_sgemm_tpu import perf, telemetry, tuner, utils
 from ft_sgemm_tpu.configs import (
     KernelShape,
     SHAPES,
@@ -79,6 +79,7 @@ __all__ = [
     "make_ft_attention_diff",
     "ft_matmul",
     "make_ft_matmul",
+    "perf",
     "telemetry",
     "tuner",
 ]

@@ -28,12 +28,27 @@ Usage:
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
         [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
         [--encode=vpu|mxu] [--telemetry=LOG.jsonl]
-    python -m ft_sgemm_tpu.cli telemetry LOG.jsonl
+    python -m ft_sgemm_tpu.cli telemetry LOG.jsonl [--format=text|prom]
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
         [--encode=vpu|mxu] [--dtype=...] [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
         [--dry-run]
     python -m ft_sgemm_tpu.cli tune-show
+    python -m ft_sgemm_tpu.cli report ARTIFACT.json [--format=md|json]
+    python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
+        [--tolerance=0.10] [--format=text|json]
+
+``report`` renders the RunReport a bench artifact embeds
+(``ft_sgemm_tpu.perf``): the environment manifest (device, jax/jaxlib,
+git rev, tuner cache hits, fault counters) and the per-stage roofline
+table — seconds, GFLOP/s, arithmetic intensity, %-of-peak compute and
+HBM bandwidth, compute/memory-bound verdict, and the ABFT-overhead
+fraction of each stage's FLOPs. ``bench-compare`` is the noise-aware A/B
+gate over two artifacts: per-stage improvement / within-noise /
+regression / incomparable verdicts under a relative tolerance; exit 0
+means no regression (incomparable stages are listed, never fatal),
+nonzero means a measured regression — what CI runs against the committed
+smoke baseline.
 
 ``tune`` runs the autotuner (``ft_sgemm_tpu.tuner``): enumerate the legal
 tile space for the problem, prune candidates the VMEM footprint model
@@ -324,16 +339,29 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
     return results
 
 
-def run_telemetry_summary(log_path: str, out=None) -> int:
-    """``telemetry`` subcommand: summarize a fault-event JSONL log."""
+def run_telemetry_summary(log_path: str, out=None,
+                          fmt: str = "text") -> int:
+    """``telemetry`` subcommand: summarize a fault-event JSONL log.
+
+    ``fmt="text"`` prints the human summary (totals, per-op/per-layer
+    tables, residual histogram + p50/p95/max percentiles);
+    ``fmt="prom"`` rebuilds a metrics registry from the events and
+    exports it in the Prometheus text exposition format — pipe it to a
+    node-exporter textfile collector or a pushgateway.
+    """
     from ft_sgemm_tpu.telemetry import (
-        format_summary, read_events, summarize_events)
+        format_summary, read_events, registry_from_events,
+        summarize_events, to_prometheus)
 
     # Resolve stdout at CALL time (a def-time default would pin whatever
     # object sys.stdout was at import — stale under test capture or any
     # caller that swaps streams).
     out = sys.stdout if out is None else out
     try:
+        if fmt == "prom":
+            reg = registry_from_events(read_events(log_path))
+            out.write(to_prometheus(reg.collect()))
+            return 0
         summary = summarize_events(read_events(log_path))
     except OSError as e:
         print(f"ft_sgemm: cannot read telemetry log: {e}", file=sys.stderr)
@@ -341,6 +369,74 @@ def run_telemetry_summary(log_path: str, out=None) -> int:
     print(f"telemetry summary of {log_path}", file=out)
     print(format_summary(summary), file=out)
     return 0
+
+
+def run_report(artifact_path: str, out=None, fmt: str = "md") -> int:
+    """``report`` subcommand: render a bench artifact's embedded
+    RunReport (``ft_sgemm_tpu.perf.report``).
+
+    ``--format=md`` (default) renders markdown; ``--format=json``
+    re-emits the report dict pretty-printed. Exit 2 on an unreadable
+    artifact, 1 when the artifact carries no RunReport (an old or null
+    artifact — CI's report step treats that as a failed observability
+    contract), 0 otherwise.
+    """
+    import json as _json
+
+    from ft_sgemm_tpu.perf import compare as perf_compare
+    from ft_sgemm_tpu.perf import from_artifact
+
+    out = sys.stdout if out is None else out
+    try:
+        artifact = perf_compare.load_artifact(artifact_path)
+    except (OSError, ValueError) as e:
+        print(f"ft_sgemm: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    rr = from_artifact(artifact)
+    if rr is None:
+        print(f"ft_sgemm: {artifact_path} carries no run_report "
+              "(null or pre-perf-subsystem artifact); metric="
+              f"{artifact.get('metric')!r} value={artifact.get('value')!r}",
+              file=sys.stderr)
+        return 1
+    if fmt == "json":
+        print(_json.dumps(rr.to_dict(), indent=1, sort_keys=True),
+              file=out)
+    else:
+        print(rr.to_markdown(), file=out)
+    return 0
+
+
+def run_bench_compare(baseline_path: str, candidate_path: str, out=None,
+                      tolerance: Optional[float] = None,
+                      fmt: str = "text") -> int:
+    """``bench-compare`` subcommand: the noise-aware A/B perf gate.
+
+    Exit 0 = no regression (within-noise / improved / incomparable-only),
+    1 = at least one stage regressed beyond the tolerance, 2 = an
+    artifact could not be read. ``--tolerance=0.10`` is the relative
+    band; CI uses a loose one on CPU where smoke timings are noisy.
+    """
+    import json as _json
+
+    from ft_sgemm_tpu.perf import compare as perf_compare
+
+    out = sys.stdout if out is None else out
+    try:
+        a = perf_compare.load_artifact(baseline_path)
+        b = perf_compare.load_artifact(candidate_path)
+    except (OSError, ValueError) as e:
+        print(f"ft_sgemm: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    tol = perf_compare.DEFAULT_TOLERANCE if tolerance is None else tolerance
+    result = perf_compare.compare(a, b, tolerance=tol)
+    if fmt == "json":
+        print(_json.dumps(result, indent=1, sort_keys=True), file=out)
+    else:
+        print(f"baseline:  {baseline_path}", file=out)
+        print(f"candidate: {candidate_path}", file=out)
+        print(perf_compare.format_comparison(result), file=out)
+    return perf_compare.exit_code(result)
 
 
 def run_tune(args, flags, out=None) -> int:
@@ -490,7 +586,53 @@ def main(argv=None) -> int:
         if len(args) < 2:
             print(__doc__)
             return 2
-        return run_telemetry_summary(args[1])
+        fmt = "text"
+        for f in flags:
+            if f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("text", "prom"):
+                    print(f"--format must be text or prom, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        return run_telemetry_summary(args[1], fmt=fmt)
+    if args and args[0] == "report":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        fmt = "md"
+        for f in flags:
+            if f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("md", "json"):
+                    print(f"--format must be md or json, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        return run_report(args[1], fmt=fmt)
+    if args and args[0] == "bench-compare":
+        if len(args) < 3:
+            print(__doc__)
+            return 2
+        tolerance = None
+        fmt = "text"
+        for f in flags:
+            if f.startswith("--tolerance="):
+                try:
+                    tolerance = float(f.split("=", 1)[1])
+                except ValueError:
+                    print(f"--tolerance must be a float, got {f!r}",
+                          file=sys.stderr)
+                    return 2
+                if tolerance < 0:
+                    print("--tolerance must be >= 0", file=sys.stderr)
+                    return 2
+            elif f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("text", "json"):
+                    print(f"--format must be text or json, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        return run_bench_compare(args[1], args[2], tolerance=tolerance,
+                                 fmt=fmt)
     if len(args) < 5:
         print(__doc__)
         return 2

@@ -111,6 +111,14 @@ def _sow_counts(module, pairs):
         record(f"nn.{type(module).__name__}", counts, layer=layer)
 
 
+def _summed_counts(res):
+    """(name, scalar) count pairs for an attention result — per-call
+    totals of the vmapped per-head counters."""
+    return (("detections", jnp.sum(res.detections)),
+            ("softmax_flags", jnp.sum(res.softmax_flags)),
+            ("uncorrectable", jnp.sum(res.uncorrectable)))
+
+
 class FtDense(nn.Module):
     """``nn.Dense`` with every GEMM ABFT-protected.
 
@@ -285,9 +293,7 @@ class FtSelfAttention(nn.Module):
         axes = (0, 0, 0) + (() if bwd_sink is None else (None,))
         res = jax.vmap(jax.vmap(attn, in_axes=axes), in_axes=axes)(*args)
 
-        _sow_counts(self, (("detections", jnp.sum(res.detections)),
-                           ("softmax_flags", jnp.sum(res.softmax_flags)),
-                           ("uncorrectable", jnp.sum(res.uncorrectable))))
+        _sow_counts(self, _summed_counts(res))
 
         out = res.out.transpose(0, 2, 1, 3).reshape(
             *batch_shape, length, qkv)
@@ -361,9 +367,7 @@ class FtRingSelfAttention(nn.Module):
         axes = (0, 0, 0) + (() if bwd_sink is None else (None,))
         res = jax.vmap(attn, in_axes=axes)(*args)
 
-        _sow_counts(self, (("detections", jnp.sum(res.detections)),
-                           ("softmax_flags", jnp.sum(res.softmax_flags)),
-                           ("uncorrectable", jnp.sum(res.uncorrectable))))
+        _sow_counts(self, _summed_counts(res))
 
         out = jnp.moveaxis(res.out, 0, 1).reshape(length, qkv)
         return FtDense(out_feat, name="out", **dense_kw)(out, bwd_sink)

@@ -47,6 +47,46 @@ def _register_barrier_batching() -> None:
 
 _register_barrier_batching()
 
+
+def _register_float0_reduce_jvp() -> None:
+    """Make ``reduce_sum``'s JVP tolerate instantiated float0 tangents.
+
+    The FT results carry integer fault counters; under ``jax.grad``
+    their tracers hold float0 ("void") tangents, which this jax's
+    custom_vjp machinery INSTANTIATES as real arrays when the call sits
+    inside a ``lax.scan`` body (flax ``nn.scan`` stacks — the
+    ``FtTransformer`` composition). ``jnp.sum`` over such a counter then
+    binds ``reduce_sum`` on the float0 tangent and raises "does not
+    accept dtype void". The wrapper answers a float0 tangent with a
+    symbolic Zero (the mathematically correct tangent of an integer
+    reduction) and defers every other case to the original rule, so
+    current-jax behavior is untouched.
+    """
+    try:
+        from jax._src import ad_util, core, dtypes
+        from jax._src.lax import lax as _lax_src
+        from jax.interpreters import ad
+
+        prim = getattr(_lax_src, "reduce_sum_p", None)
+        orig = ad.primitive_jvps.get(prim)
+        if prim is None or orig is None:
+            return
+
+        def rule(primals, tangents, **params):
+            t = tangents[0]
+            if getattr(core.get_aval(t), "dtype", None) == dtypes.float0:
+                out = prim.bind(primals[0], **params)
+                return out, ad_util.Zero(
+                    core.get_aval(out).at_least_vspace())
+            return orig(primals, tangents, **params)
+
+        ad.primitive_jvps[prim] = rule
+    except Exception:  # noqa: BLE001 — unpatchable jax: grads raise as before
+        pass
+
+
+_register_float0_reduce_jvp()
+
 # Calibrated constants of the clean-residual noise model — single source
 # for the numpy estimator (analysis.estimate_noise_floor, where the
 # calibration story is documented) and the traced one below.
@@ -133,15 +173,19 @@ def dtype_suffix(in_dtype) -> str:
     return "" if dt == jnp.float32 else f"_{dt.name}"
 
 
-def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
-                       block=None, strategy=None, multifault: bool = False,
-                       check_every=None):
-    """FLOPs / bytes for one ``C = alpha*A@B.T + beta*C`` pass: A and B at
-    their input width, C read+written in f32.
+def gemm_cost_breakdown(m: int, n: int, k: int, in_itemsize: int, *,
+                        block=None, strategy=None, multifault: bool = False,
+                        check_every=None) -> dict:
+    """Component-wise FLOPs / bytes of one ``C = alpha*A@B.T + beta*C``
+    pass: the plain GEMM (``base``) plus, for FT kernels, the
+    checksum-``encode`` work and the detect/correct ``check`` epilogue.
 
-    The FT kernels pass ``block``/``strategy``/``multifault``/
-    ``check_every`` so the estimate covers what the plain model ignores —
-    Mosaic's scheduler must see honest costs for FT kernels:
+    Returns ``{"flops_base", "flops_encode", "flops_check", "bytes_base",
+    "bytes_encode", "bytes_check"}`` — the decomposition the perf
+    subsystem's roofline rows report as the ABFT-overhead fraction
+    (:mod:`ft_sgemm_tpu.perf.roofline`); :func:`gemm_cost_estimate` sums
+    it into the ``pl.CostEstimate`` Mosaic's scheduler sees, so the two
+    views can never drift apart.
 
     - **Checksum-encode flops.** VPU encode (``rowcol``/``global``/
       ``weighted``) re-reduces each operand block once per grid step, so
@@ -161,13 +205,11 @@ def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
 
     ``strategy`` takes the KERNEL-level value (``resolve_kernel_strategy``
     — ``weighted`` with ``check_every >= nk`` is costed as the precomp
-    body). Plain callers keep the original 4-argument form and the
-    original numbers.
+    body). Plain callers (``strategy=None``) get zero encode/check terms.
     """
-    import jax.experimental.pallas as pl
-
-    flops = 2 * m * n * k
-    bytes_accessed = in_itemsize * (m * k + n * k) + 4 * 2 * m * n
+    flops_base = 2 * m * n * k
+    bytes_base = in_itemsize * (m * k + n * k) + 4 * 2 * m * n
+    flops_encode = flops_check = bytes_encode = bytes_check = 0
     if strategy is not None:
         from ft_sgemm_tpu.configs import aug_rows
 
@@ -183,16 +225,16 @@ def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
             aug_b = aug if strategy in ("rowcol_mxu", "global_mxu") else 0
             # Widened dot rows ride the MXU; the wrapper's one-time moment
             # reduction costs ~2 flops per operand element per moment row.
-            flops += 2 * k * (aug_a * n + aug_b * m)
-            flops += 2 * (aug_a * m * k // max(bm, 1)
-                          + aug_b * n * k // max(bn, 1))
-            bytes_accessed += in_itemsize * k * (
+            flops_encode += 2 * k * (aug_a * n + aug_b * m)
+            flops_encode += 2 * (aug_a * m * k // max(bm, 1)
+                                 + aug_b * n * k // max(bn, 1))
+            bytes_encode += in_itemsize * k * (
                 aug_a * (m // bm) + aug_b * (n // bn))
         elif precomp:
             # Expected checksums via one stacked XLA dot OUTSIDE the
             # kernel; in-kernel extra cost is only the (8, bn) expected-
             # checksum operand window per tile.
-            bytes_accessed += 4 * 8 * (m // bm) * n
+            bytes_encode += 4 * 8 * (m // bm) * n
         else:
             # VPU encode streams per grid step: s_a/s_b reductions plus
             # one elementwise multiply-reduce per expected-checksum
@@ -201,18 +243,42 @@ def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
             streams_a = {"rowcol": 2 if multifault else 1,
                          "global": 1, "weighted": 3}[strategy]
             streams_b = 1
-            flops += 3 * k * (streams_a * n + streams_b * m)
+            flops_encode += 3 * k * (streams_a * n + streams_b * m)
         # Detect/correct epilogue: per check, ~2 flops per accumulator
         # element per residual stream (reduce + masked correct/re-check).
         streams = {"rowcol": 3 if multifault else 2, "rowcol_mxu": 3,
                    "global": 1, "global_mxu": 1,
                    "weighted": 3, "fused": 3}.get(strategy, 2)
-        flops += 2 * streams * m * n * n_checks
+        flops_check += 2 * streams * m * n * n_checks
         # det/unc counter outputs.
-        bytes_accessed += 2 * 4 * (m // bm) * (n // bn)
+        bytes_check += 2 * 4 * (m // bm) * (n // bn)
+    return {"flops_base": int(flops_base),
+            "flops_encode": int(flops_encode),
+            "flops_check": int(flops_check),
+            "bytes_base": int(bytes_base),
+            "bytes_encode": int(bytes_encode),
+            "bytes_check": int(bytes_check)}
+
+
+def gemm_cost_estimate(m: int, n: int, k: int, in_itemsize: int, *,
+                       block=None, strategy=None, multifault: bool = False,
+                       check_every=None):
+    """FLOPs / bytes for one ``C = alpha*A@B.T + beta*C`` pass: A and B at
+    their input width, C read+written in f32 — the summed view of
+    :func:`gemm_cost_breakdown` as the ``pl.CostEstimate`` every
+    ``pallas_call`` in the package hands Mosaic's scheduler. Plain
+    callers keep the original 4-argument form and the original numbers.
+    """
+    import jax.experimental.pallas as pl
+
+    parts = gemm_cost_breakdown(
+        m, n, k, in_itemsize, block=block, strategy=strategy,
+        multifault=multifault, check_every=check_every)
     return pl.CostEstimate(
-        flops=int(flops),
-        bytes_accessed=int(bytes_accessed),
+        flops=(parts["flops_base"] + parts["flops_encode"]
+               + parts["flops_check"]),
+        bytes_accessed=(parts["bytes_base"] + parts["bytes_encode"]
+                        + parts["bytes_check"]),
         transcendentals=0,
     )
 

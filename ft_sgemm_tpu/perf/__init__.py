@@ -1,0 +1,74 @@
+"""Performance-observability subsystem: roofline run-reports,
+compiled-HLO cost introspection, and noise-aware bench comparison.
+
+PR 1 made *faults* observable (counters, events, spans); this package
+makes *performance* observable — every bench run self-reports how close
+each stage ran to the hardware roofline, what the compiler built, and
+whether a candidate artifact regressed against a baseline:
+
+- :mod:`.roofline` — device peak specs (TPU v4/v5e/v5p/v6e + CPU
+  fallback) and per-stage utilization summaries with the ABFT-overhead
+  decomposition. Pure Python, no jax.
+- :mod:`.hlo` — lower/compile a jitted callable once and record
+  ``cost_analysis()`` / ``memory_analysis()`` / HLO op counts (guarded
+  per backend) into the telemetry registry as ``compile.*`` / ``hlo.*``.
+- :mod:`.report` — the :class:`~ft_sgemm_tpu.perf.report.RunReport`
+  manifest a bench artifact embeds (device, versions, git rev, tuner
+  cache hits, fault counters, roofline rows), JSON + markdown.
+- :mod:`.compare` — A/B artifact comparison under a relative-delta
+  tolerance: improvement / within-noise / regression / incomparable
+  verdicts and the CI exit-code contract. Pure Python, no jax.
+
+Importing this package never imports jax (the bench supervisor's
+constraint); modules that need it import lazily inside functions.
+
+CLI: ``python -m ft_sgemm_tpu.cli report ARTIFACT.json`` and
+``python -m ft_sgemm_tpu.cli bench-compare A.json B.json``.
+"""
+
+from __future__ import annotations
+
+from ft_sgemm_tpu.perf import compare, hlo, report, roofline
+from ft_sgemm_tpu.perf.compare import (
+    DEFAULT_TOLERANCE,
+    VERDICTS,
+    exit_code,
+    extract_stages,
+    format_comparison,
+    load_artifact,
+)
+from ft_sgemm_tpu.perf.report import (
+    RunReport,
+    build_manifest,
+    from_artifact,
+    stage_row,
+)
+from ft_sgemm_tpu.perf.roofline import (
+    DEVICE_SPECS,
+    DeviceSpec,
+    abft_fractions,
+    find_spec,
+    roofline_summary,
+)
+
+__all__ = [
+    "DEFAULT_TOLERANCE",
+    "DEVICE_SPECS",
+    "DeviceSpec",
+    "RunReport",
+    "VERDICTS",
+    "abft_fractions",
+    "build_manifest",
+    "compare",
+    "exit_code",
+    "extract_stages",
+    "find_spec",
+    "format_comparison",
+    "from_artifact",
+    "hlo",
+    "load_artifact",
+    "report",
+    "roofline",
+    "roofline_summary",
+    "stage_row",
+]

@@ -1,0 +1,191 @@
+"""Noise-aware A/B comparison of two bench artifacts — the CI perf gate.
+
+Two runs of the same benchmark never produce identical numbers; the
+question a gate must answer is whether B is *meaningfully* slower than A.
+This module extracts every comparable measurement from a pair of bench
+artifacts (the one-line JSON ``bench.py`` emits — headline value, context
+GFLOPS rows, smoke per-encode seconds, and the embedded RunReport's
+per-stage roofline rows), compares each under a relative-delta tolerance,
+and returns structured verdicts:
+
+- ``improvement`` / ``regression`` — the delta exceeds the tolerance in
+  the stage's goodness direction (GFLOPS up is good, seconds down is
+  good);
+- ``within_noise`` — the delta is inside the tolerance band;
+- ``incomparable`` — the stage is missing or null on either side. Never
+  an exception: a half-dead artifact (the exact thing a regression gate
+  exists to catch early) still produces a readable report, and
+  incomparability alone never fails the build (a MISSING baseline is a
+  setup problem, not a perf regression — the gate's exit code only
+  reflects measured regressions).
+
+Exit-code contract (:func:`exit_code`): 0 = no regression (identical,
+within-noise, improved, or merely incomparable), 1 = at least one
+regression verdict, 2 = an artifact could not be read at all.
+
+Pure stdlib — usable from any process, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+DEFAULT_TOLERANCE = 0.10
+
+VERDICT_IMPROVEMENT = "improvement"
+VERDICT_WITHIN_NOISE = "within_noise"
+VERDICT_REGRESSION = "regression"
+VERDICT_INCOMPARABLE = "incomparable"
+VERDICTS = (VERDICT_IMPROVEMENT, VERDICT_WITHIN_NOISE,
+            VERDICT_REGRESSION, VERDICT_INCOMPARABLE)
+
+
+def load_artifact(path: str) -> dict:
+    """Read one bench artifact: the LAST parseable JSON-object line of the
+    file (bench prints exactly one; logs may precede it), or the whole
+    file as JSON. A driver wrapper document (``{"parsed": {...}}``) is
+    unwrapped. Raises ``ValueError``/``OSError`` on an unreadable file —
+    the CLI maps those to exit code 2."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: no JSON object found")
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    return doc
+
+
+def _stage(value, higher_is_better: bool) -> Optional[dict]:
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return None
+    return {"value": float(value), "higher_is_better": higher_is_better}
+
+
+def extract_stages(artifact: dict) -> dict:
+    """Every comparable measurement of one artifact, keyed by stage name.
+
+    Each entry is ``{"value": float, "higher_is_better": bool}``; null /
+    missing / non-numeric measurements simply don't appear (the compare
+    step reports them ``incomparable``)."""
+    stages = {}
+    ctx = artifact.get("context") or {}
+
+    metric = artifact.get("metric") or "value"
+    s = _stage(artifact.get("value"), higher_is_better=True)
+    if s and metric != "bench_smoke":
+        # The smoke headline is a 0/1 ok flag, not a measurement.
+        stages[metric] = s
+
+    for key, v in ctx.items():
+        if key.endswith("_gflops"):
+            s = _stage(v, higher_is_better=True)
+            if s:
+                stages[key] = s
+    tuned = ctx.get("abft_tuned")
+    if isinstance(tuned, dict):
+        s = _stage(tuned.get("gflops"), higher_is_better=True)
+        if s:
+            stages["abft_tuned_gflops"] = s
+
+    modes = ctx.get("encode_modes")
+    if isinstance(modes, dict):
+        for enc, rec in modes.items():
+            if isinstance(rec, dict):
+                s = _stage(rec.get("seconds"), higher_is_better=False)
+                if s:
+                    stages[f"smoke_encode[{enc}].seconds"] = s
+
+    rr = ctx.get("run_report")
+    if isinstance(rr, dict):
+        for row in rr.get("stages") or []:
+            if not isinstance(row, dict) or not row.get("name"):
+                continue
+            s = _stage(row.get("seconds"), higher_is_better=False)
+            if s:
+                stages[f"stage[{row['name']}].seconds"] = s
+    return stages
+
+
+def compare(a: dict, b: dict, *,
+            tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Compare artifact ``b`` (candidate) against ``a`` (baseline).
+
+    Returns ``{"tolerance", "stages": [...], "counts": {verdict: n},
+    "regressions": [names]}``; each stage row carries both values, the
+    relative delta in the GOODNESS direction (positive = better), and
+    the verdict."""
+    sa, sb = extract_stages(a), extract_stages(b)
+    rows = []
+    counts = {v: 0 for v in VERDICTS}
+    for name in sorted(set(sa) | set(sb)):
+        ra, rb = sa.get(name), sb.get(name)
+        row = {"stage": name,
+               "baseline": ra["value"] if ra else None,
+               "candidate": rb["value"] if rb else None,
+               "delta": None}
+        if ra is None or rb is None or ra["value"] == 0:
+            row["verdict"] = VERDICT_INCOMPARABLE
+            row["reason"] = ("missing in candidate" if rb is None
+                            else "missing in baseline" if ra is None
+                            else "zero baseline")
+        else:
+            d = (rb["value"] - ra["value"]) / abs(ra["value"])
+            if not ra["higher_is_better"]:
+                d = -d
+            row["delta"] = d
+            row["verdict"] = (VERDICT_WITHIN_NOISE if abs(d) <= tolerance
+                              else VERDICT_IMPROVEMENT if d > 0
+                              else VERDICT_REGRESSION)
+        counts[row["verdict"]] += 1
+        rows.append(row)
+    return {"tolerance": tolerance, "stages": rows, "counts": counts,
+            "regressions": [r["stage"] for r in rows
+                            if r["verdict"] == VERDICT_REGRESSION]}
+
+
+def exit_code(result: dict) -> int:
+    """0 = no regression verdicts; 1 = at least one."""
+    return 1 if result["counts"][VERDICT_REGRESSION] else 0
+
+
+def format_comparison(result: dict) -> str:
+    """Human rendering of one :func:`compare` result."""
+    lines = [f"bench-compare (tolerance ±{100 * result['tolerance']:.0f}% "
+             "relative)"]
+    width = max((len(r["stage"]) for r in result["stages"]), default=5)
+    for r in result["stages"]:
+        def num(v):
+            return "—" if v is None else f"{v:.6g}"
+
+        delta = ("" if r["delta"] is None
+                 else f"  {100 * r['delta']:+.1f}%")
+        reason = f"  ({r['reason']})" if r.get("reason") else ""
+        lines.append(f"  {r['stage']:<{width}}  {num(r['baseline']):>12} "
+                     f"-> {num(r['candidate']):>12}  "
+                     f"{r['verdict']}{delta}{reason}")
+    c = result["counts"]
+    lines.append("verdicts: " + "  ".join(
+        f"{k}={c[k]}" for k in VERDICTS if c[k]))
+    if not result["stages"]:
+        lines.append("no comparable stages found in either artifact")
+    return "\n".join(lines)
+
+
+__all__ = ["DEFAULT_TOLERANCE", "VERDICTS", "VERDICT_IMPROVEMENT",
+           "VERDICT_INCOMPARABLE", "VERDICT_REGRESSION",
+           "VERDICT_WITHIN_NOISE", "compare", "exit_code",
+           "extract_stages", "format_comparison", "load_artifact"]

@@ -1,0 +1,182 @@
+"""Compiled-artifact introspection: what XLA actually built.
+
+Measured GFLOPS say how fast a kernel ran; the compiled artifact says
+what the compiler did to it — how long compilation took, what XLA's own
+cost model thinks the executable costs, how much device memory it
+reserves, and how many ``dot``/``fusion``/``custom-call`` ops survived
+optimization (the MXU-encode work of PR 3 is pinned to "exactly one
+dot_general" at the jaxpr level; this module gives the same visibility
+post-XLA). One :func:`introspect_jitted` call lowers + compiles the
+callable once and returns a plain dict; when the telemetry subsystem is
+enabled the numbers also land in the PR-1 metrics registry as
+``compile.*`` and ``hlo.*`` gauge series.
+
+Both ``cost_analysis()`` and ``memory_analysis()`` are best-effort per
+backend (the CPU backend of some jaxlib builds returns nothing, TPU-ish
+backends raise ``NotImplementedError`` through a tunnel): every probe is
+guarded, a missing analysis is reported by name under ``unavailable``,
+and the rest of the dict still fills in — graceful degradation, never an
+exception out of an observability path.
+
+jax is imported lazily inside the functions so merely importing
+:mod:`ft_sgemm_tpu.perf` stays jax-free (the bench supervisor's
+constraint).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+# cost_analysis returns a large property map on some backends; only the
+# stable, scalar, cross-backend-meaningful keys are kept.
+_COST_KEYS = ("flops", "transcendentals", "bytes accessed",
+              "optimal_seconds", "utilization operand 0 {}",
+              "utilization operand 1 {}")
+
+_MEM_ATTRS = ("generated_code_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "temp_size_in_bytes",
+              "alias_size_in_bytes", "host_generated_code_size_in_bytes",
+              "host_argument_size_in_bytes", "host_output_size_in_bytes",
+              "host_temp_size_in_bytes")
+
+
+def _normalize_cost(cost) -> Optional[dict]:
+    """cost_analysis() shapes vary by jax version: a dict, a list of
+    per-computation dicts, or None. Normalize to one flat float dict."""
+    if cost is None:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+        if cost is None:
+            return None
+    if not isinstance(cost, dict):
+        return None
+    out = {}
+    for key in _COST_KEYS:
+        v = cost.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out or None
+
+
+def _normalize_memory(mem) -> Optional[dict]:
+    if mem is None:
+        return None
+    out = {}
+    for attr in _MEM_ATTRS:
+        v = getattr(mem, attr, None)
+        if isinstance(v, (int, float)):
+            out[attr] = int(v)
+    return out or None
+
+
+def hlo_op_counts(hlo_text: str) -> dict:
+    """Optimized-HLO op census: the fusion/dot/custom-call shape of the
+    executable. Counts instruction definitions (``= <shape> op(...)``),
+    not free-text mentions."""
+    def count(op):
+        return len(re.findall(rf"= \S+ {op}\(", hlo_text))
+
+    return {
+        "dot_general": count("dot") + count("dot_general"),
+        "fusion": count("fusion"),
+        "custom_call": count("custom-call"),
+        "while": count("while"),
+        "all_reduce": count("all-reduce"),
+    }
+
+
+def introspect_jitted(fn, *args, label: str = "jit",
+                      registry=None, **jit_kwargs) -> dict:
+    """Lower + compile ``fn(*args)`` once and report the artifact's facts.
+
+    ``fn`` may be a plain callable (jitted here) or anything with a
+    ``.lower(*args)`` (an existing ``jax.jit`` wrapper). ``args`` may be
+    real arrays or ``jax.ShapeDtypeStruct``s — nothing is executed, so
+    the probe costs one compile and no device run.
+
+    Returns ``{"label", "lower_seconds", "compile_seconds",
+    "cost_analysis", "memory_analysis", "hlo_counts", "unavailable"}``
+    where each analysis is None (and named in ``unavailable`` with the
+    reason) when the backend does not provide it. When ``registry`` is
+    given — or telemetry is enabled — the scalars are mirrored into it
+    as ``compile.*`` / ``hlo.*`` series labeled ``stage=<label>``.
+    """
+    import jax
+
+    out = {
+        "label": label,
+        "lower_seconds": None,
+        "compile_seconds": None,
+        "cost_analysis": None,
+        "memory_analysis": None,
+        "hlo_counts": None,
+        "unavailable": {},
+    }
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn, **jit_kwargs)
+    try:
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args)
+        out["lower_seconds"] = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001 — observability must not raise
+        out["unavailable"]["lower"] = f"{type(e).__name__}: {e}"
+        return out
+    try:
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        out["compile_seconds"] = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001
+        out["unavailable"]["compile"] = f"{type(e).__name__}: {e}"
+        return out
+
+    for probe, normalize in (("cost_analysis", _normalize_cost),
+                             ("memory_analysis", _normalize_memory)):
+        try:
+            out[probe] = normalize(getattr(compiled, probe)())
+            if out[probe] is None:
+                out["unavailable"][probe] = "backend returned no data"
+        except Exception as e:  # noqa: BLE001 — per-backend best effort
+            out["unavailable"][probe] = f"{type(e).__name__}: {e}"
+    try:
+        out["hlo_counts"] = hlo_op_counts(compiled.as_text())
+    except Exception as e:  # noqa: BLE001
+        out["unavailable"]["hlo_text"] = f"{type(e).__name__}: {e}"
+
+    _record(out, registry)
+    return out
+
+
+def _record(result: dict, registry) -> None:
+    """Mirror one introspection into the telemetry registry (explicit
+    registry, or the active one when telemetry is enabled; otherwise a
+    no-op — the subsystem's zero-overhead-off convention)."""
+    if registry is None:
+        from ft_sgemm_tpu import telemetry
+
+        if not telemetry.enabled():
+            return
+        registry = telemetry.get_registry()
+    label = result.get("label") or "jit"
+    for key in ("lower_seconds", "compile_seconds"):
+        v = result.get(key)
+        if v is not None:
+            registry.gauge(f"compile.{key}", stage=label).set(v)
+    cost = result.get("cost_analysis") or {}
+    for key, series in (("flops", "hlo.flops"),
+                        ("bytes accessed", "hlo.bytes_accessed")):
+        if key in cost:
+            registry.gauge(series, stage=label).set(cost[key])
+    mem = result.get("memory_analysis") or {}
+    for attr in ("generated_code_size_in_bytes", "temp_size_in_bytes",
+                 "argument_size_in_bytes", "output_size_in_bytes"):
+        if attr in mem:
+            registry.gauge(f"hlo.{attr}", stage=label).set(mem[attr])
+    counts = result.get("hlo_counts") or {}
+    for op, v in counts.items():
+        registry.gauge(f"hlo.{op}_count", stage=label).set(v)
+
+
+__all__ = ["hlo_op_counts", "introspect_jitted"]

@@ -1,0 +1,271 @@
+"""RunReport: the self-describing manifest a bench artifact embeds.
+
+A GFLOPS number without its context is unreviewable: which chip, which
+jax, which code revision, did the tuner cache serve or miss, did any
+fault go uncorrectable, and how close did each stage run to the
+hardware roofline. :class:`RunReport` packages exactly that — an
+environment manifest plus per-stage roofline rows
+(:func:`~ft_sgemm_tpu.perf.roofline.roofline_summary`) — serializes to
+JSON (round-trippable, schema-tagged) and renders to markdown for humans
+(``python -m ft_sgemm_tpu.cli report ARTIFACT.json``).
+
+:func:`build_manifest` degrades gracefully fact by fact: no git, no jax,
+no telemetry — each contributes ``None`` rather than an exception, so a
+manifest is constructible from any process state (including the bench
+supervisor, which never imports jax: every jax touch here is lazy and
+guarded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform as _platform
+import subprocess
+import time
+from typing import List, Optional
+
+SCHEMA_VERSION = 1
+
+
+def _git_rev(cwd: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=10)
+        rev = out.stdout.strip()
+        if out.returncode != 0 or not rev:
+            return None
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd or None, capture_output=True, text=True, timeout=10)
+        if dirty.returncode == 0 and dirty.stdout.strip():
+            rev += "-dirty"
+        return rev
+    except Exception:  # noqa: BLE001 — no git is a valid environment
+        return None
+
+
+def _jax_facts() -> dict:
+    facts = {"jax_version": None, "jaxlib_version": None,
+             "backend": None, "device_kind": None, "num_devices": None}
+    try:
+        import jax
+
+        facts["jax_version"] = jax.__version__
+    except Exception:  # noqa: BLE001
+        return facts
+    try:
+        import jaxlib
+
+        facts["jaxlib_version"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        devs = jax.devices()
+        facts["backend"] = jax.default_backend()
+        facts["device_kind"] = getattr(devs[0], "device_kind",
+                                       devs[0].platform)
+        facts["num_devices"] = len(devs)
+    except RuntimeError:
+        # Backend init failure: version facts stand, device facts are
+        # honestly absent (the bench fallback path records its own).
+        pass
+    return facts
+
+
+def _tuner_stats() -> Optional[dict]:
+    try:
+        from ft_sgemm_tpu import tuner
+
+        return dict(tuner.lookup_stats())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _fault_counters() -> Optional[dict]:
+    try:
+        from ft_sgemm_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        return {"calls": reg.total("ft_calls"),
+                "detections": reg.total("ft_detections"),
+                "corrected": reg.total("ft_corrected"),
+                "uncorrectable": reg.total("ft_uncorrectable")}
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def build_manifest(*, device_kind: Optional[str] = None,
+                   platform: Optional[str] = None,
+                   extra: Optional[dict] = None,
+                   probe_jax: bool = True) -> dict:
+    """Collect the run's environment facts, each one guarded.
+
+    ``device_kind``/``platform`` override the live-probed values (the
+    bench supervisor passes what the worker recorded; ``probe_jax=False``
+    skips the live probe entirely for jax-free processes).
+    """
+    facts = _jax_facts() if probe_jax else {
+        "jax_version": None, "jaxlib_version": None, "backend": None,
+        "device_kind": None, "num_devices": None}
+    if device_kind is not None:
+        facts["device_kind"] = device_kind
+    if platform is not None:
+        facts["backend"] = platform
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "created_unix": time.time(),
+        "host_platform": _platform.platform(),
+        "python_version": _platform.python_version(),
+        "git_rev": _git_rev(),
+        **facts,
+        "tuner_cache": _tuner_stats(),
+        "fault_counters": _fault_counters(),
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def stage_row(name: str, seconds: Optional[float], *, m: int, n: int,
+              k: int, in_itemsize: int = 4, dtype: str = "float32",
+              block=None, strategy: Optional[str] = None,
+              encode: str = "vpu", check_every=None,
+              multifault: bool = False,
+              device_kind: Optional[str] = None) -> dict:
+    """One measured stage -> one roofline row.
+
+    Resolves the user-facing ``(strategy, encode)`` pair to the kernel
+    body that actually ran (``resolve_kernel_strategy`` — weighted+mxu is
+    the fused body) so the cost decomposition matches the executed
+    kernel. Imports the ops layer lazily: only callers that BUILD rows
+    need jax; readers/renderers never do.
+    """
+    from ft_sgemm_tpu.ops.common import gemm_cost_breakdown
+    from ft_sgemm_tpu.perf.roofline import roofline_summary
+
+    kernel_strategy = None
+    if strategy is not None:
+        from ft_sgemm_tpu.ops.ft_sgemm import resolve_kernel_strategy
+
+        kernel_strategy = resolve_kernel_strategy(strategy, encode)
+    parts = gemm_cost_breakdown(m, n, k, in_itemsize, block=block,
+                                strategy=kernel_strategy,
+                                multifault=multifault,
+                                check_every=check_every)
+    row = roofline_summary(
+        flops=(parts["flops_base"] + parts["flops_encode"]
+               + parts["flops_check"]),
+        bytes_accessed=(parts["bytes_base"] + parts["bytes_encode"]
+                        + parts["bytes_check"]),
+        seconds=seconds, device_kind=device_kind, dtype=dtype,
+        breakdown=parts, name=name)
+    row["problem"] = [int(m), int(n), int(k)]
+    if strategy is not None:
+        row["strategy"] = strategy
+        row["encode"] = encode
+    return row
+
+
+@dataclasses.dataclass
+class RunReport:
+    """The manifest + per-stage roofline rows of one bench run."""
+
+    manifest: dict
+    stages: List[dict] = dataclasses.field(default_factory=list)
+    schema: int = SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return {"schema": self.schema, "manifest": self.manifest,
+                "stages": self.stages}
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RunReport":
+        if not isinstance(d, dict) or "manifest" not in d:
+            raise ValueError("not a RunReport dict (no 'manifest')")
+        return RunReport(manifest=dict(d["manifest"]),
+                         stages=list(d.get("stages") or []),
+                         schema=int(d.get("schema", SCHEMA_VERSION)))
+
+    @staticmethod
+    def from_json(text: str) -> "RunReport":
+        return RunReport.from_dict(json.loads(text))
+
+    def to_markdown(self) -> str:
+        """Human rendering: manifest facts, then the roofline table."""
+        md = ["# Run report", "", "## Environment", ""]
+        order = ("device_kind", "backend", "num_devices", "jax_version",
+                 "jaxlib_version", "git_rev", "python_version",
+                 "host_platform", "platform_requested", "platform_used",
+                 "fallback_reason")
+        seen = set(order)
+        for key in order:
+            if self.manifest.get(key) is not None:
+                md.append(f"- **{key}**: {self.manifest[key]}")
+        for key in sorted(self.manifest):
+            v = self.manifest[key]
+            if key in seen or key in ("schema", "stages") or v is None:
+                continue
+            if isinstance(v, dict):
+                inner = ", ".join(f"{ik}={iv}" for ik, iv in
+                                  sorted(v.items()))
+                md.append(f"- **{key}**: {inner}")
+            else:
+                md.append(f"- **{key}**: {v}")
+        if self.stages:
+            md += ["", "## Roofline", ""]
+            md.append("| stage | seconds | GFLOP/s | AI (flops/B) | "
+                      "% peak compute | % peak HBM | bound | ABFT "
+                      "overhead |")
+            md.append("|---|---|---|---|---|---|---|---|")
+            for row in self.stages:
+                est = "~" if row.get("spec_estimated") else ""
+
+                def pct(v, est=est):
+                    return "—" if v is None else f"{est}{100 * v:.1f}%"
+
+                def num(v, fmt="{:.4g}"):
+                    return "—" if v is None else fmt.format(v)
+
+                md.append(
+                    "| {name} | {sec} | {gf} | {ai} | {pc} | {pb} | {bd} "
+                    "| {ov} |".format(
+                        name=row.get("name") or "?",
+                        sec=num(row.get("seconds")),
+                        gf=num(row.get("gflops"), "{:.1f}"),
+                        ai=num(row.get("arithmetic_intensity"), "{:.1f}"),
+                        pc=pct(row.get("pct_peak_compute")),
+                        pb=pct(row.get("pct_peak_bandwidth")),
+                        bd=row.get("bound") or "—",
+                        ov=pct(row.get("abft_fraction"), est="")))
+            dev = self.stages[0].get("device")
+            if dev:
+                note = (" (estimated placeholder spec)"
+                        if self.stages[0].get("spec_estimated") else "")
+                md.append("")
+                md.append(f"Peaks from the `{dev}` spec entry{note}; "
+                          "`AI` is arithmetic intensity, `ABFT overhead` "
+                          "the checksum encode+check share of the "
+                          "stage's FLOPs.")
+        return "\n".join(md)
+
+
+def from_artifact(artifact: dict) -> Optional[RunReport]:
+    """The RunReport embedded in a bench artifact (under
+    ``context.run_report``), or None."""
+    try:
+        d = artifact.get("context", {}).get("run_report")
+        return None if d is None else RunReport.from_dict(d)
+    except (AttributeError, ValueError, TypeError):
+        return None
+
+
+__all__ = ["RunReport", "SCHEMA_VERSION", "build_manifest",
+           "from_artifact", "stage_row"]

@@ -1,0 +1,201 @@
+"""Roofline model: device peak specs + per-stage utilization summaries.
+
+The source paper's entire argument is a GFLOPS table — "ABFT is free" is
+a claim about distance from the hardware ceiling (arXiv:2305.01024) — and
+TPU linear-algebra studies characterize kernels the same way: achieved
+FLOP/s as a fraction of peak MXU throughput and achieved bytes/s as a
+fraction of peak HBM bandwidth (arXiv:2112.09017). This module turns one
+measured ``(cost estimate, seconds)`` pair into that characterization:
+
+- arithmetic intensity (FLOPs per HBM byte) against the device's ridge
+  point, yielding a compute-bound / memory-bound verdict;
+- %-of-peak-compute and %-of-peak-bandwidth;
+- the ABFT overhead decomposition — what fraction of the stage's FLOPs
+  are checksum encode and detect/correct work rather than the GEMM
+  itself (:func:`ft_sgemm_tpu.ops.common.gemm_cost_breakdown`).
+
+Everything here is pure host-side Python over plain numbers — no jax
+import, so the bench SUPERVISOR (which must never import jax; see
+``bench.py``) and offline artifact tooling can use it freely.
+
+Spec provenance: per-chip figures from Google's public Cloud TPU system
+documentation (bf16 peak FLOP/s and HBM bandwidth per chip). f32 peak is
+DERIVED as bf16/6: XLA's highest-precision f32 dot decomposes each
+operand into bf16 limbs and runs a 6-pass MXU schedule, and the repo's
+measured v5e ratio agrees (RESULTS.md: f32 xla_dot ~32 TF vs bf16
+~190 TF ≈ 1/6). The CPU entry is an order-of-magnitude placeholder
+(``estimated=True``) so %-of-peak on a dev box reads as a rough shape,
+never a calibrated claim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+# f32 MXU throughput = bf16 / F32_DERATE (6-pass bf16-limb decomposition
+# of highest-precision f32 dots; matches measured v5e f32/bf16 ~ 1/6).
+F32_DERATE = 6.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Peak throughput of one device class.
+
+    ``peak_flops`` maps dtype name -> FLOP/s; ``hbm_bytes_per_s`` is the
+    per-chip HBM bandwidth. ``estimated`` marks entries whose numbers are
+    placeholders rather than published spec (the CPU fallback) — renderers
+    annotate their percentages with ``~``.
+    """
+
+    name: str
+    peak_flops: Mapping[str, float]
+    hbm_bytes_per_s: float
+    source: str
+    estimated: bool = False
+
+    def peak_for(self, dtype: str) -> Optional[float]:
+        return self.peak_flops.get(str(dtype))
+
+    def ridge_point(self, dtype: str) -> Optional[float]:
+        """FLOPs/byte above which this device is compute-bound."""
+        peak = self.peak_for(dtype)
+        if peak is None or self.hbm_bytes_per_s <= 0:
+            return None
+        return peak / self.hbm_bytes_per_s
+
+
+def _tpu(name: str, bf16_tflops: float, hbm_gbps: float,
+         source: str) -> DeviceSpec:
+    bf16 = bf16_tflops * 1e12
+    return DeviceSpec(
+        name=name,
+        peak_flops={"bfloat16": bf16, "float32": bf16 / F32_DERATE},
+        hbm_bytes_per_s=hbm_gbps * 1e9,
+        source=source,
+    )
+
+
+# Per-chip peaks (Cloud TPU system architecture docs; bandwidth in GB/s).
+DEVICE_SPECS = (
+    _tpu("TPU v4", 275.0, 1228.0, "cloud.google.com/tpu v4: 275 TFLOPS "
+         "bf16, 1228 GB/s HBM2 per chip"),
+    _tpu("TPU v5e", 197.0, 819.0, "cloud.google.com/tpu v5e: 197 TFLOPS "
+         "bf16, 819 GB/s HBM2 per chip"),
+    _tpu("TPU v5p", 459.0, 2765.0, "cloud.google.com/tpu v5p: 459 TFLOPS "
+         "bf16, 2765 GB/s HBM2e per chip"),
+    _tpu("TPU v6e", 918.0, 1640.0, "cloud.google.com/tpu v6e (Trillium): "
+         "918 TFLOPS bf16, 1640 GB/s HBM per chip"),
+    DeviceSpec(
+        name="cpu",
+        peak_flops={"float32": 1e11, "bfloat16": 1e11},
+        hbm_bytes_per_s=5e10,
+        source="order-of-magnitude placeholder for a dev-box CPU "
+               "(~100 GFLOP/s, ~50 GB/s); utilization numbers on CPU are "
+               "shape, not spec",
+        estimated=True,
+    ),
+)
+
+# device_kind normalization: jax reports e.g. "TPU v4", "TPU v5 lite"
+# (v5e), "TPU v5p", "TPU v6 lite" / "TPU v6e" (Trillium). Ordered: the
+# first matching alias wins, so "v5p" is tested before the bare "v5".
+_ALIASES = (
+    ("v6", "TPU v6e"),
+    ("trillium", "TPU v6e"),
+    ("v5p", "TPU v5p"),
+    ("v5 lite", "TPU v5e"),
+    ("v5e", "TPU v5e"),
+    ("v5", "TPU v5e"),  # bare "v5 litepod" style strings: the lite class
+    ("v4", "TPU v4"),
+)
+
+
+def find_spec(device_kind: Optional[str]) -> DeviceSpec:
+    """The :class:`DeviceSpec` for a jax ``device_kind`` string.
+
+    Unknown / absent kinds fall back to the estimated CPU entry — a
+    roofline row is always renderable, and ``estimated`` keeps the
+    fallback honest.
+    """
+    kind = (device_kind or "").lower()
+    by_name = {s.name: s for s in DEVICE_SPECS}
+    if "tpu" in kind or kind.startswith("v"):
+        for needle, name in _ALIASES:
+            if needle in kind:
+                return by_name[name]
+    return by_name["cpu"]
+
+
+def abft_fractions(breakdown: Mapping[str, int]) -> dict:
+    """The ABFT overhead decomposition of one
+    :func:`~ft_sgemm_tpu.ops.common.gemm_cost_breakdown` dict: encode,
+    detect/correct, and total overhead FLOPs as fractions of the stage's
+    total FLOPs (0.0 for a plain kernel)."""
+    total = (breakdown["flops_base"] + breakdown["flops_encode"]
+             + breakdown["flops_check"])
+    if total <= 0:
+        return {"encode_fraction": 0.0, "check_fraction": 0.0,
+                "abft_fraction": 0.0}
+    enc = breakdown["flops_encode"] / total
+    chk = breakdown["flops_check"] / total
+    return {"encode_fraction": enc, "check_fraction": chk,
+            "abft_fraction": enc + chk}
+
+
+def roofline_summary(*, flops: float, bytes_accessed: float,
+                     seconds: Optional[float],
+                     device_kind: Optional[str] = None,
+                     spec: Optional[DeviceSpec] = None,
+                     dtype: str = "float32",
+                     breakdown: Optional[Mapping[str, int]] = None,
+                     name: Optional[str] = None) -> dict:
+    """One roofline row: measured seconds against the device ceilings.
+
+    ``flops``/``bytes_accessed`` come from the kernel's cost estimate
+    (:func:`~ft_sgemm_tpu.ops.common.gemm_cost_estimate` — the same
+    numbers Mosaic's scheduler sees); ``breakdown`` optionally adds the
+    ABFT-overhead fractions. ``seconds`` may be None/non-positive (a
+    skipped or failed stage): the row still renders with null rates so
+    downstream comparison reports ``incomparable`` instead of crashing.
+    """
+    spec = find_spec(device_kind) if spec is None else spec
+    peak = spec.peak_for(dtype)
+    ridge = spec.ridge_point(dtype)
+    ai = (flops / bytes_accessed) if bytes_accessed else None
+    row = {
+        "name": name,
+        "dtype": str(dtype),
+        "flops": int(flops),
+        "bytes": int(bytes_accessed),
+        "arithmetic_intensity": ai,
+        "device": spec.name,
+        "spec_estimated": spec.estimated,
+        "peak_gflops": None if peak is None else peak / 1e9,
+        "peak_gbps": spec.hbm_bytes_per_s / 1e9,
+        "ridge_point": ridge,
+        "seconds": None,
+        "gflops": None,
+        "pct_peak_compute": None,
+        "pct_peak_bandwidth": None,
+        "bound": None,
+    }
+    if ai is not None and ridge is not None:
+        # The model's verdict from the costs alone: which ceiling this
+        # stage runs under, independent of how well it ran.
+        row["bound"] = "compute" if ai >= ridge else "memory"
+    if seconds is not None and seconds > 0:
+        row["seconds"] = float(seconds)
+        row["gflops"] = flops / 1e9 / seconds
+        if peak:
+            row["pct_peak_compute"] = (flops / seconds) / peak
+        if spec.hbm_bytes_per_s:
+            row["pct_peak_bandwidth"] = (
+                (bytes_accessed / seconds) / spec.hbm_bytes_per_s)
+    if breakdown is not None:
+        row.update(abft_fractions(breakdown))
+    return row
+
+
+__all__ = ["DEVICE_SPECS", "DeviceSpec", "F32_DERATE", "abft_fractions",
+           "find_spec", "roofline_summary"]

@@ -54,6 +54,7 @@ from ft_sgemm_tpu.telemetry.events import (
     OUTCOMES,
     format_summary,
     read_events,
+    registry_from_events,
     summarize_events,
 )
 from ft_sgemm_tpu.telemetry.registry import (
@@ -62,6 +63,8 @@ from ft_sgemm_tpu.telemetry.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    histogram_percentiles,
+    to_prometheus,
 )
 
 
@@ -433,15 +436,18 @@ __all__ = [
     "enabled",
     "format_summary",
     "get_registry",
+    "histogram_percentiles",
     "measure_output_residual",
     "read_events",
     "record_attention",
     "record_gemm",
     "record_step_event",
+    "registry_from_events",
     "reset",
     "session",
     "set_step",
     "summarize_events",
     "suppress",
+    "to_prometheus",
     "trace_span",
 ]

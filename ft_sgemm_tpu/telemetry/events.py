@@ -190,6 +190,41 @@ def summarize_events(events: Iterable[FaultEvent]) -> dict:
             "per_layer": per_layer, "residuals": hist.value}
 
 
+def registry_from_events(events: Iterable[FaultEvent]):
+    """Rebuild a :class:`~ft_sgemm_tpu.telemetry.registry.MetricsRegistry`
+    from a fault-event log — the bridge from the JSONL stream to any
+    registry exporter (``cli telemetry LOG --format=prom``). The series
+    mirror what live recording would have produced: ``ft_calls`` /
+    ``ft_detections`` / ``ft_corrected`` / ``ft_uncorrectable`` counters
+    labeled by op/strategy/layer, ``ft_step_events`` per outcome, and the
+    ``ft_residual`` histogram."""
+    from ft_sgemm_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    call_outcomes = ("clean", "corrected", "uncorrectable")
+    for ev in events:
+        if ev.outcome not in call_outcomes:
+            reg.counter("ft_step_events", op=ev.op,
+                        outcome=ev.outcome).inc()
+            continue
+        labels = {"op": ev.op}
+        if ev.strategy:
+            labels["strategy"] = ev.strategy
+        if ev.layer:
+            labels["layer"] = ev.layer
+        if ev.device:
+            labels["device"] = ev.device
+        if isinstance(ev.extra, dict) and ev.extra.get("encode"):
+            labels["encode"] = ev.extra["encode"]
+        reg.counter("ft_calls", **labels).inc()
+        reg.counter("ft_detections", **labels).inc(ev.detected)
+        reg.counter("ft_corrected", **labels).inc(ev.corrected)
+        reg.counter("ft_uncorrectable", **labels).inc(ev.uncorrectable)
+        if ev.residual is not None:
+            reg.histogram("ft_residual", **labels).observe(ev.residual)
+    return reg
+
+
 def format_summary(summary: dict) -> str:
     """Human-readable rendering of :func:`summarize_events` output."""
     lines = []
@@ -227,6 +262,13 @@ def format_summary(summary: dict) -> str:
                 bar = "#" * max(1, round(40 * n / peak))
                 lines.append(f"  ({lo:>8.1e}, {ub:>8.1e}]  {n:>6d}  {bar}")
             lo = ub
+        from ft_sgemm_tpu.telemetry.registry import histogram_percentiles
+
+        pct = histogram_percentiles(h)
+        lines.append("residual percentiles (bucket upper bounds): "
+                     + "  ".join(f"{k}<={v:.1e}"
+                                 for k, v in pct.items()
+                                 if v is not None))
     else:
         lines.append("residual histogram: no residual observations "
                      "(enable measure_residual or log residual-bearing "
@@ -235,4 +277,4 @@ def format_summary(summary: dict) -> str:
 
 
 __all__ = ["FaultEvent", "JsonlSink", "OUTCOMES", "format_summary",
-           "read_events", "summarize_events"]
+           "read_events", "registry_from_events", "summarize_events"]

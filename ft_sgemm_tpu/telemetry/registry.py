@@ -116,6 +116,105 @@ class Histogram:
                     "sum": self._sum, "count": self._count}
 
 
+def histogram_percentiles(value: dict, quantiles=(0.5, 0.95)) -> dict:
+    """Percentile estimates from a histogram's bucket counts.
+
+    Takes a :attr:`Histogram.value` dict (non-cumulative per-bucket
+    counts). Each quantile resolves to the upper bound of the first
+    bucket whose cumulative count reaches it — the standard
+    Prometheus-style estimate: exact to bucket resolution (decades
+    here), never below the true percentile. Returns
+    ``{"p50": ..., "p95": ..., "max": ...}``-shaped keys (one per
+    requested quantile, plus ``max`` = the last nonempty bucket's upper
+    bound); all None when the histogram is empty. ``inf`` means the
+    observation landed in the overflow bucket."""
+    buckets = value.get("buckets") or []
+    counts = value.get("counts") or []
+    total = sum(counts)
+    out = {f"p{round(100 * q)}": None for q in quantiles}
+    out["max"] = None
+    if not total:
+        return out
+    for q in quantiles:
+        need = q * total
+        cum = 0
+        for ub, n in zip(buckets, counts):
+            cum += n
+            if cum >= need:
+                out[f"p{round(100 * q)}"] = ub
+                break
+    nonempty = [ub for ub, n in zip(buckets, counts) if n]
+    out["max"] = nonempty[-1] if nonempty else None
+    return out
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric-name sanitization (dots and dashes to
+    underscores; the exposition format allows ``[a-zA-Z_:][a-zA-Z0-9_:]*``)."""
+    import re
+
+    s = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return s if s and not s[0].isdigit() else "_" + s
+
+
+def _prom_escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"'
+                    for k, v in sorted(merged.items()))
+    return "{" + body + "}"
+
+
+def _prom_num(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def to_prometheus(series: Sequence[dict]) -> str:
+    """Render a :meth:`MetricsRegistry.collect` snapshot in the
+    Prometheus text exposition format (v0.0.4).
+
+    Counters and gauges map directly; histograms emit the standard
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
+    Metric names are sanitized (``compile.seconds`` ->
+    ``compile_seconds``); a ``# TYPE`` line precedes each metric family
+    once."""
+    by_name: dict = {}
+    for s in series:
+        by_name.setdefault((_prom_name(s["name"]), s["kind"]), []).append(s)
+    lines = []
+    for (name, kind), group in sorted(by_name.items()):
+        prom_kind = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}.get(kind, "untyped")
+        lines.append(f"# TYPE {name} {prom_kind}")
+        for s in group:
+            labels = s.get("labels") or {}
+            v = s["value"]
+            if kind in ("counter", "gauge"):
+                lines.append(f"{name}{_prom_labels(labels)} {_prom_num(v)}")
+                continue
+            cum = 0
+            for ub, n in zip(v["buckets"], v["counts"]):
+                cum += n
+                le = _prom_labels(labels, {"le": _prom_num(ub)})
+                lines.append(f"{name}_bucket{le} {cum}")
+            lines.append(f"{name}_sum{_prom_labels(labels)} "
+                         f"{_prom_num(v['sum'])}")
+            lines.append(f"{name}_count{_prom_labels(labels)} "
+                         f"{v['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class MetricsRegistry:
     """Process-wide metric store, thread-safe, keyed by name + labels.
 
@@ -185,4 +284,4 @@ class MetricsRegistry:
 
 
 __all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
-           "MetricsRegistry"]
+           "MetricsRegistry", "histogram_percentiles", "to_prometheus"]

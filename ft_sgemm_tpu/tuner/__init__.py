@@ -66,6 +66,41 @@ _OFF_VALUES = ("0", "off", "false", "no")
 
 _LOCAL = threading.local()
 
+# Dispatch-lookup outcome counts (process-wide, plain ints under a lock):
+# the perf subsystem's RunReport embeds them so an artifact says whether
+# its kernels ran tuned tiles or heuristics. Kept independent of the
+# telemetry on/off switch — a counter bump is ~free and the manifest
+# wants the answer even on un-instrumented runs; the telemetry registry
+# is additionally mirrored when enabled (the subsystem convention).
+_STATS_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0}
+
+
+def lookup_stats() -> dict:
+    """Snapshot of dispatch cache-lookup outcomes: ``{"hits", "misses"}``
+    since process start (lookups while tuning is disabled don't count —
+    nothing was asked of the cache)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_lookup_stats() -> None:
+    """Zero the lookup counters (tests; between independent runs)."""
+    with _STATS_LOCK:
+        _STATS["hits"] = 0
+        _STATS["misses"] = 0
+
+
+def _count_lookup(hit: bool) -> None:
+    with _STATS_LOCK:
+        _STATS["hits" if hit else "misses"] += 1
+    from ft_sgemm_tpu import telemetry
+
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "tuner.cache_lookups",
+            result="hit" if hit else "miss").inc()
+
 
 def enabled() -> bool:
     """Whether dispatch consults the tile cache.
@@ -107,6 +142,7 @@ def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
     rec = cache.lookup(make_key(m, n, k, strategy=strategy,
                                 in_dtype=in_dtype, encode=encode,
                                 injection_enabled=injection_enabled))
+    _count_lookup(rec is not None)
     if rec is None:
         return None
     bm, bn, bk = rec["block"]
@@ -231,8 +267,10 @@ __all__ = [
     "enabled",
     "enumerate_space",
     "heuristic_shape",
+    "lookup_stats",
     "lookup_tile",
     "make_key",
+    "reset_lookup_stats",
     "measure",
     "measure_space",
     "mnk_bucket",
