@@ -44,7 +44,13 @@ well under any plausible driver window), ``FT_SGEMM_BENCH_WORKER_MAX`` per
 attempt (default 480), ``FT_SGEMM_BENCH_MARGIN`` reserved for final
 assembly (default 30), ``FT_SGEMM_BENCH_GRACE`` SIGTERM->SIGKILL (default
 5), ``FT_SGEMM_BENCH_MIN_ATTEMPT`` smallest budget worth launching a
-worker for (default 90), ``FT_SGEMM_BENCH_RECORDS`` records path (default:
+worker for (default 90), ``FT_SGEMM_BENCH_TIMELINE`` span-timeline path
+(default ``<records>.timeline.jsonl`` — the worker streams
+stage/attempt/compile spans there, flushed per event, and the supervisor
+both appends kill markers and SALVAGES completed stage values from it
+when a deadline kill would otherwise null the artifact; render with
+``python -m ft_sgemm_tpu.cli timeline``), ``FT_SGEMM_BENCH_RECORDS``
+records path (default:
 a repo-local ``.bench/`` file keyed by the code version, so runs of the
 same code share measurements — an earlier monitoring run's stages resume
 into the scoring run; an flock serializes concurrent runs, and different
@@ -72,6 +78,7 @@ are 8 minutes apart: progress, not a dead hang).  Two counters now:
   which survives a ~9-minute init with time to measure).
 """
 
+import contextlib
 import json
 import os
 import signal
@@ -105,6 +112,94 @@ _EXTEND_MAX = float(os.environ.get("FT_SGEMM_BENCH_EXTEND_MAX",
 
 def _time_left() -> float:
     return _DEADLINE - (time.monotonic() - _T0)
+
+
+# --------------------------------------------------------------------------
+# Run timeline: streamed span log (telemetry/timeline.py), loaded by FILE
+# PATH so the supervisor keeps its never-imports-jax guarantee (importing
+# the ft_sgemm_tpu package root would pull jax in). Everything here is
+# best-effort: a missing/unwritable timeline degrades observability, never
+# the JSON line.
+# --------------------------------------------------------------------------
+
+_TIMELINE_MOD = None
+
+
+def _load_timeline_mod():
+    """The telemetry.timeline module loaded standalone (stdlib-only by
+    contract — see its docstring). None when unloadable."""
+    global _TIMELINE_MOD
+    if _TIMELINE_MOD is not None:
+        return _TIMELINE_MOD
+    try:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "ft_sgemm_tpu", "telemetry", "timeline.py")
+        spec = importlib.util.spec_from_file_location("_ft_timeline", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _TIMELINE_MOD = mod
+    except Exception:  # noqa: BLE001 — observability must not kill the run
+        _TIMELINE_MOD = None
+    return _TIMELINE_MOD
+
+
+def _timeline_path(records_path):
+    env = os.environ.get("FT_SGEMM_BENCH_TIMELINE")
+    if env:
+        return env
+    return (records_path + ".timeline.jsonl") if records_path else None
+
+
+class _NoTimeline:
+    """Recorder stand-in when the timeline module failed to load."""
+
+    def point(self, *a, **k):
+        pass
+
+    @contextlib.contextmanager
+    def span(self, *a, **k):
+        yield {}
+
+    path = None
+
+
+def _make_timeline(records_path):
+    mod = _load_timeline_mod()
+    path = _timeline_path(records_path)
+    if mod is None or path is None:
+        return _NoTimeline()
+    try:
+        return mod.TimelineRecorder(path)
+    except Exception:  # noqa: BLE001
+        return _NoTimeline()
+
+
+def _tl_point(kind, name, **fields):
+    """Supervisor-side point event (kill markers): opened per write so a
+    signal handler can emit without any shared recorder state."""
+    mod = _load_timeline_mod()
+    path = _timeline_path(_RECORDS_PATH)
+    if mod is None or path is None:
+        return
+    try:
+        mod.TimelineRecorder(path).point(kind, name, **fields)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _read_timeline_summary():
+    """Summarize the run's streamed timeline, or None."""
+    mod = _load_timeline_mod()
+    path = _timeline_path(_RECORDS_PATH)
+    if mod is None or path is None:
+        return None
+    try:
+        records = mod.read_timeline(path)
+        return mod.summarize_timeline(records) if records else None
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _attempt_budget(remaining):
@@ -308,18 +403,24 @@ def _wait_with_heartbeat(attempt_t0, budget, hb_path):
         status = hb.status()
         if _time_left() <= _MARGIN:
             _kill_child()
-            return "killed (supervisor deadline reached)"
+            reason = "killed (supervisor deadline reached)"
+            _tl_point("kill", reason)
+            return reason
         over = time.monotonic() - attempt_t0 - budget
         if over < 0:
             continue
         if over >= _EXTEND_MAX:
             _kill_child()
-            return ("killed (per-attempt budget and heartbeat-extension "
-                    "cap exhausted)")
+            reason = ("killed (per-attempt budget and heartbeat-extension "
+                      "cap exhausted)")
+            _tl_point("kill", reason)
+            return reason
         if status == hb.FRESH:
             continue  # worker alive past budget: extend the attempt
         _kill_child()
-        return f"killed (per-attempt budget exhausted, heartbeat {status})"
+        reason = f"killed (per-attempt budget exhausted, heartbeat {status})"
+        _tl_point("kill", reason)
+        return reason
 
 
 def _kill_child():
@@ -396,6 +497,22 @@ def _emit_locked(values, errors, extra_errors=None):
     # reference's flagship row is likewise its best FT kernel). Every
     # per-variant number stays visible in context.
     ft, strategy = _best_measurement(values)
+    # Kill-safe salvage (the BENCH_r05 null-artifact class): when this
+    # run's records hold no promotable measurement, read the worker's
+    # STREAMED timeline partials — every completed stage's value landed
+    # on disk before the next stage began — and emit the best completed
+    # measurement instead of null, marked ``context.partial`` below.
+    tl_summary = _read_timeline_summary()
+    salvaged = False
+    if ft is None and tl_summary:
+        merged = dict(values)
+        for name, v in (tl_summary.get("stage_values") or {}).items():
+            merged.setdefault(name, v)
+        ft_s, strat_s = _best_measurement(merged)
+        if ft_s is not None:
+            ft, strategy = ft_s, strat_s
+            salvaged = True
+            values = merged  # salvaged stages join the context rows
     context = {}
     if strategy:
         context["strategy"] = strategy
@@ -519,6 +636,28 @@ def _emit_locked(values, errors, extra_errors=None):
         stale = _newest_stale_headline()
         if stale:
             context["last_measured_other_code_version"] = stale
+    killed = ("signal" in errors
+              or any(isinstance(v, str) and "killed (" in v
+                     for v in errors.values()))
+    complete = ("ft_headline" in values
+                and all(w in values for w in WANTED_STAGES))
+    if ft is not None and (salvaged or (killed and not complete)):
+        # Real but PARTIAL: a deadline kill (or a lost record salvaged
+        # from the streamed timeline) means later stages never ran —
+        # say so, and list exactly which stages completed, so readers
+        # and gates (bench-compare, summarize_bench) never mistake a
+        # salvaged artifact for a full sweep.
+        context["partial"] = True
+        context["completed_stages"] = sorted(
+            k for k in values
+            if not k.startswith("_")
+            and k not in ("backend_guard", "worker_crash"))
+    if tl_summary:
+        if tl_summary.get("killed_at_stage"):
+            context["killed_at_stage"] = tl_summary["killed_at_stage"]
+        tpath = _timeline_path(_RECORDS_PATH)
+        if tpath:
+            context["timeline"] = os.path.basename(tpath)
     context["errors"] = errors
     print(json.dumps({
         "metric": "abft_kernel_huge_gflops_4096",
@@ -602,6 +741,7 @@ def _on_signal(signum, frame):
     able to SIGKILL us before the line lands. The worker is then reaped
     here or, failing even that, by its PR_SET_PDEATHSIG when we exit."""
     rc = _emit_from_disk({"signal": f"supervisor received signal {signum}"})
+    _tl_point("kill", f"killed (supervisor received signal {signum})")
     _kill_child()
     os._exit(rc)
 
@@ -709,7 +849,10 @@ def _default_records_path():
             mine = f"records_{key}_{SIZE}.jsonl"
             cutoff = time.time() - 3 * 86400
             for name in os.listdir(d):
-                if not name.endswith(".jsonl") or name == mine:
+                # Spare the current key's records AND its streamed
+                # timeline (the salvage input must survive startup).
+                if (not name.endswith(".jsonl")
+                        or name in (mine, mine + ".timeline.jsonl")):
                     continue
                 fp = os.path.join(d, name)
                 try:
@@ -930,14 +1073,16 @@ def _retry(what, fn, errors, attempts=4, base=3.0):
     return None
 
 
-def _start_heartbeat(records_path):
+def _start_heartbeat(records_path, tl=None):
     """Touch ``<records>.hb`` every few seconds from a daemon thread.
 
     Started BEFORE any jax import: the supervisor's budget-extension
     policy reads this file's mtime. A slowly-initializing backend keeps
     beating (init releases the GIL between steps — the BENCH_r03 tail
     shows log lines landing mid-init); a wedged GIL or dead process goes
-    stale and the supervisor's nominal-budget kill fires."""
+    stale and the supervisor's nominal-budget kill fires. Each beat also
+    lands as a timeline point so ``cli timeline`` can render heartbeat
+    gaps post hoc."""
     if (os.environ.get("PYTEST_CURRENT_TEST")
             and os.environ.get("FT_SGEMM_BENCH_FAKE_NO_HB")):
         return  # test hook: simulate a worker whose beats never start
@@ -952,6 +1097,8 @@ def _start_heartbeat(records_path):
                     f.write(f"{os.getpid()} {time.time():.1f}\n")
             except OSError:
                 pass
+            if tl is not None:
+                tl.point("heartbeat", "beat")
             time.sleep(10.0)
 
     threading.Thread(target=beat, daemon=True,
@@ -959,10 +1106,18 @@ def _start_heartbeat(records_path):
 
 
 def worker_main(records_path):
-    _start_heartbeat(records_path)
+    tl = _make_timeline(records_path)
+    _start_heartbeat(records_path, tl)
     rec = Recorder(records_path)
     try:
-        return _worker_stages(rec)
+        # The attempt span's start record lands before any jax import:
+        # even a worker that hangs in backend init leaves a timeline
+        # saying when the attempt began and (from the supervisor's kill
+        # marker) when it died.
+        with tl.span("worker", kind="attempt", pid=os.getpid()) as info:
+            rc = _worker_stages(rec, tl)
+            info["value"] = rc
+            return rc
     except Exception as e:  # noqa: BLE001 — a crash must leave a record
         # Deterministic failures outside any _retry wrapper (imports,
         # kernel factories) land here so the artifact says WHAT died
@@ -972,7 +1127,8 @@ def worker_main(records_path):
         return _worker_rc(rec)
 
 
-def _worker_stages(rec):
+def _worker_stages(rec, tl=None):
+    tl = _NoTimeline() if tl is None else tl
     # The supervisor passes the attempt's full wall allowance (nominal
     # budget + earnable heartbeat extension, clipped to its deadline), so
     # stage skip thresholds track the REAL kill time — finish gracefully
@@ -1000,6 +1156,20 @@ def _worker_stages(rec):
                                    "strategy": "fake"})
             rec.ok("xla_dot", float(fake) * 1.05)
             return 0
+        fake_partial = os.environ.get("FT_SGEMM_BENCH_FAKE_PARTIAL")
+        if fake_partial:
+            # Simulated deadline-kill mid-sweep (the salvage-path test
+            # harness): one context stage completes — records AND
+            # streamed timeline — then the next stage hangs in flight
+            # until the supervisor's kill. No headline ever lands, so
+            # the emit must salvage the completed stage.
+            rec.ok("backend", {"backend": "fake", "device": "fake",
+                               "num_devices": 1})
+            with tl.span("ft_rowcol", kind="stage") as info:
+                info["value"] = float(fake_partial)
+            rec.ok("ft_rowcol", float(fake_partial))
+            with tl.span("ft_fused", kind="stage"):
+                time.sleep(100000)
         if os.environ.get("FT_SGEMM_BENCH_FAKE_HANG"):
             time.sleep(100000)
 
@@ -1043,9 +1213,16 @@ def _worker_stages(rec):
         if left() < need:
             rec.fail(name, f"skipped: worker deadline within ~{need:.0f}s"
                            " stage budget (graceful early-stop)")
+            tl.point("stage", name, note="skipped: graceful early-stop")
             return None
         t_stage = time.monotonic()
-        out = _retry(name, fn, errors, attempts=attempts, base=base)
+        with tl.span(name, kind="stage") as span_info:
+            out = _retry(name, fn, errors, attempts=attempts, base=base)
+            if out is None:
+                span_info["status"] = "fail"
+                span_info["error"] = errors.get(name, "unknown")
+            else:
+                span_info["value"] = out
         elapsed = time.monotonic() - t_stage
         if out is not None:
             # Only successful stages update the estimate: a failed stage's
@@ -1058,9 +1235,10 @@ def _worker_stages(rec):
         return out
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import numpy as np
+    with tl.span("import_jax", kind="compile"):
+        import numpy as np
 
-    import jax
+        import jax
 
     # Persistent executable cache: tunnel windows are ~20 min; a relaunch
     # or a later stage must not respend them recompiling the same
@@ -1092,7 +1270,11 @@ def _worker_stages(rec):
     # resume on a different machine must not measure under a stale
     # recorded identity (TPU-recorded cache on a CPU box would otherwise
     # merge CPU stage numbers into a TPU-claiming artifact).
-    live = _retry("backend", probe, errors, attempts=3, base=2.0)
+    with tl.span("backend_init", kind="compile") as bi_info:
+        live = _retry("backend", probe, errors, attempts=3, base=2.0)
+        if live is None:
+            bi_info["status"] = "fail"
+            bi_info["error"] = errors.get("backend", "unknown")
     if live is None:
         # Backend init raised every retry (the BENCH_r01 failure class).
         # Instead of dying with a null artifact, fall back to whatever
@@ -1127,7 +1309,8 @@ def _worker_stages(rec):
 
         def fallback_fn():
             ctx = {}
-            ok = _smoke_measure(ctx, device_kind=live.get("device_kind"))
+            ok = _smoke_measure(ctx, device_kind=live.get("device_kind"),
+                                facts=live, tl=tl)
             ctx["ok"] = bool(ok)
             return ctx
 
@@ -1171,7 +1354,11 @@ def _worker_stages(rec):
             jax.device_put(generate_random_matrix(SIZE, SIZE, rng=rng))
             for _ in range(3))
 
-    inputs = _retry("device_put_inputs", put_inputs, errors, attempts=3)
+    with tl.span("device_put_inputs", kind="stage") as dp_info:
+        inputs = _retry("device_put_inputs", put_inputs, errors, attempts=3)
+        if inputs is None:
+            dp_info["status"] = "fail"
+            dp_info["error"] = errors.get("device_put_inputs", "unknown")
     if inputs is None:
         rec.fail("device_put_inputs", errors["device_put_inputs"])
         return _worker_rc(rec)
@@ -1202,28 +1389,41 @@ def _worker_stages(rec):
             ladder.append(("weighted (in-kernel encode fallback, 2 checks)",
                            dict(strategy="weighted", check_every=nk // 2)))
         ladder.append(("rowcol", dict(strategy="rowcol")))
-        for label, kwargs in ladder:
-            if left() < 30:
-                rec.fail("ft_headline", "skipped: worker deadline reached")
-                break
-            rung = f"ft_headline[{label}]"
+        with tl.span("ft_headline", kind="stage") as head_info:
+            for label, kwargs in ladder:
+                if left() < 30:
+                    rec.fail("ft_headline",
+                             "skipped: worker deadline reached")
+                    break
+                rung = f"ft_headline[{label}]"
 
-            def rung_fn(kwargs=kwargs):
-                # Factory inside the retry scope: a factory-time failure
-                # on one rung must fall through to the next, not abort
-                # the ladder.
-                ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5, **kwargs)
-                return gf(lambda a, b, x: ft(a, b, x, inj).c, a, b, c)
+                def rung_fn(kwargs=kwargs):
+                    # Factory inside the retry scope: a factory-time
+                    # failure on one rung must fall through to the next,
+                    # not abort the ladder.
+                    ft = make_ft_sgemm("huge", alpha=1.0, beta=-1.5,
+                                       **kwargs)
+                    return gf(lambda a, b, x: ft(a, b, x, inj).c, a, b, c)
 
-            val = _retry(rung, rung_fn, errors, attempts=2)
-            if val is not None:
-                rec.ok("ft_headline", {"gflops": val, "strategy": label})
-                break
-            # Land the rung's error on disk even when a later rung
-            # rescues the headline, so the artifact says WHAT died.
-            rec.fail(rung, errors.get(rung, "unknown"))
-        else:
-            rec.fail("ft_headline", json.dumps(errors))
+                with tl.span(rung, kind="stage") as rung_info:
+                    val = _retry(rung, rung_fn, errors, attempts=2)
+                    if val is None:
+                        rung_info["status"] = "fail"
+                        rung_info["error"] = errors.get(rung, "unknown")
+                    else:
+                        rung_info["value"] = val
+                if val is not None:
+                    rec.ok("ft_headline",
+                           {"gflops": val, "strategy": label})
+                    head_info["value"] = {"gflops": val, "strategy": label}
+                    break
+                # Land the rung's error on disk even when a later rung
+                # rescues the headline, so the artifact says WHAT died.
+                rec.fail(rung, errors.get(rung, "unknown"))
+            else:
+                rec.fail("ft_headline", json.dumps(errors))
+            if "value" not in head_info:
+                head_info["status"] = "fail"
 
     if not rec.done("ft_headline"):
         # No number, no point burning budget on context stages: return so
@@ -1371,7 +1571,7 @@ def _worker_stages(rec):
                     a, b, x, 1.0, -1.5, in_dtype="bfloat16"), a16, b16, c),
                 attempts=2)
 
-    _record_run_report(rec, live)
+    _record_run_report(rec, live, tl=tl)
     return _worker_rc(rec)
 
 
@@ -1394,7 +1594,24 @@ _REPORT_STAGES = (
 )
 
 
-def _record_run_report(rec, live):
+def _tl_summary_for_report(tl):
+    """The run's timeline summary for RunReport embedding, or None.
+
+    ``stage_values`` is dropped — redundant with the stage records that
+    feed the roofline rows — keeping the artifact lean."""
+    try:
+        mod = _load_timeline_mod()
+        path = getattr(tl, "path", None)
+        if mod is None or not path or not os.path.exists(path):
+            return None
+        summary = mod.summarize_timeline(mod.read_timeline(path))
+        summary.pop("stage_values", None)
+        return summary
+    except Exception:  # noqa: BLE001 — observability never kills a run
+        return None
+
+
+def _record_run_report(rec, live, tl=None):
     """Assemble the RunReport (manifest + per-stage roofline rows) from
     this run's stage records and bank it as the ``run_report`` record.
 
@@ -1443,12 +1660,21 @@ def _record_run_report(rec, live):
             tb = tuned.get("tuned_block")
             add("ft_tuned", tuned.get("gflops"), "weighted", "vpu",
                 "float32", block=tuple(tb) if tb else blk)
+        # The backend-fallback triple rides the manifest (not just the
+        # bench context): a report rendered from the artifact alone says
+        # what platform was ASKED for, what ran, and why they differ.
+        extra = {k: live[k] for k in ("platform_requested",
+                                      "platform_used", "fallback_reason")
+                 if isinstance(live, dict) and live.get(k) is not None}
         manifest = perf.build_manifest(
             device_kind=kind,
             platform=live.get("backend") if isinstance(live, dict)
-            else None)
+            else None,
+            extra=extra or None)
         rec.ok("run_report",
-               perf.RunReport(manifest=manifest, stages=rows).to_dict())
+               perf.RunReport(manifest=manifest, stages=rows,
+                              timeline=_tl_summary_for_report(tl)
+                              ).to_dict())
     except Exception as e:  # noqa: BLE001 — observability never kills a run
         rec.fail("run_report", f"{type(e).__name__}: {e}")
         sys.stderr.write(traceback.format_exc())
@@ -1506,12 +1732,16 @@ SMOKE_SIZE = 256
 SMOKE_BLOCK = (128, 128, 128)
 
 
-def _smoke_measure(context, *, device_kind=None):
+def _smoke_measure(context, *, device_kind=None, facts=None, tl=None):
     """The smoke measurement set: one tiny size, both encode modes, plus
     the RunReport manifest with per-stage roofline rows and a guarded
     compiled-HLO introspection. Shared by ``--smoke`` and the worker's
     backend-fallback path (which records the same facts under the full
-    bench artifact instead of dying null). Returns ok_all."""
+    bench artifact instead of dying null). ``facts`` (the backend probe
+    dict) threads the ``platform_requested`` / ``platform_used`` /
+    ``fallback_reason`` triple into the RunReport manifest; ``tl`` (a
+    TimelineRecorder) streams per-stage spans and lands the timeline
+    summary in the report. Returns ok_all."""
     import numpy as np
 
     import jax
@@ -1532,22 +1762,27 @@ def _smoke_measure(context, *, device_kind=None):
     inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
     context.setdefault("encode_modes", {})
     context.setdefault("errors", {})
+    tl = _NoTimeline() if tl is None else tl
     stages = []
     ok_all = True
     for enc in ("vpu", "mxu"):
         try:
-            ft = make_ft_sgemm(tile, alpha=1.0, beta=-1.5,
-                               strategy="rowcol", encode=enc)
-            t1 = time.monotonic()
-            res = ft(a, b, c, inj)
-            jax.block_until_ready(res.c)
-            dt = time.monotonic() - t1
-            ok, nbad, _ = verify_matrix(want, np.asarray(res.c),
-                                        verbose=False)
-            unc = int(res.num_uncorrectable)
-            context["encode_modes"][enc] = {
-                "corrected_ok": bool(ok), "detections": int(res.num_detected),
-                "uncorrectable": unc, "seconds": round(dt, 3)}
+            with tl.span(f"ft_rowcol[{enc}]", kind="stage") as span_info:
+                ft = make_ft_sgemm(tile, alpha=1.0, beta=-1.5,
+                                   strategy="rowcol", encode=enc)
+                t1 = time.monotonic()
+                res = ft(a, b, c, inj)
+                jax.block_until_ready(res.c)
+                dt = time.monotonic() - t1
+                ok, nbad, _ = verify_matrix(want, np.asarray(res.c),
+                                            verbose=False)
+                unc = int(res.num_uncorrectable)
+                row = {
+                    "corrected_ok": bool(ok),
+                    "detections": int(res.num_detected),
+                    "uncorrectable": unc, "seconds": round(dt, 3)}
+                context["encode_modes"][enc] = row
+                span_info["value"] = row
             ok_all &= bool(ok) and unc == 0
             stages.append(perf.stage_row(
                 f"ft_rowcol[{enc}]", dt, m=size, n=size, k=size,
@@ -1563,15 +1798,21 @@ def _smoke_measure(context, *, device_kind=None):
     try:
         from ft_sgemm_tpu.perf import hlo as perf_hlo
 
-        context["hlo"] = perf_hlo.introspect_jitted(
-            lambda a, b, c: sgemm_reference(a, b, c, 1.0, -1.5),
-            a, b, c, label="xla_dot_smoke")
+        with tl.span("hlo_introspect", kind="compile"):
+            context["hlo"] = perf_hlo.introspect_jitted(
+                lambda a, b, c: sgemm_reference(a, b, c, 1.0, -1.5),
+                a, b, c, label="xla_dot_smoke")
     except Exception as e:  # noqa: BLE001
         context["errors"]["hlo"] = f"{type(e).__name__}: {e}"
     try:
-        manifest = perf.build_manifest(device_kind=device_kind)
+        extra = {k: facts[k] for k in ("platform_requested",
+                                       "platform_used", "fallback_reason")
+                 if isinstance(facts, dict) and facts.get(k) is not None}
+        manifest = perf.build_manifest(device_kind=device_kind,
+                                       extra=extra or None)
         context["run_report"] = perf.RunReport(
-            manifest=manifest, stages=stages).to_dict()
+            manifest=manifest, stages=stages,
+            timeline=_tl_summary_for_report(tl)).to_dict()
     except Exception as e:  # noqa: BLE001
         context["errors"]["run_report"] = f"{type(e).__name__}: {e}"
     return ok_all
@@ -1603,7 +1844,13 @@ def smoke_main():
         return 1
 
     context = {"smoke": True, "size": SMOKE_SIZE, "errors": {}}
-    facts, err = _backend_with_fallback()
+    # --smoke streams a timeline when FT_SGEMM_BENCH_TIMELINE names a
+    # path (CI sets it, uploads the JSONL, and renders it with
+    # ``cli timeline``); without the env var this is a no-op recorder.
+    tl = (_make_timeline(None)
+          if os.environ.get("FT_SGEMM_BENCH_TIMELINE") else _NoTimeline())
+    with tl.span("backend_init", kind="compile"):
+        facts, err = _backend_with_fallback()
     if facts is None:
         context["errors"]["backend"] = err
         print(json.dumps({"metric": "bench_smoke", "value": 0, "unit": "ok",
@@ -1613,7 +1860,8 @@ def smoke_main():
     context.update(facts)
     try:
         ok_all = _smoke_measure(context,
-                                device_kind=facts.get("device_kind"))
+                                device_kind=facts.get("device_kind"),
+                                facts=facts, tl=tl)
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["smoke"] = f"{type(e).__name__}: {e}"
         sys.stderr.write(traceback.format_exc())

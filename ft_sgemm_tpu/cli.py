@@ -28,7 +28,11 @@ Usage:
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
         [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
         [--encode=vpu|mxu] [--telemetry=LOG.jsonl]
-    python -m ft_sgemm_tpu.cli telemetry LOG.jsonl [--format=text|prom]
+    python -m ft_sgemm_tpu.cli telemetry LOG.jsonl \
+        [--format=text|prom] [--by-device]
+    python -m ft_sgemm_tpu.cli attribute LOG.jsonl [LOG2.jsonl ...]
+    python -m ft_sgemm_tpu.cli timeline RUN.timeline.jsonl \
+        [--format=text|json]
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
         [--encode=vpu|mxu] [--dtype=...] [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
@@ -69,7 +73,16 @@ structured event — counters, outcome, tile coordinates, and a host-side
 residual measurement — to LOG.jsonl. The ``telemetry`` subcommand then
 summarizes such a log: per-op/per-layer totals, outcome counts, and the
 residual-magnitude histogram that feeds threshold calibration
-(``analysis.calibrate_threshold``).
+(``analysis.calibrate_threshold``); ``--by-device`` prints the
+per-device SDC localization view instead (host, device, shard coords,
+counts — DESIGN.md §8).
+
+``attribute`` merges one or more per-host fault-event shards
+(``telemetry.aggregate``) and ranks every implicated device most
+suspect first — the fleet-screening "which chip do I pull" view.
+``timeline`` renders a bench run's streamed span timeline
+(``telemetry.timeline``): per-stage wall time, heartbeat gaps, kill
+markers, in-flight work — post hoc on a killed run or live mid-run.
 
 ``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
@@ -339,18 +352,22 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
     return results
 
 
-def run_telemetry_summary(log_path: str, out=None,
-                          fmt: str = "text") -> int:
+def run_telemetry_summary(log_path: str, out=None, fmt: str = "text",
+                          by_device: bool = False) -> int:
     """``telemetry`` subcommand: summarize a fault-event JSONL log.
 
     ``fmt="text"`` prints the human summary (totals, per-op/per-layer
     tables, residual histogram + p50/p95/max percentiles);
     ``fmt="prom"`` rebuilds a metrics registry from the events and
     exports it in the Prometheus text exposition format — pipe it to a
-    node-exporter textfile collector or a pushgateway.
+    node-exporter textfile collector or a pushgateway. ``--by-device``
+    prints the per-device localization view instead: one row per
+    ``(host, device)`` that appeared in the events' attribution entries
+    (``telemetry.aggregate`` — shard coords, detected/uncorrectable
+    counts, fault rate).
     """
     from ft_sgemm_tpu.telemetry import (
-        format_summary, read_events, registry_from_events,
+        aggregate, format_summary, read_events, registry_from_events,
         summarize_events, to_prometheus)
 
     # Resolve stdout at CALL time (a def-time default would pin whatever
@@ -358,6 +375,11 @@ def run_telemetry_summary(log_path: str, out=None,
     # caller that swaps streams).
     out = sys.stdout if out is None else out
     try:
+        if by_device:
+            table = aggregate.device_table(read_events(log_path))
+            print(f"per-device fault attribution of {log_path}", file=out)
+            print(aggregate.format_device_table(table), file=out)
+            return 0
         if fmt == "prom":
             reg = registry_from_events(read_events(log_path))
             out.write(to_prometheus(reg.collect()))
@@ -368,6 +390,63 @@ def run_telemetry_summary(log_path: str, out=None,
         return 2
     print(f"telemetry summary of {log_path}", file=out)
     print(format_summary(summary), file=out)
+    return 0
+
+
+def run_attribute(paths, out=None) -> int:
+    """``attribute`` subcommand: the fleet-screening view.
+
+    Merges one or more per-host fault-event JSONL shards
+    (``telemetry.aggregate.merge_shards`` — each process of a multi-host
+    run writes its own shard listing only its devices) and prints every
+    implicated device ranked most-suspect first: uncorrectable count,
+    then detections, then fault rate. The "which chip do I pull" list.
+    """
+    from ft_sgemm_tpu.telemetry import aggregate
+
+    out = sys.stdout if out is None else out
+    try:
+        events = aggregate.merge_shards(paths)
+    except OSError as e:
+        print(f"ft_sgemm: cannot read telemetry log: {e}", file=sys.stderr)
+        return 2
+    table = aggregate.device_table(events)
+    print(f"fault attribution over {len(paths)} shard(s), "
+          f"{len(events)} events", file=out)
+    print(aggregate.format_device_table(table, ranked=True), file=out)
+    return 0
+
+
+def run_timeline(path: str, out=None, fmt: str = "text") -> int:
+    """``timeline`` subcommand: render a streamed run timeline.
+
+    Reads the append-only span JSONL a bench worker streams
+    (``telemetry.timeline``) — works post-hoc on a finished/killed run
+    or mid-run on a live one (in-flight spans render as such) — and
+    prints per-span wall time, heartbeat gaps, and any supervisor kill
+    markers. ``--format=json`` emits the summary dict instead. Exit 2 on
+    an unreadable file, 1 when the file holds no timeline records.
+    """
+    import json as _json
+
+    from ft_sgemm_tpu.telemetry import timeline as tl
+
+    out = sys.stdout if out is None else out
+    try:
+        records = tl.read_timeline(path)
+    except OSError as e:
+        print(f"ft_sgemm: cannot read timeline: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"ft_sgemm: {path} holds no timeline records",
+              file=sys.stderr)
+        return 1
+    summary = tl.summarize_timeline(records)
+    if fmt == "json":
+        print(_json.dumps(summary, indent=1, sort_keys=True), file=out)
+    else:
+        print(f"timeline of {path}", file=out)
+        print(tl.format_timeline(summary), file=out)
     return 0
 
 
@@ -594,7 +673,26 @@ def main(argv=None) -> int:
                     print(f"--format must be text or prom, got {fmt!r}",
                           file=sys.stderr)
                     return 2
-        return run_telemetry_summary(args[1], fmt=fmt)
+        return run_telemetry_summary(args[1], fmt=fmt,
+                                     by_device="--by-device" in flags)
+    if args and args[0] == "attribute":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        return run_attribute(args[1:])
+    if args and args[0] == "timeline":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        fmt = "text"
+        for f in flags:
+            if f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("text", "json"):
+                    print(f"--format must be text or json, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        return run_timeline(args[1], fmt=fmt)
     if args and args[0] == "report":
         if len(args) < 2:
             print(__doc__)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
@@ -177,6 +178,7 @@ def multihost_ft_sgemm(
     in_dtype: str = "float32",
     scatter_output: bool = False,
     interpret: Optional[bool] = None,
+    inject_coords: Optional[Tuple[int, int, int]] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a ("host", "x", "y") mesh.
 
@@ -185,6 +187,15 @@ def multihost_ft_sgemm(
     corrected per device before the psum; only the int32 detection count
     crosses DCN. ``scatter_output=True`` reduce-scatters the K-partials so
     C lands additionally N-sharded over ``y``.
+
+    With telemetry enabled, each process records per-device attribution
+    for ITS OWN devices only (``telemetry.record_mesh_gemm`` reads the
+    per-device counter grids through ``addressable_shards``), so the
+    per-host JSONL event shards partition cleanly and
+    ``telemetry.aggregate.merge_shards`` reassembles the pod-wide view
+    without dedup (DESIGN.md §8). ``inject_coords=(h, i, j)`` restricts
+    injection to the device at that mesh position — the cross-host
+    localization self-test.
     """
     # Keep string shapes as names: make_ft_sgemm resolves them through the
     # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
@@ -207,7 +218,9 @@ def multihost_ft_sgemm(
     # K-partials psum over "y" (ICI only); the int32 detection count is the
     # one value that crosses "host" (DCN).
     step = make_ft_step(local_ft, alpha, beta, inject, scatter_output,
-                        det_axes=("y", "x", "host"))
+                        det_axes=("y", "x", "host"),
+                        mesh_axes=("host", "x", "y"),
+                        inject_coords=inject_coords)
 
     rows = P(("host", "x"), "y")
     c_spec = (P(("host", "x"), "y") if scatter_output
@@ -216,10 +229,22 @@ def multihost_ft_sgemm(
         step,
         mesh=mesh,
         in_specs=(rows, P(None, "y"), c_spec),
-        out_specs=(c_spec, P(None, None), P(None, None)),
+        out_specs=(c_spec, P(None, None), P(None, None),
+                   P("host", "x", "y"), P("host", "x", "y")),
     )
-    out, det, unc = jax.jit(fn)(a, b, c)
-    return FtSgemmResult(out, det, unc)
+    with telemetry.trace_span("multihost_ft_sgemm"):
+        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
+    result = FtSgemmResult(out, det, unc)
+    if telemetry.enabled():
+        # Each process attributes ITS addressable devices' counts; the
+        # device label carries the full mesh extent for topology rollups.
+        telemetry.record_mesh_gemm(
+            "multihost_ft_sgemm", result, strategy=strategy,
+            device=f"mesh{h}x{mx}x{my}", operands=(a, b, c),
+            alpha=alpha, beta=beta,
+            dev_detections=dev_det, dev_uncorrectable=dev_unc,
+            axes=("host", "x", "y"))
+    return result
 
 
 __all__ = ["initialize", "make_multihost_mesh", "make_multihost_ring_mesh",

@@ -38,7 +38,7 @@ from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
-from ft_sgemm_tpu.parallel.sharded import shard_map
+from ft_sgemm_tpu.parallel.sharded import shard_local_ft, shard_map
 
 
 def make_ring_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -81,12 +81,17 @@ def ring_ft_sgemm(
     precision: str = "highest",
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    inject_coords: Optional[tuple] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` as a ring collective matmul.
 
     Detections are aggregated over all hops and devices; the returned
     ``detections`` array is the global scalar count reshaped to (1, 1)
-    (per-tile attribution is not preserved across hops).
+    (per-tile attribution is not preserved across hops — but per-DEVICE
+    attribution is: each device's hop-summed counts are recorded with
+    its ring position and host when telemetry is enabled, DESIGN.md §8).
+    ``inject_coords=(i,)`` restricts injection to ring position ``i``
+    (every hop on that device injects; all other devices run clean).
     """
     # String shapes stay names: make_ft_sgemm resolves them through the
     # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
@@ -109,6 +114,7 @@ def ring_ft_sgemm(
         precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
     perm = [(i, (i + 1) % d) for i in range(d)]  # shift shards up the ring
+    run_local = shard_local_ft(local_ft, inject, inject_coords, ("x",))
 
     def step_fn(a_loc, b_loc, c_loc):
         my = jax.lax.axis_index("x")
@@ -116,7 +122,7 @@ def ring_ft_sgemm(
 
         def hop(t, carry):
             out, b_vis, det, unc = carry
-            res = local_ft(a_loc, b_vis, zeros, inject)
+            res = run_local(a_loc, b_vis, zeros)
             # perm shifts shards UP the ring, so after t rotations a device
             # holds the shard that started at position my - t => that
             # shard's C columns start at its owner's offset.
@@ -133,26 +139,34 @@ def ring_ft_sgemm(
         out, _, det, unc = jax.lax.fori_loop(
             0, d, hop, (out0, b_loc, jnp.int32(0), jnp.int32(0)))
         out = alpha * out + beta * c_loc
+        # Per-device counts (summed over this device's hops) keep their
+        # ring position via the P("x") layout; the psum'd globals follow.
+        dev_det = det.reshape(1)
+        dev_unc = unc.reshape(1)
         det = jax.lax.psum(det, "x")
         unc = jax.lax.psum(unc, "x")
-        return out, det.reshape(1, 1), unc.reshape(1, 1)
+        return out, det.reshape(1, 1), unc.reshape(1, 1), dev_det, dev_unc
 
     fn = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(P("x", None), P("x", None), P("x", None)),
-        out_specs=(P("x", None), P(None, None), P(None, None)),
+        out_specs=(P("x", None), P(None, None), P(None, None),
+                   P("x"), P("x")),
     )
     with telemetry.trace_span("ring_ft_sgemm"):
-        out, det, unc = jax.jit(fn)(a, b, c)
+        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
         # Ring counts psum over all hops and devices; the device label
-        # carries the ring extent for per-topology attribution.
-        telemetry.record_gemm(
+        # carries the ring extent, and the sharded per-device counts
+        # attribute each hop-summed total to its ring position.
+        telemetry.record_mesh_gemm(
             "ring_ft_sgemm", result, strategy=strategy,
             device=f"ring{d}", operands=(a, b, c),
-            alpha=alpha, beta=beta)
+            alpha=alpha, beta=beta,
+            dev_detections=dev_det, dev_uncorrectable=dev_unc,
+            axes=("x",))
     return result
 
 

@@ -56,13 +56,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.ops.attention import (
     FtAttentionResult, PV_SHAPE, QK_SHAPE)
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
 from ft_sgemm_tpu.parallel.ring import _check_divisible, make_ring_mesh
-from ft_sgemm_tpu.parallel.sharded import shard_map
+from ft_sgemm_tpu.parallel.sharded import shard_local_ft, shard_map
 
 
 def _ring_geometry(q, k, v, mesh, scale, causal, in_dtype):
@@ -97,10 +98,14 @@ def _masked_scores(s_res, sc, causal, my, t, dnum, qpos, nk_blk):
 
 def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
                    qk_shape, pv_shape, in_dtype, interpret, lq, lk, dv,
-                   dnum):
-    """The shard_map'd forward ring; returns (out, m, l, det, flags, unc)
-    with (m, l) row-sharded like the output — the residuals the
-    differentiable path's backward ring needs."""
+                   dnum, inject_coords=None):
+    """The shard_map'd forward ring; returns
+    (out, m, l, det, flags, unc, dev_det, dev_unc) with (m, l)
+    row-sharded like the output — the residuals the differentiable
+    path's backward ring needs — and the trailing pair the P("x")
+    per-device counter arrays telemetry attribution reads
+    (DESIGN.md §8). ``inject_coords=(i,)`` restricts injection to ring
+    position ``i`` (both of that device's hop GEMMs inject)."""
     inject = inject or InjectionSpec.none()
     sc_causal = causal
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
@@ -109,6 +114,8 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
     pv = make_ft_sgemm(pv_shape, alpha=1.0, beta=0.0, strategy=strategy,
                        threshold=threshold, in_dtype=in_dtype,
                        interpret=interpret)
+    run_qk = shard_local_ft(qk, inject, inject_coords, ("x",))
+    run_pv = shard_local_ft(pv, inject, inject_coords, ("x",))
     perm = [(i, (i + 1) % dnum) for i in range(dnum)]
     sc = scale
 
@@ -124,7 +131,7 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
 
         def hop(t, carry):
             m, l, o, k_vis, vt_vis, det, unc = carry
-            s_res = qk(q_loc, k_vis, zs, inject)
+            s_res = run_qk(q_loc, k_vis, zs)
             s_t = _masked_scores(s_res, sc, sc_causal, my, t, dnum, qpos,
                                  nk_blk)
             # Masked-block-safe online softmax: m_new may stay -inf while a
@@ -133,7 +140,7 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
             m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
             a = jnp.where(m == m_new, 1.0, jnp.exp(m - m_safe))
             p_t = jnp.exp(s_t - m_safe)
-            o_res = pv(p_t, vt_vis, zo, inject)
+            o_res = run_pv(p_t, vt_vis, zo)
             o = a * o + o_res.c
             l = a * l + jnp.sum(p_t, axis=1, keepdims=True)
             det = det + jnp.sum(s_res.detections) + jnp.sum(o_res.detections)
@@ -155,18 +162,22 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
         flags = jnp.sum(jnp.logical_not(
             jnp.isfinite(l) & (l > 0.0)).astype(jnp.int32))
         out = o / l
+        # Per-device counts keep their ring position via P("x") before
+        # the psums collapse the global totals.
+        dev_det = det.reshape(1)
+        dev_unc = unc.reshape(1)
         det = jax.lax.psum(det, "x")
         flags = jax.lax.psum(flags, "x")
         unc = jax.lax.psum(unc, "x")
         return (out, m, l, det.reshape(1, 1), flags.reshape(1, 1),
-                unc.reshape(1, 1))
+                unc.reshape(1, 1), dev_det, dev_unc)
 
     return shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(P("x", None), P("x", None), P(None, "x")),
         out_specs=(P("x", None), P("x", None), P("x", None), P(None, None),
-                   P(None, None), P(None, None)),
+                   P(None, None), P(None, None), P("x"), P("x")),
     )
 
 
@@ -185,6 +196,7 @@ def ring_ft_attention(
     pv_shape: KernelShape = PV_SHAPE,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    inject_coords: Optional[tuple] = None,
 ) -> FtAttentionResult:
     """Fault-tolerant ring attention over a 1-D mesh.
 
@@ -193,7 +205,10 @@ def ring_ft_attention(
     the mesh, the global corrected-fault count, and ``softmax_flags`` =
     number of rows whose online-softmax denominator ``l`` ended non-finite
     or non-positive — the streaming analog of the single-device
-    rowsum==1 invariant (detect-only; 0 on clean runs).
+    rowsum==1 invariant (detect-only; 0 on clean runs). With telemetry
+    enabled, each device's hop-summed counts are recorded against its
+    ring position and host (``telemetry.record_mesh_attention``);
+    ``inject_coords=(i,)`` restricts injection to ring position ``i``.
     """
     q, k, v, lq, lk, dv, dnum, sc = _ring_geometry(
         q, k, v, mesh, scale, causal, in_dtype)
@@ -201,11 +216,20 @@ def ring_ft_attention(
         mesh, scale=sc, causal=causal, inject=inject, strategy=strategy,
         threshold=threshold, qk_shape=qk_shape, pv_shape=pv_shape,
         in_dtype=in_dtype, interpret=interpret, lq=lq, lk=lk, dv=dv,
-        dnum=dnum)
+        dnum=dnum, inject_coords=inject_coords)
     # V rides the ring pre-transposed: the PV kernel consumes B = V^T and a
     # (dv, Lk/D) shard halves nothing but avoids a per-hop transpose.
-    out, _, _, det, flags, unc = jax.jit(fn)(q, k, jnp.swapaxes(v, 0, 1))
-    return FtAttentionResult(out, det[0, 0], flags[0, 0], unc[0, 0])
+    with telemetry.trace_span("ring_ft_attention"):
+        out, _, _, det, flags, unc, dev_det, dev_unc = jax.jit(fn)(
+            q, k, jnp.swapaxes(v, 0, 1))
+    result = FtAttentionResult(out, det[0, 0], flags[0, 0], unc[0, 0])
+    if telemetry.enabled():
+        telemetry.record_mesh_attention(
+            "ring_ft_attention", result, strategy=strategy,
+            device=f"ring{dnum}",
+            dev_detections=dev_det, dev_uncorrectable=dev_unc,
+            axes=("x",))
+    return result
 
 
 def make_ring_ft_attention_diff(
@@ -283,7 +307,8 @@ def make_ring_ft_attention_diff(
             threshold=threshold, qk_shape=qk_shape, pv_shape=pv_shape,
             in_dtype=in_dtype, interpret=interpret, lq=lq, lk=lk, dv=dv,
             dnum=dnum)
-        out, m, l, det, flags, unc = fn(q2, k2, jnp.swapaxes(v2, 0, 1))
+        out, m, l, det, flags, unc, _, _ = fn(q2, k2,
+                                              jnp.swapaxes(v2, 0, 1))
         res = FtAttentionResult(out, det[0, 0], flags[0, 0], unc[0, 0])
         # Residuals keep the CALLER's arrays (original dtype, like the
         # single-device factory): cotangents must match the primals'

@@ -78,27 +78,77 @@ def _check_divisible(name, dim, parts):
         )
 
 
-def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes):
+def shard_local_ft(local_ft, inject, inject_coords, mesh_axes):
+    """Run the local FT kernel, optionally restricting injection to the
+    one device at ``inject_coords`` (mesh coordinates along
+    ``mesh_axes``).
+
+    The single-shard mode is the attribution self-test of the
+    distributed paths: inject a known SDC on exactly one chip, then
+    assert the merged telemetry names that chip (tests; DESIGN.md §8).
+    Gating happens with ``lax.cond`` on ``axis_index`` — both branches
+    compile once, each device executes only its own — because the
+    injection spec is a trace-time constant of the kernel factory and
+    cannot vary per device any other way.
+    """
+
+    def run(a_loc, b_loc, zeros):
+        if inject_coords is None or not inject.enabled:
+            return local_ft(a_loc, b_loc, zeros, inject)
+        if len(inject_coords) != len(mesh_axes):
+            raise ValueError(
+                f"inject_coords {inject_coords} must give one coordinate "
+                f"per mesh axis {mesh_axes}")
+        is_target = jnp.bool_(True)
+        for ax, coord in zip(mesh_axes, inject_coords):
+            is_target = jnp.logical_and(
+                is_target, jax.lax.axis_index(ax) == coord)
+        return jax.lax.cond(
+            is_target,
+            lambda ops: local_ft(*ops, inject),
+            lambda ops: local_ft(*ops, InjectionSpec.none()),
+            (a_loc, b_loc, zeros))
+
+    return run
+
+
+def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes,
+                 *, mesh_axes=("x", "y"), inject_coords=None):
     """Per-device FT-GEMM step shared by the 2-D and multi-host meshes.
 
     Runs the local fused-ABFT kernel on the device's shard (corrects BEFORE
     any collective), combines K-partials over mesh axis "y" with psum or
     psum_scatter, applies alpha/beta once, and psums detection and
     uncorrectable-interval counts over ``det_axes``.
+
+    Besides the psum'd global counters, the step returns each device's
+    LOCAL detection/uncorrectable sums as size-1-per-axis arrays laid
+    out ``P(*mesh_axes)`` — the fully sharded per-device counter grids
+    whose shard placement encodes the mesh coordinates
+    (``telemetry._device_entries`` reads them back without any
+    collective). They are produced unconditionally: a few scalars per
+    device, and the HLO must not depend on whether telemetry is enabled.
+
+    ``inject_coords`` restricts injection to one device's mesh position
+    (see :func:`shard_local_ft`).
     """
+    run_local = shard_local_ft(local_ft, inject, inject_coords, mesh_axes)
+    dev_shape = (1,) * len(mesh_axes)
 
     def step(a_loc, b_loc, c_loc):
         zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
-        res = local_ft(a_loc, b_loc, zeros, inject)
+        res = run_local(a_loc, b_loc, zeros)
         if scatter_output:
             partial = jax.lax.psum_scatter(
                 res.c, "y", scatter_dimension=1, tiled=True)
         else:
             partial = jax.lax.psum(res.c, "y")
         out = alpha * partial + beta * c_loc
+        dev_det = jnp.sum(res.detections).reshape(dev_shape)
+        dev_unc = jnp.sum(res.uncorrectable).reshape(dev_shape)
         det = jax.lax.psum(res.detections, det_axes)
         unc = jax.lax.psum(res.uncorrectable, det_axes)
-        return out, det, unc
+        return out, det, unc, dev_det, dev_unc
 
     return step
 
@@ -119,13 +169,19 @@ def sharded_ft_sgemm(
     in_dtype: str = "float32",
     scatter_output: bool = False,
     interpret: Optional[bool] = None,
+    inject_coords: Optional[Tuple[int, int]] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a 2-D device mesh.
 
     Sharding: A (M, K) -> P("x", "y"); B (N, K) -> P(None, "y");
     C (M, N) -> P("x", None). Each device corrects its own K-partial
     locally, then partials ``psum`` over ``y`` and detection counts ``psum``
-    over the whole mesh.
+    over the whole mesh. With telemetry enabled, each device's local
+    counts are additionally recorded per ``(host, device, shard coords)``
+    (``telemetry.record_mesh_gemm`` — the SDC-localization view;
+    DESIGN.md §8). ``inject_coords=(i, j)`` restricts fault injection to
+    the device at mesh position ``(x=i, y=j)`` — the attribution
+    self-test.
 
     ``scatter_output=True`` replaces the ``psum`` with a ``psum_scatter``
     over ``y`` (a reduce-scatter on the ICI ring): the output lands sharded
@@ -160,26 +216,31 @@ def sharded_ft_sgemm(
         precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
     step = make_ft_step(local_ft, alpha, beta, inject, scatter_output,
-                        det_axes=("y", "x"))
+                        det_axes=("y", "x"),
+                        inject_coords=inject_coords)
 
     c_spec = P("x", "y") if scatter_output else P("x", None)
     fn = shard_map(
         step,
         mesh=mesh,
         in_specs=(P("x", "y"), P(None, "y"), c_spec),
-        out_specs=(c_spec, P(None, None), P(None, None)),
+        out_specs=(c_spec, P(None, None), P(None, None),
+                   P("x", "y"), P("x", "y")),
     )
     with telemetry.trace_span("sharded_ft_sgemm"):
-        out, det, unc = jax.jit(fn)(a, b, c)
+        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
         # Counters arrive already psum-aggregated across the mesh; the
-        # device label records the mesh extent so fleet rollups can
-        # attribute counts per mesh topology.
-        telemetry.record_gemm(
+        # device label records the mesh extent, and the fully sharded
+        # per-device grids attribute each count to the chip that
+        # produced it (host/device/coords labels — DESIGN.md §8).
+        telemetry.record_mesh_gemm(
             "sharded_ft_sgemm", result, strategy=strategy,
             device=f"mesh{mx}x{my}", operands=(a, b, c),
-            alpha=alpha, beta=beta)
+            alpha=alpha, beta=beta,
+            dev_detections=dev_det, dev_uncorrectable=dev_unc,
+            axes=("x", "y"))
     return result
 
 

@@ -173,15 +173,26 @@ def stage_row(name: str, seconds: Optional[float], *, m: int, n: int,
 
 @dataclasses.dataclass
 class RunReport:
-    """The manifest + per-stage roofline rows of one bench run."""
+    """The manifest + per-stage roofline rows of one bench run.
+
+    ``timeline`` optionally carries the run's wall-clock shape — the
+    :func:`ft_sgemm_tpu.telemetry.timeline.summarize_timeline` dict of
+    the streamed span log (per-stage wall time, in-flight work at kill
+    time, heartbeat health) — so a report renders WHERE a run's time
+    went, not just how fast each stage ran once measured.
+    """
 
     manifest: dict
     stages: List[dict] = dataclasses.field(default_factory=list)
     schema: int = SCHEMA_VERSION
+    timeline: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {"schema": self.schema, "manifest": self.manifest,
-                "stages": self.stages}
+        d = {"schema": self.schema, "manifest": self.manifest,
+             "stages": self.stages}
+        if self.timeline is not None:
+            d["timeline"] = self.timeline
+        return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
@@ -192,7 +203,8 @@ class RunReport:
             raise ValueError("not a RunReport dict (no 'manifest')")
         return RunReport(manifest=dict(d["manifest"]),
                          stages=list(d.get("stages") or []),
-                         schema=int(d.get("schema", SCHEMA_VERSION)))
+                         schema=int(d.get("schema", SCHEMA_VERSION)),
+                         timeline=d.get("timeline"))
 
     @staticmethod
     def from_json(text: str) -> "RunReport":
@@ -254,6 +266,33 @@ class RunReport:
                           "`AI` is arithmetic intensity, `ABFT overhead` "
                           "the checksum encode+check share of the "
                           "stage's FLOPs.")
+        tl = self.timeline
+        if tl and (tl.get("spans") or tl.get("in_flight")):
+            md += ["", "## Timeline", ""]
+            wall = tl.get("wall_seconds")
+            if wall is not None:
+                md.append(f"- **wall**: {wall:.1f}s over "
+                          f"{len(tl.get('spans') or [])} completed spans")
+            if tl.get("killed_at_stage"):
+                md.append(f"- **killed during**: {tl['killed_at_stage']}")
+            if tl.get("heartbeats"):
+                gap = tl.get("max_heartbeat_gap")
+                md.append(f"- **heartbeats**: {tl['heartbeats']}"
+                          + (f" (max gap {gap:.1f}s)"
+                             if gap is not None else ""))
+            md.append("")
+            md.append("| span | kind | seconds | status |")
+            md.append("|---|---|---|---|")
+            for s in tl.get("spans") or []:
+                sec = s.get("seconds")
+                md.append(
+                    f"| {s.get('name')} | {s.get('kind')} | "
+                    + (f"{sec:.2f}" if isinstance(sec, (int, float))
+                       else "—")
+                    + f" | {s.get('status') or '—'} |")
+            for s in tl.get("in_flight") or []:
+                md.append(f"| {s.get('name')} | {s.get('kind')} | — | "
+                          "in flight |")
         return "\n".join(md)
 
 

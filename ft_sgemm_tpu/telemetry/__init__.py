@@ -44,10 +44,12 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from ft_sgemm_tpu.telemetry import aggregate, timeline
 from ft_sgemm_tpu.telemetry.events import (
     FaultEvent,
     JsonlSink,
@@ -312,7 +314,9 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
                 step: Optional[int] = None, layer: Optional[str] = None,
                 device: Optional[str] = None, threshold=None,
                 operands=None, alpha: float = 1.0, beta: float = 0.0,
-                extra: Optional[dict] = None) -> Optional[FaultEvent]:
+                extra: Optional[dict] = None,
+                devices: Optional[list] = None,
+                host: Optional[int] = None) -> Optional[FaultEvent]:
     """Record one FT-GEMM call from its materialized result counters.
 
     ``result`` is an :class:`~ft_sgemm_tpu.ops.ft_sgemm.FtSgemmResult`
@@ -350,7 +354,7 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
         strategy=strategy, layer=layer, device=device,
         threshold=_float_or_none(threshold), residual=residual,
         tiles=_nonzero_tiles(getattr(result, "detections", None)),
-        extra=extra)
+        extra=extra, devices=devices or None, host=host, ts=time.time())
     reg = _STATE.registry
     labels = _series_labels(op, strategy, layer, device, encode)
     reg.counter("ft_calls", **labels).inc()
@@ -368,7 +372,9 @@ def record_attention(op: str, result, *, strategy: Optional[str] = None,
                      step: Optional[int] = None,
                      layer: Optional[str] = None,
                      device: Optional[str] = None,
-                     extra: Optional[dict] = None) -> Optional[FaultEvent]:
+                     extra: Optional[dict] = None,
+                     devices: Optional[list] = None,
+                     host: Optional[int] = None) -> Optional[FaultEvent]:
     """Record one FT-attention call (adds the softmax-stage flags the
     GEMM record has no slot for). Same skip rules as :func:`record_gemm`.
     """
@@ -390,7 +396,8 @@ def record_attention(op: str, result, *, strategy: Optional[str] = None,
         outcome=outcome, op=op, detected=det, corrected=det,
         uncorrectable=unc,
         step=_STATE.step if step is None else step,
-        strategy=strategy, layer=layer, device=device, extra=merged)
+        strategy=strategy, layer=layer, device=device, extra=merged,
+        devices=devices or None, host=host, ts=time.time())
     reg = _STATE.registry
     labels = _series_labels(op, strategy, layer, device, encode)
     reg.counter("ft_calls", **labels).inc()
@@ -400,6 +407,146 @@ def record_attention(op: str, result, *, strategy: Optional[str] = None,
     reg.counter("ft_softmax_flags", **labels).inc(flags)
     _emit(event)
     return event
+
+
+# ---------------------------------------------------------------------------
+# Distributed attribution (DESIGN.md §8)
+# ---------------------------------------------------------------------------
+
+
+def _process_index() -> Optional[int]:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no runtime: host identity unknown
+        return None
+
+
+def _device_entries(dev_detections, dev_uncorrectable,
+                    axes=None) -> Optional[list]:
+    """Per-device attribution entries from a mesh-sharded call's
+    per-device counter arrays.
+
+    The parallel paths emit, alongside their psum'd global counters, one
+    fully mesh-sharded counter array per metric — each addressable shard
+    is exactly one device's local count, and its placement index IS the
+    device's mesh coordinates. Reading ``addressable_shards`` therefore
+    (a) needs no collective, (b) yields only devices THIS process owns —
+    per-host JSONL shards partition cleanly for
+    :mod:`~ft_sgemm_tpu.telemetry.aggregate` — and (c) names the real
+    ``Device`` each count came from. Returns None for tracers (caller
+    inside jit) or anything without shard metadata.
+    """
+    import jax
+
+    if (isinstance(dev_detections, jax.core.Tracer)
+            or isinstance(dev_uncorrectable, jax.core.Tracer)):
+        return None
+    try:
+        det_shards = list(dev_detections.addressable_shards)
+        unc_by_dev = {s.device: s.data
+                      for s in dev_uncorrectable.addressable_shards}
+    except Exception:  # noqa: BLE001 — unsharded/foreign arrays: no view
+        return None
+    entries = []
+    for s in det_shards:
+        try:
+            det = int(np.sum(np.asarray(s.data)))
+            unc_data = unc_by_dev.get(s.device)
+            unc = (0 if unc_data is None
+                   else int(np.sum(np.asarray(unc_data))))
+            coords = [int(sl.start or 0) for sl in s.index]
+        except Exception:  # noqa: BLE001 — skip a shard, keep the rest
+            continue
+        dev = s.device
+        entries.append({
+            "host": int(getattr(dev, "process_index", 0)),
+            "device": str(dev),
+            "id": int(getattr(dev, "id", -1)),
+            "coords": coords,
+            "axes": list(axes) if axes else None,
+            "detected": det,
+            "uncorrectable": unc,
+        })
+    return entries or None
+
+
+def _bump_device_counters(op, strategy, entries) -> None:
+    """Per-device registry series (``ft_device_*``) — separate metric
+    names from the call-level ``ft_*`` counters, so fleet rollups by
+    device never double-count call totals."""
+    reg = _STATE.registry
+    for e in entries:
+        labels = {"op": op, "device": e["device"],
+                  "coords": ",".join(str(c) for c in e["coords"])}
+        if e.get("host") is not None:
+            labels["host"] = e["host"]
+        if strategy:
+            labels["strategy"] = strategy
+        reg.counter("ft_device_calls", **labels).inc()
+        reg.counter("ft_device_detections", **labels).inc(e["detected"])
+        reg.counter("ft_device_uncorrectable",
+                    **labels).inc(e["uncorrectable"])
+
+
+def record_mesh_gemm(op: str, result, *, dev_detections=None,
+                     dev_uncorrectable=None, axes=None,
+                     strategy: Optional[str] = None,
+                     step: Optional[int] = None,
+                     device: Optional[str] = None, threshold=None,
+                     operands=None, alpha: float = 1.0, beta: float = 0.0,
+                     extra: Optional[dict] = None) -> Optional[FaultEvent]:
+    """Record one mesh-sharded FT-GEMM call WITH per-device attribution.
+
+    Same contract as :func:`record_gemm` (one event per logical call,
+    global counters), plus: ``dev_detections`` / ``dev_uncorrectable``
+    are the call's fully mesh-sharded per-device counter arrays and
+    ``axes`` the mesh axis names; each addressable device's counts land
+    as (a) an entry in the event's ``devices`` list when nonzero and
+    (b) ``ft_device_*`` registry series labeled
+    ``(op, host, device, coords)``. The event itself lists only FAULTY
+    devices (a clean 256-chip step must not carry 256 entries); the
+    registry counts every device's calls so rates stay computable.
+    """
+    if not _STATE.enabled or _suppressed():
+        return None
+    entries = None
+    if dev_detections is not None and dev_uncorrectable is not None:
+        entries = _device_entries(dev_detections, dev_uncorrectable, axes)
+    faulty = [e for e in (entries or [])
+              if e["detected"] or e["uncorrectable"]]
+    ev = record_gemm(
+        op, result, strategy=strategy, step=step, device=device,
+        threshold=threshold, operands=operands, alpha=alpha, beta=beta,
+        extra=extra, devices=faulty, host=_process_index())
+    if ev is not None and entries:
+        _bump_device_counters(op, strategy, entries)
+    return ev
+
+
+def record_mesh_attention(op: str, result, *, dev_detections=None,
+                          dev_uncorrectable=None, axes=None,
+                          strategy: Optional[str] = None,
+                          step: Optional[int] = None,
+                          device: Optional[str] = None,
+                          extra: Optional[dict] = None
+                          ) -> Optional[FaultEvent]:
+    """Mesh-sharded analog of :func:`record_attention` — see
+    :func:`record_mesh_gemm` for the attribution semantics."""
+    if not _STATE.enabled or _suppressed():
+        return None
+    entries = None
+    if dev_detections is not None and dev_uncorrectable is not None:
+        entries = _device_entries(dev_detections, dev_uncorrectable, axes)
+    faulty = [e for e in (entries or [])
+              if e["detected"] or e["uncorrectable"]]
+    ev = record_attention(
+        op, result, strategy=strategy, step=step, device=device,
+        extra=extra, devices=faulty, host=_process_index())
+    if ev is not None and entries:
+        _bump_device_counters(op, strategy, entries)
+    return ev
 
 
 def record_step_event(outcome: str, *, op: str = "resilient_step",
@@ -415,7 +562,8 @@ def record_step_event(outcome: str, *, op: str = "resilient_step",
     event = FaultEvent(
         outcome=outcome, op=op,
         uncorrectable=int(uncorrectable),
-        step=_STATE.step if step is None else step, extra=extra)
+        step=_STATE.step if step is None else step, extra=extra,
+        ts=time.time())
     _STATE.registry.counter(
         "ft_step_events", op=op, outcome=outcome).inc()
     _emit(event)
@@ -431,6 +579,8 @@ __all__ = [
     "JsonlSink",
     "MetricsRegistry",
     "OUTCOMES",
+    "aggregate",
+    "timeline",
     "configure",
     "disable",
     "enabled",
@@ -441,6 +591,8 @@ __all__ = [
     "read_events",
     "record_attention",
     "record_gemm",
+    "record_mesh_attention",
+    "record_mesh_gemm",
     "record_step_event",
     "registry_from_events",
     "reset",

@@ -1,0 +1,152 @@
+"""Host-side aggregation: merge per-host event shards, localize by device.
+
+The distributed paths (``ft_sgemm_tpu.parallel``) record fault events
+with per-device attribution entries (``FaultEvent.devices`` — one entry
+per addressable device whose local counter was nonzero, carrying
+``(host, device, coords, axes)``; DESIGN.md §8). On a multi-host pod
+each process writes its OWN JSONL shard and only lists the devices it
+owns, so the shards partition cleanly: merging is concatenation plus a
+timestamp sort, never dedup. This module is that merge plus the two
+fleet-screening views built on it:
+
+- :func:`device_table` — per-device rollup (events, detected,
+  uncorrectable, max residual) keyed by ``(host, device)``.
+- :func:`rank_devices` — devices ordered by fault severity/rate, the
+  "which chip do I pull" list ``python -m ft_sgemm_tpu.cli attribute``
+  prints (the screening workflow of large-pod deployments,
+  arXiv:2112.09017 scale).
+
+Like :mod:`.events`, nothing here imports jax — aggregation runs on any
+host, including one with no accelerator attached.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from ft_sgemm_tpu.telemetry.events import FaultEvent, read_events
+
+DeviceKey = Tuple[Optional[int], str]
+
+
+def merge_shards(paths: Sequence) -> List[FaultEvent]:
+    """Merge per-host JSONL event shards into one stream.
+
+    Events are ordered by their wall-clock ``ts`` when present (shards
+    from different hosts interleave in real time); events without one
+    (older logs) keep their per-file order and sort before timestamped
+    ones, so pre-attribution logs still merge losslessly.
+    """
+    events: List[FaultEvent] = []
+    for path in paths:
+        events.extend(read_events(path))
+    return sorted(events,
+                  key=lambda e: (e.ts is not None, e.ts or 0.0))
+
+
+def _entry_rows(ev: FaultEvent):
+    """Per-device rows of one event: its ``devices`` attribution entries,
+    or — for single-device / pre-attribution events — the event's own
+    (host, device) labels as one synthetic entry."""
+    if ev.devices:
+        for d in ev.devices:
+            if isinstance(d, dict) and d.get("device") is not None:
+                yield d
+        return
+    if ev.device is not None:
+        yield {"host": ev.host, "device": ev.device, "coords": None,
+               "axes": None, "detected": ev.detected,
+               "uncorrectable": ev.uncorrectable}
+
+
+def device_table(events: Iterable[FaultEvent]) -> dict:
+    """Aggregate an event stream into the per-device localization view.
+
+    Returns ``{"calls": <total call events>, "devices": {(host, device):
+    {"coords", "axes", "events", "detected", "uncorrectable",
+    "max_residual"}}}``. ``events`` counts how many call events named
+    the device (its fault-rate denominator is the global call count:
+    clean calls list no devices by design, keeping pod-scale events
+    small). ``coords`` keeps the last-seen shard coordinates — a device
+    does not move between mesh positions within one log's run.
+    """
+    call_outcomes = ("clean", "corrected", "uncorrectable")
+    calls = 0
+    table: dict = {}
+    for ev in events:
+        if ev.outcome not in call_outcomes:
+            continue
+        calls += 1
+        for entry in _entry_rows(ev):
+            key: DeviceKey = (entry.get("host"), str(entry["device"]))
+            row = table.setdefault(
+                key, {"coords": None, "axes": None, "events": 0,
+                      "detected": 0, "uncorrectable": 0,
+                      "max_residual": None})
+            row["events"] += 1
+            row["detected"] += int(entry.get("detected") or 0)
+            row["uncorrectable"] += int(entry.get("uncorrectable") or 0)
+            if entry.get("coords") is not None:
+                row["coords"] = list(entry["coords"])
+            if entry.get("axes") is not None:
+                row["axes"] = list(entry["axes"])
+            if ev.residual is not None:
+                row["max_residual"] = (
+                    ev.residual if row["max_residual"] is None
+                    else max(row["max_residual"], ev.residual))
+    return {"calls": calls, "devices": table}
+
+
+def rank_devices(table: dict) -> List[Tuple[DeviceKey, dict]]:
+    """Devices of a :func:`device_table`, most suspect first.
+
+    Severity order: uncorrectable count (unverified output shipped), then
+    detected count, then fault rate (detections per call event naming the
+    device) — so a chip with few but always-faulting calls outranks a
+    busy healthy one at equal counts.
+    """
+    devs = table["devices"]
+
+    def sev(item):
+        _, row = item
+        rate = row["detected"] / row["events"] if row["events"] else 0.0
+        return (row["uncorrectable"], row["detected"], rate)
+
+    return sorted(devs.items(), key=sev, reverse=True)
+
+
+def format_device_table(table: dict, *, ranked: bool = False) -> str:
+    """Text rendering of the per-device view (``cli telemetry
+    --by-device`` / ``cli attribute``)."""
+    rows = rank_devices(table) if ranked else sorted(
+        table["devices"].items(),
+        key=lambda kv: (kv[0][0] is None, kv[0]))
+    lines = [f"calls: {table['calls']}  devices with fault events: "
+             f"{len(rows)}"]
+    if not rows:
+        lines.append("no per-device fault attribution in this stream "
+                     "(clean run, or a pre-attribution log)")
+        return "\n".join(lines)
+    width = max(len(str(dev)) for (_, dev), _ in rows)
+    header = (f"  {'host':>4s}  {'device':<{width}s}  {'coords':<12s}"
+              f"  {'events':>6s}  {'detected':>8s}  {'uncorr':>6s}"
+              f"  {'det/event':>9s}  {'max_residual':>12s}")
+    lines.append(header)
+    for (host, dev), row in rows:
+        coords = ("(" + ",".join(str(c) for c in row["coords"]) + ")"
+                  if row["coords"] is not None else "-")
+        if row["axes"] and row["coords"] is not None:
+            coords = "(" + ",".join(
+                f"{a}={c}" for a, c in zip(row["axes"], row["coords"])) + ")"
+        rate = row["detected"] / row["events"] if row["events"] else 0.0
+        resid = (f"{row['max_residual']:.3g}"
+                 if row["max_residual"] is not None else "-")
+        lines.append(
+            f"  {('-' if host is None else host):>4}  {dev:<{width}s}"
+            f"  {coords:<12s}  {row['events']:>6d}  {row['detected']:>8d}"
+            f"  {row['uncorrectable']:>6d}  {rate:>9.2f}  {resid:>12s}")
+    return "\n".join(lines)
+
+
+__all__ = ["device_table", "format_device_table", "merge_shards",
+           "rank_devices"]

@@ -50,6 +50,15 @@ class FaultEvent:
     (see ``telemetry.record_gemm(measure_residual=...)``); None when not
     measured. ``threshold`` is None when the call ran a traced/auto
     threshold whose concrete value never materialized on host.
+
+    Distributed attribution (DESIGN.md §8): ``host`` is the recording
+    process's ``jax.process_index()``; ``devices`` lists the per-device
+    entries of a mesh-sharded call whose local counter was nonzero —
+    ``{"host", "device", "id", "coords", "axes", "detected",
+    "uncorrectable"}`` with ``coords`` the shard's mesh coordinates along
+    ``axes`` — the "which chip produced this SDC" answer the fleet view
+    (``cli attribute``) ranks on. ``ts`` is the wall-clock emission time
+    (merging per-host JSONL shards orders on it).
     """
 
     outcome: str
@@ -65,6 +74,9 @@ class FaultEvent:
     residual: Optional[float] = None
     tiles: Optional[list] = None
     extra: Optional[dict] = None
+    host: Optional[int] = None
+    devices: Optional[list] = None
+    ts: Optional[float] = None
 
     def __post_init__(self):
         if self.outcome not in OUTCOMES:

@@ -1,0 +1,287 @@
+"""Live run timelines: wall-clock span records streamed to JSONL.
+
+The second observability gap after anonymous SDCs (see
+``telemetry/aggregate.py``) is run *progress*: a long bench attempt that
+gets deadline-killed used to take every in-flight measurement down with
+it — ``BENCH_r05.json`` came back ``value: null`` although earlier
+stages had finished. This module is the durable record that prevents
+that: a :class:`TimelineRecorder` streams one JSON line per event —
+stage/attempt/compile span starts and ends, heartbeats, kill markers —
+flushed (and fsync'd when possible) the moment it happens, so whatever
+kills the process, everything that *completed* is already on disk.
+``bench.py``'s worker records its stages through one of these; the
+supervisor reads the stream back on a deadline kill and salvages the
+completed measurements into a non-null artifact
+(``context.partial: true`` + ``killed_at_stage``), and
+``python -m ft_sgemm_tpu.cli timeline RUN.timeline.jsonl`` renders the
+post-hoc (or in-flight) view: per-stage wall time, heartbeat gaps, and
+the kill point.
+
+HARD CONSTRAINT — stdlib only, no package-relative imports: the bench
+supervisor must never import jax, and it loads this file directly via
+``importlib.util.spec_from_file_location`` (importing the
+``ft_sgemm_tpu`` package root would pull jax in). Keep it that way.
+
+Record schema (one JSON object per line)::
+
+    {"kind": "stage"|"attempt"|"compile"|...,   # span family
+     "name": str, "phase": "start"|"end"|"point",
+     "t": <unix seconds>,
+     # end records only:
+     "seconds": float, "status": "ok"|"fail",
+     "value": <stage result>, "error": str}
+
+``kind="heartbeat"`` and ``kind="kill"`` are point events (the worker's
+liveness beats and the supervisor's kill markers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import IO, Iterable, List, Optional
+
+SPAN_KINDS = ("stage", "attempt", "compile")
+
+
+class TimelineRecorder:
+    """Append-only JSONL span recorder, thread-safe, flushed per event.
+
+    Accepts a path (opened lazily, parent dirs created) or an open
+    text-mode file object. Every write flushes and best-effort fsyncs:
+    the whole point is that a SIGKILL one instruction later loses
+    nothing already emitted. Emission never raises — an unwritable
+    timeline degrades to losing observability, not the run.
+    """
+
+    def __init__(self, path_or_file):
+        self._lock = threading.Lock()
+        if hasattr(path_or_file, "write"):
+            self._fh: Optional[IO] = path_or_file
+            self._path = getattr(path_or_file, "name", None)
+            self._owns = False
+        else:
+            self._fh = None
+            self._path = os.fspath(path_or_file)
+            self._owns = True
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def _write(self, rec: dict) -> None:
+        try:
+            with self._lock:
+                if self._fh is None:
+                    if self._path is None:
+                        return
+                    parent = os.path.dirname(os.path.abspath(self._path))
+                    os.makedirs(parent, exist_ok=True)
+                    self._fh = open(self._path, "a", encoding="utf-8")
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+                try:
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError, AttributeError):
+                    pass  # file objects without a real fd (StringIO)
+        except (OSError, ValueError):
+            pass  # never let observability take down the run
+
+    def point(self, kind: str, name: str, **fields) -> None:
+        """One instantaneous event (heartbeat, kill marker, skip note)."""
+        rec = {"kind": kind, "name": name, "phase": "point",
+               "t": time.time()}
+        rec.update(fields)
+        self._write(rec)
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str = "stage", **fields):
+        """Bracket a unit of work: a ``start`` record lands immediately
+        (so a kill mid-span still names what was in flight), the ``end``
+        record on exit carries wall seconds and ok/fail status.
+
+        Yields a dict; set ``info["value"]`` inside the block to attach
+        the stage's result (e.g. its GFLOPS) to the end record — the
+        payload the supervisor's salvage path reads. Exceptions
+        propagate after a ``status: "fail"`` end record is written.
+        """
+        start = {"kind": kind, "name": name, "phase": "start",
+                 "t": time.time()}
+        start.update(fields)
+        self._write(start)
+        t0 = time.monotonic()
+        info: dict = {}
+        try:
+            yield info
+        except BaseException as e:
+            end = {"kind": kind, "name": name, "phase": "end",
+                   "t": time.time(),
+                   "seconds": round(time.monotonic() - t0, 6),
+                   "status": "fail", "error": f"{type(e).__name__}: {e}"}
+            self._write(end)
+            raise
+        end = {"kind": kind, "name": name, "phase": "end",
+               "t": time.time(),
+               "seconds": round(time.monotonic() - t0, 6), "status": "ok"}
+        end.update(info)
+        self._write(end)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and self._owns:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._fh = None
+
+
+def read_timeline(path) -> List[dict]:
+    """Parse a timeline JSONL file; torn/foreign lines are skipped (the
+    stream is append-only across kills, so a torn tail is expected)."""
+    out = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (isinstance(rec, dict) and "kind" in rec
+                    and "t" in rec and "name" in rec):
+                out.append(rec)
+    return out
+
+
+def summarize_timeline(records: Iterable[dict]) -> dict:
+    """Pair span starts/ends and derive the run-shape facts.
+
+    Returns::
+
+        {"spans": [{kind, name, start, end, seconds, status, value,
+                    error}, ...],        # completed, in record order
+         "in_flight": [{kind, name, start}, ...],  # started, never ended
+         "killed_at_stage": str|None,    # last in-flight "stage" span
+         "kills": [{"name": reason, "t": ...}, ...],
+         "heartbeats": int, "max_heartbeat_gap": float|None,
+         "t0": float|None, "t1": float|None, "wall_seconds": float|None,
+         "stage_values": {name: value}}  # last ok end value per stage
+
+    ``stage_values`` is the salvage payload: everything a killed run
+    measured to completion, keyed by stage name.
+    """
+    records = list(records)
+    spans: List[dict] = []
+    open_spans: dict = {}
+    kills: List[dict] = []
+    beats: List[float] = []
+    stage_values: dict = {}
+    t0 = t1 = None
+    for rec in records:
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            t0 = t if t0 is None else min(t0, t)
+            t1 = t if t1 is None else max(t1, t)
+        kind, name, phase = rec.get("kind"), rec.get("name"), rec.get("phase")
+        if kind == "heartbeat":
+            if isinstance(t, (int, float)):
+                beats.append(t)
+            continue
+        if kind == "kill":
+            kills.append({"name": name, "t": t})
+            continue
+        key = (kind, name)
+        if phase == "start":
+            open_spans.setdefault(key, []).append(rec)
+        elif phase == "end":
+            stack = open_spans.get(key)
+            start = stack.pop() if stack else None
+            spans.append({
+                "kind": kind, "name": name,
+                "start": start.get("t") if start else None, "end": t,
+                "seconds": rec.get("seconds"),
+                "status": rec.get("status"),
+                "value": rec.get("value"), "error": rec.get("error")})
+            if kind == "stage" and rec.get("status") == "ok" \
+                    and rec.get("value") is not None:
+                stage_values[name] = rec.get("value")
+    in_flight = [{"kind": k, "name": n, "start": r.get("t")}
+                 for (k, n), stack in open_spans.items() for r in stack]
+    in_flight.sort(key=lambda s: (s["start"] is None, s["start"]))
+    killed_at = None
+    for s in in_flight:
+        if s["kind"] == "stage":
+            killed_at = s["name"]  # last-started wins
+    gaps = [b - a for a, b in zip(beats, beats[1:])]
+    return {
+        "spans": spans, "in_flight": in_flight,
+        "killed_at_stage": killed_at, "kills": kills,
+        "heartbeats": len(beats),
+        "max_heartbeat_gap": round(max(gaps), 3) if gaps else None,
+        "t0": t0, "t1": t1,
+        "wall_seconds": (round(t1 - t0, 3)
+                         if t0 is not None and t1 is not None else None),
+        "stage_values": stage_values,
+    }
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return f"  {v:.1f}"
+    if isinstance(v, dict):
+        inner = ", ".join(f"{k}={vv}" for k, vv in sorted(v.items())
+                          if not isinstance(vv, (dict, list)))
+        return f"  {{{inner[:70]}}}" if inner else ""
+    return f"  {v}"
+
+
+def format_timeline(summary: dict) -> str:
+    """Human rendering of :func:`summarize_timeline` output: one line per
+    span (relative start, duration, status, attached value), then the
+    in-flight work, kill markers, and heartbeat health."""
+    lines = []
+    t0 = summary.get("t0")
+    wall = summary.get("wall_seconds")
+    lines.append(
+        f"timeline: {len(summary['spans'])} completed spans, "
+        f"{len(summary['in_flight'])} in flight"
+        + (f", {wall:.1f}s wall" if wall is not None else ""))
+
+    def rel(t):
+        return (f"{t - t0:8.1f}s" if isinstance(t, (int, float))
+                and t0 is not None else "       ?")
+
+    for s in summary["spans"]:
+        dur = s.get("seconds")
+        status = s.get("status") or "?"
+        lines.append(
+            f"  [{rel(s.get('start'))}] {s['kind']:<8s} {s['name']:<28s} "
+            f"{status:<4s}"
+            + (f" {dur:8.2f}s" if isinstance(dur, (int, float)) else "")
+            + _fmt_value(s.get("value"))
+            + (f"  ({s['error']})" if s.get("error") else ""))
+    for s in summary["in_flight"]:
+        lines.append(
+            f"  [{rel(s.get('start'))}] {s['kind']:<8s} {s['name']:<28s} "
+            "IN FLIGHT (no end record)")
+    for k in summary["kills"]:
+        lines.append(f"  [{rel(k.get('t'))}] KILL: {k['name']}")
+    if summary.get("killed_at_stage"):
+        lines.append(f"killed during stage: {summary['killed_at_stage']}")
+    if summary["heartbeats"]:
+        gap = summary.get("max_heartbeat_gap")
+        lines.append(
+            f"heartbeats: {summary['heartbeats']}"
+            + (f", max gap {gap:.1f}s" if gap is not None else ""))
+    return "\n".join(lines)
+
+
+__all__ = ["SPAN_KINDS", "TimelineRecorder", "format_timeline",
+           "read_timeline", "summarize_timeline"]

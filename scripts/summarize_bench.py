@@ -6,7 +6,14 @@ file: the backend identity, every measured stage with GFLOPS and derived
 ratios, and the errors — so a scarce tunnel window's yield can be read
 (and pasted into RESULTS.md) at a glance.
 
-Usage: python scripts/summarize_bench.py [records.jsonl ...]
+Also accepts emitted bench ARTIFACTS (the one-line ``{"metric": ...}``
+JSON object bench.py prints): the row shows metric/value/vs_baseline,
+and a salvaged partial run (``context.partial: true`` — the supervisor
+promoted the best completed stage after a deadline kill) is annotated
+PARTIAL with its kill point and completed-stage list instead of being
+mistaken for a full sweep.
+
+Usage: python scripts/summarize_bench.py [records.jsonl|artifact.json ...]
 (defaults to every .bench/records_*.jsonl, newest first)
 """
 
@@ -50,7 +57,47 @@ def _fmt(v, name=""):
     return str(v)
 
 
+def _try_artifact(path):
+    """Parse ``path`` as an emitted bench artifact; None when it is a
+    records file (JSONL stage records have no top-level "metric")."""
+    try:
+        with open(path, errors="replace") as f:
+            obj = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) and "metric" in obj else None
+
+
+def summarize_artifact(path, obj):
+    ctx = obj.get("context") or {}
+    print(f"== {os.path.basename(path)} (bench artifact)")
+    v = obj.get("value")
+    vs = obj.get("vs_baseline")
+    line = (f"   {obj.get('metric', '?'):34s} "
+            + (f"{v:10.1f} {obj.get('unit', '')}" if isinstance(
+                v, (int, float)) else f"{'null':>10s}"))
+    if isinstance(vs, (int, float)):
+        line += f"  (x{vs:.3f} vs baseline)"
+    if ctx.get("partial"):
+        line += "  PARTIAL (salvaged from a killed run)"
+    print(line)
+    if ctx.get("partial"):
+        if ctx.get("killed_at_stage"):
+            print(f"   {'killed during':34s} {ctx['killed_at_stage']}")
+        done = ctx.get("completed_stages")
+        if done:
+            print(f"   {'completed stages':34s} {', '.join(done)}")
+    for name, e in (ctx.get("errors") or {}).items():
+        first = str(e).splitlines()[0] if e else ""
+        print(f"   {name:34s} ERROR: {first[:90]}")
+    print()
+
+
 def summarize(path):
+    artifact = _try_artifact(path)
+    if artifact is not None:
+        summarize_artifact(path, artifact)
+        return
     vals, errs = _load(path)
     print(f"== {os.path.basename(path)}")
     backend = vals.get("backend")

@@ -1,0 +1,248 @@
+"""Per-device SDC localization over the simulated 8-device CPU mesh.
+
+The distributed paths must answer "WHICH chip produced this fault":
+inject on exactly one shard (``inject_coords``), then assert the merged
+telemetry names that shard's device, host, and mesh coordinates — plus
+the two-host JSONL-shard merge that reassembles a pod-wide view from
+per-process event logs (``telemetry/aggregate.py``; DESIGN.md §8).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import InjectionSpec, sgemm_reference, telemetry
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.parallel import (
+    make_mesh,
+    make_multihost_mesh,
+    make_ring_mesh,
+    multihost_ft_sgemm,
+    ring_ft_attention,
+    ring_ft_sgemm,
+    sharded_ft_sgemm,
+)
+from ft_sgemm_tpu.telemetry import aggregate, read_events
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+INJ = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _inputs(m, n, k, seed=11):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+def test_sharded_injection_localizes_to_target_shard(tmp_path):
+    """Inject on ONE shard of the 2x4 mesh: the output must still verify
+    (the fault is corrected locally) and the merged event must name
+    exactly that shard's device and (x, y) coordinates."""
+    log = tmp_path / "faults.jsonl"
+    mesh = make_mesh(8)  # 2 x 4
+    a, b, c = _inputs(256, 128, 512)
+    target = (1, 2)
+    with telemetry.session(log):
+        res = sharded_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
+                               inject=INJ, inject_coords=target)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived"
+    # Only the target device injects: local k-steps = 512/4/128 = 1.
+    assert int(res.num_detected) == 1
+
+    (ev,) = list(read_events(log))
+    assert ev.op == "sharded_ft_sgemm" and ev.outcome == "corrected"
+    assert ev.host == 0 and ev.ts is not None
+    assert ev.devices is not None and len(ev.devices) == 1
+    entry = ev.devices[0]
+    assert entry["coords"] == list(target)
+    assert entry["axes"] == ["x", "y"]
+    assert entry["detected"] == 1 and entry["uncorrectable"] == 0
+    # The entry names the REAL device at mesh position (1, 2).
+    assert entry["device"] == str(mesh.devices[1][2])
+    assert entry["host"] == 0
+
+    # Registry: per-device series carry the same localization.
+    reg = telemetry.get_registry()
+    assert reg.total("ft_device_detections") == 1
+    assert reg.total("ft_device_detections", coords="1,2") == 1
+    assert reg.total("ft_device_detections", coords="0,0") == 0
+    # Every device's calls are counted (rates stay computable)...
+    assert reg.total("ft_device_calls") == 8
+    # ...and the call-level counters are NOT double-counted.
+    assert reg.total("ft_detections") == 1
+
+
+def test_sharded_clean_run_lists_no_devices(tmp_path):
+    log = tmp_path / "clean.jsonl"
+    mesh = make_mesh(8)
+    a, b, c = _inputs(256, 128, 512, seed=5)
+    telemetry.configure(log, log_clean=True)
+    sharded_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA)
+    telemetry.disable()
+    (ev,) = list(read_events(log))
+    assert ev.outcome == "clean"
+    assert ev.devices is None  # pod-scale events stay small when clean
+    # ...but per-device call counts still landed in the registry.
+    assert telemetry.get_registry().total("ft_device_calls") == 8
+
+
+def test_ring_injection_localizes_to_ring_position(tmp_path):
+    log = tmp_path / "ring.jsonl"
+    mesh = make_ring_mesh(8)
+    a, b, c = _inputs(256, 256, 512)
+    with telemetry.session(log):
+        res = ring_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA, beta=BETA,
+                            inject=INJ, inject_coords=(3,))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived the ring"
+    assert int(res.num_detected) > 0
+    (ev,) = list(read_events(log))
+    assert ev.op == "ring_ft_sgemm"
+    (entry,) = ev.devices
+    assert entry["coords"] == [3] and entry["axes"] == ["x"]
+    assert entry["detected"] == int(res.num_detected)
+    assert entry["device"] == str(mesh.devices[3])
+
+
+def test_multihost_injection_localizes_across_host_axis(tmp_path):
+    """(host, x, y) mesh: the event entry names the 3-axis coordinates
+    including the host slot — the cross-DCN localization view."""
+    log = tmp_path / "mh.jsonl"
+    mesh = make_multihost_mesh(hosts=2)  # (2, 2, 2) over 8 CPU devices
+    a, b, c = _inputs(256, 128, 512, seed=9)
+    target = (1, 0, 1)
+    with telemetry.session(log):
+        res = multihost_ft_sgemm(a, b, c, mesh, TILE, alpha=ALPHA,
+                                 beta=BETA, inject=INJ,
+                                 inject_coords=target)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} bad"
+    assert int(res.num_detected) > 0
+    (ev,) = list(read_events(log))
+    assert ev.op == "multihost_ft_sgemm"
+    (entry,) = ev.devices
+    assert entry["coords"] == list(target)
+    assert entry["axes"] == ["host", "x", "y"]
+    assert entry["device"] == str(mesh.devices[1][0][1])
+
+
+def test_ring_attention_injection_localizes(tmp_path):
+    log = tmp_path / "attn.jsonl"
+    mesh = make_ring_mesh(8)
+    rng = np.random.default_rng(2)
+    q = rng.standard_normal((256, 64)).astype(np.float32)
+    k = rng.standard_normal((256, 64)).astype(np.float32)
+    v = rng.standard_normal((256, 64)).astype(np.float32)
+    with telemetry.session(log):
+        res = ring_ft_attention(q, k, v, mesh, inject=INJ,
+                                inject_coords=(5,))
+    assert int(res.detections) > 0
+    assert int(res.uncorrectable) == 0
+    (ev,) = list(read_events(log))
+    assert ev.op == "ring_ft_attention"
+    (entry,) = ev.devices
+    assert entry["coords"] == [5]
+    assert entry["detected"] == int(res.detections)
+
+
+def test_inject_coords_arity_mismatch_raises():
+    mesh = make_mesh(8)
+    a, b, c = _inputs(256, 128, 512)
+    with pytest.raises(ValueError, match="one coordinate per mesh axis"):
+        sharded_ft_sgemm(a, b, c, mesh, TILE, inject=INJ,
+                         inject_coords=(1,))
+
+
+# -- two-host JSONL-shard merge (telemetry/aggregate.py) --------------------
+
+
+def _shard_event(host, device, coords, detected, unc=0, ts=0.0,
+                 residual=None):
+    d = {"outcome": "uncorrectable" if unc else "corrected",
+         "op": "sharded_ft_sgemm", "detected": detected,
+         "corrected": detected, "uncorrectable": unc, "host": host,
+         "ts": ts,
+         "devices": [{"host": host, "device": device, "id": 0,
+                      "coords": coords, "axes": ["x", "y"],
+                      "detected": detected, "uncorrectable": unc}]}
+    if residual is not None:
+        d["residual"] = residual
+    return d
+
+
+def test_two_host_shard_merge_localizes_and_ranks(tmp_path):
+    """Each process of a multi-host run writes its own shard listing only
+    its devices; the merge must reassemble the pod view, order by ts,
+    and rank the faultiest chip first."""
+    shard0 = tmp_path / "host0.jsonl"
+    shard1 = tmp_path / "host1.jsonl"
+    shard0.write_text(
+        json.dumps(_shard_event(0, "TPU_0", [0, 0], 1, ts=3.0)) + "\n"
+        + json.dumps({"outcome": "clean", "op": "sharded_ft_sgemm",
+                      "host": 0, "ts": 1.0}) + "\n")
+    shard1.write_text(
+        json.dumps(_shard_event(1, "TPU_5", [1, 1], 4, unc=2, ts=2.0,
+                                residual=1.2e4)) + "\n"
+        + json.dumps(_shard_event(1, "TPU_5", [1, 1], 3, ts=4.0)) + "\n")
+    events = aggregate.merge_shards([shard0, shard1])
+    assert [e.ts for e in events] == [1.0, 2.0, 3.0, 4.0]  # interleaved
+
+    table = aggregate.device_table(events)
+    assert table["calls"] == 4
+    assert set(table["devices"]) == {(0, "TPU_0"), (1, "TPU_5")}
+    bad = table["devices"][(1, "TPU_5")]
+    assert bad["detected"] == 7 and bad["uncorrectable"] == 2
+    assert bad["events"] == 2 and bad["coords"] == [1, 1]
+    assert bad["max_residual"] == pytest.approx(1.2e4)
+
+    ranked = aggregate.rank_devices(table)
+    assert ranked[0][0] == (1, "TPU_5")  # uncorrectable outranks all
+    text = aggregate.format_device_table(table, ranked=True)
+    assert "TPU_5" in text and "(x=1,y=1)" in text
+    assert text.index("TPU_5") < text.index("TPU_0")
+
+
+def test_merge_tolerates_pre_attribution_logs(tmp_path):
+    """Old logs (no ts, no devices) still merge: the event's own device
+    label becomes a synthetic attribution row."""
+    old = tmp_path / "old.jsonl"
+    old.write_text(json.dumps({"outcome": "corrected", "op": "x",
+                               "detected": 2, "corrected": 2,
+                               "device": "mesh2x4"}) + "\n")
+    events = aggregate.merge_shards([old])
+    table = aggregate.device_table(events)
+    assert table["devices"][(None, "mesh2x4")]["detected"] == 2
+    assert "mesh2x4" in aggregate.format_device_table(table)
+
+
+def test_cli_by_device_and_attribute(tmp_path, capsys):
+    from ft_sgemm_tpu import cli
+
+    log = tmp_path / "ev.jsonl"
+    log.write_text(
+        json.dumps(_shard_event(0, "TPU_3", [0, 1], 5, ts=1.0)) + "\n")
+    assert cli.main(["cli", "telemetry", str(log), "--by-device"]) == 0
+    out = capsys.readouterr().out
+    assert "TPU_3" in out and "(x=0,y=1)" in out
+    assert cli.main(["cli", "attribute", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "TPU_3" in out and "1 shard(s)" in out
+    assert cli.main(["cli", "attribute",
+                     str(tmp_path / "missing.jsonl")]) == 2

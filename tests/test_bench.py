@@ -416,6 +416,66 @@ def test_resumed_stages_suppressed_after_reset(tmp_path):
     assert "resumed_stages" not in payload["context"], payload["context"]
 
 
+def test_deadline_kill_salvages_streamed_partials(tmp_path):
+    """The BENCH_r05 fix, end to end: the worker completes one context
+    stage (records + streamed timeline), then hangs in the next until
+    the supervisor's deadline kill. The artifact must be NON-NULL —
+    best completed measurement promoted — marked ``context.partial``
+    with the completed-stage list and the kill point's in-flight stage
+    from the timeline."""
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="8",
+                     FT_SGEMM_BENCH_WORKER_MAX="3",
+                     FT_SGEMM_BENCH_EXTEND_MAX="2",
+                     FT_SGEMM_BENCH_FAKE_PARTIAL="25600.0"))
+    payload = _payload(proc)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert payload["value"] == 25600.0
+    ctx = payload["context"]
+    assert ctx["partial"] is True
+    assert ctx["strategy"] == "rowcol"
+    assert "ft_rowcol" in ctx["completed_stages"]
+    assert ctx["killed_at_stage"] == "ft_fused"
+    assert "killed (" in ctx["errors"]["worker_rc"]
+    # The streamed timeline is on disk next to the records, renderable
+    # post hoc, and carries the supervisor's kill marker.
+    tl_path = tmp_path / "records.jsonl.timeline.jsonl"
+    assert tl_path.exists()
+    assert ctx["timeline"] == tl_path.name
+    bench = _load_bench()
+    tlmod = bench._load_timeline_mod()
+    summary = tlmod.summarize_timeline(tlmod.read_timeline(str(tl_path)))
+    assert summary["killed_at_stage"] == "ft_fused"
+    assert summary["kills"], "supervisor must write a kill marker"
+    assert summary["stage_values"]["ft_rowcol"] == 25600.0
+
+
+def test_timeline_only_salvage_recovers_lost_record(tmp_path):
+    """A stage whose timeline end landed but whose records write was
+    lost (or a records file from a dead fs) still yields a non-null
+    artifact: the supervisor merges the timeline's streamed stage values
+    into the emit."""
+    records = tmp_path / "records.jsonl"
+    records.write_text(json.dumps(
+        {"name": "backend", "ok": True,
+         "value": {"backend": "tpu", "device": "d",
+                   "num_devices": 1}}) + "\n")
+    bench = _load_bench()
+    tlmod = bench._load_timeline_mod()
+    tl = tlmod.TimelineRecorder(str(records) + ".timeline.jsonl")
+    with tl.span("ft_rowcol", kind="stage") as info:
+        info["value"] = 29100.0
+    tl.close()
+    # Deadline below MIN_ATTEMPT: emit from disk only, no worker runs.
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="5",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="99"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    assert payload["value"] == 29100.0
+    assert payload["context"]["partial"] is True
+    assert payload["context"]["strategy"] == "rowcol"
+    assert "ft_rowcol" in payload["context"]["completed_stages"]
+
+
 def test_smoke_mode_runs_both_encodes_on_cpu(tmp_path):
     """``--smoke``: the CI liveness check — one tiny size, both encode
     modes, valid JSON, rc 0 — must run without a TPU (the CPU interpret

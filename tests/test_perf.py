@@ -166,6 +166,30 @@ def test_run_report_markdown_renders_roofline_columns():
     assert "~" in md
 
 
+def test_run_report_timeline_round_trip_and_markdown():
+    """The optional ``timeline`` section (streamed-span summary from
+    telemetry.timeline) must survive JSON round-trips and render — with
+    the kill point — in the markdown report."""
+    rr = _report()
+    rr.timeline = {
+        "spans": [{"kind": "stage", "name": "ft_rowcol", "start": 0.0,
+                   "end": 1.2, "seconds": 1.2, "status": "ok",
+                   "value": 100.0, "error": None}],
+        "in_flight": [{"kind": "stage", "name": "ft_fused", "start": 1.2}],
+        "killed_at_stage": "ft_fused", "kills": [],
+        "heartbeats": 3, "max_heartbeat_gap": 10.0,
+        "t0": 0.0, "t1": 2.0, "wall_seconds": 2.0}
+    back = perf_report.RunReport.from_json(rr.to_json())
+    assert back.timeline == rr.timeline
+    md = back.to_markdown()
+    assert "## Timeline" in md
+    assert "killed during" in md and "ft_fused" in md
+    assert "in flight" in md
+    assert "heartbeats" in md
+    # Reports without a timeline render no empty section.
+    assert "## Timeline" not in _report().to_markdown()
+
+
 def test_build_manifest_survives_jax_free_process():
     m = perf_report.build_manifest(probe_jax=False)
     assert m["schema"] == perf_report.SCHEMA_VERSION

@@ -1,5 +1,6 @@
 """summarize_bench renders banked records with bench.py's semantics."""
 
+import json
 import pathlib
 import subprocess
 import sys
@@ -29,3 +30,36 @@ def test_summarizer_handles_resume_artifacts(tmp_path):
     assert "ERROR" not in out.stdout
     # Tombstones are provenance, not measurement rows.
     assert "backend_guard" not in out.stdout
+
+
+def test_summarizer_annotates_partial_salvaged_artifact(tmp_path):
+    """A salvaged bench ARTIFACT (context.partial from a deadline-killed
+    run) must render — not crash — and be annotated PARTIAL with its
+    kill point, so it is never mistaken for a full sweep."""
+    p = tmp_path / "artifact.json"
+    p.write_text(json.dumps({
+        "metric": "abft_kernel_huge_gflops_4096", "value": 25600.0,
+        "unit": "GFLOPS", "vs_baseline": 6.392,
+        "context": {"partial": True, "killed_at_stage": "ft_fused",
+                    "completed_stages": ["backend", "ft_rowcol"],
+                    "errors": {"worker_rc":
+                               "killed (supervisor deadline reached)"}},
+    }))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(p)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "25600.0" in out.stdout
+    assert "PARTIAL" in out.stdout
+    assert "ft_fused" in out.stdout
+    assert "backend, ft_rowcol" in out.stdout
+    # A full (non-partial) artifact renders without the annotation.
+    full = tmp_path / "full.json"
+    full.write_text(json.dumps({
+        "metric": "bench_smoke", "value": 1, "unit": "ok",
+        "vs_baseline": None, "context": {}}))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(full)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "PARTIAL" not in out.stdout

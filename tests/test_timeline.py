@@ -1,0 +1,142 @@
+"""Timeline recorder: streamed spans, kill-safety, summary, rendering.
+
+The contract (telemetry/timeline.py): every event is durably on disk the
+moment it is emitted (a kill loses at most the span in flight, which the
+summary then reports AS in flight), the reader tolerates torn tails, and
+the module stays stdlib-only so bench.py's jax-free supervisor can load
+it by file path.
+"""
+
+import json
+
+import pytest
+
+from ft_sgemm_tpu.telemetry.timeline import (
+    TimelineRecorder,
+    format_timeline,
+    read_timeline,
+    summarize_timeline,
+)
+
+
+def test_span_roundtrip_with_value(tmp_path):
+    path = tmp_path / "tl.jsonl"
+    tl = TimelineRecorder(path)
+    with tl.span("ft_rowcol", kind="stage") as info:
+        info["value"] = 25600.0
+    with tl.span("backend_init", kind="compile"):
+        pass
+    tl.point("heartbeat", "beat")
+    tl.close()
+    records = read_timeline(path)
+    assert [r["phase"] for r in records] == ["start", "end", "start",
+                                             "end", "point"]
+    summary = summarize_timeline(records)
+    assert [s["name"] for s in summary["spans"]] == ["ft_rowcol",
+                                                     "backend_init"]
+    assert summary["spans"][0]["status"] == "ok"
+    assert summary["spans"][0]["value"] == 25600.0
+    assert summary["stage_values"] == {"ft_rowcol": 25600.0}
+    assert summary["in_flight"] == []
+    assert summary["killed_at_stage"] is None
+    assert summary["heartbeats"] == 1
+
+
+def test_failed_span_records_error_and_reraises(tmp_path):
+    path = tmp_path / "tl.jsonl"
+    tl = TimelineRecorder(path)
+    with pytest.raises(RuntimeError, match="boom"):
+        with tl.span("xla_dot", kind="stage"):
+            raise RuntimeError("boom")
+    summary = summarize_timeline(read_timeline(path))
+    (span,) = summary["spans"]
+    assert span["status"] == "fail" and "boom" in span["error"]
+    # Failed stages are NOT salvage material.
+    assert summary["stage_values"] == {}
+
+
+def test_kill_mid_span_leaves_start_on_disk(tmp_path):
+    """The whole point: a start record lands BEFORE the work, so a
+    SIGKILL mid-stage still names what was in flight, and the kill
+    marker the supervisor appends renders with it."""
+    path = tmp_path / "tl.jsonl"
+    tl = TimelineRecorder(path)
+    with tl.span("ft_rowcol", kind="stage") as info:
+        info["value"] = 100.0
+    # Simulate a kill mid-span: start written, process dies, no end.
+    tl._write({"kind": "stage", "name": "ft_fused", "phase": "start",
+               "t": 12345.0})
+    TimelineRecorder(path).point("kill",
+                                 "killed (supervisor deadline reached)")
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "stage", "name": "torn", "phase": "e')  # torn
+    summary = summarize_timeline(read_timeline(path))
+    assert summary["killed_at_stage"] == "ft_fused"
+    assert summary["stage_values"] == {"ft_rowcol": 100.0}
+    assert [k["name"] for k in summary["kills"]] == [
+        "killed (supervisor deadline reached)"]
+    text = format_timeline(summary)
+    assert "IN FLIGHT" in text and "ft_fused" in text
+    assert "KILL" in text
+    assert "killed during stage: ft_fused" in text
+
+
+def test_heartbeat_gap_detection(tmp_path):
+    path = tmp_path / "tl.jsonl"
+    with open(path, "w") as f:
+        for t in (0.0, 10.0, 20.0, 95.0):  # one 75 s gap (wedged worker)
+            f.write(json.dumps({"kind": "heartbeat", "name": "beat",
+                                "phase": "point", "t": t}) + "\n")
+    summary = summarize_timeline(read_timeline(path))
+    assert summary["heartbeats"] == 4
+    assert summary["max_heartbeat_gap"] == pytest.approx(75.0)
+    assert "max gap 75.0s" in format_timeline(summary)
+
+
+def test_reader_skips_foreign_lines(tmp_path):
+    path = tmp_path / "tl.jsonl"
+    path.write_text('not json\n{"unrelated": 1}\n'
+                    + json.dumps({"kind": "stage", "name": "s",
+                                  "phase": "start", "t": 1.0}) + "\n")
+    records = read_timeline(path)
+    assert len(records) == 1 and records[0]["name"] == "s"
+
+
+def test_module_is_loadable_without_the_package(tmp_path):
+    """bench.py's supervisor loads timeline.py by FILE PATH (importing
+    the package root would pull jax into the jax-free supervisor); the
+    module must work standalone."""
+    import importlib.util
+    import pathlib
+
+    src = (pathlib.Path(__file__).resolve().parent.parent / "ft_sgemm_tpu"
+           / "telemetry" / "timeline.py")
+    spec = importlib.util.spec_from_file_location("_standalone_tl", src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    tl = mod.TimelineRecorder(tmp_path / "x.jsonl")
+    with tl.span("s") as info:
+        info["value"] = 1.0
+    assert mod.summarize_timeline(
+        mod.read_timeline(tmp_path / "x.jsonl"))["stage_values"] == {
+            "s": 1.0}
+
+
+def test_cli_timeline_renders_and_errors(tmp_path, capsys):
+    from ft_sgemm_tpu import cli
+
+    path = tmp_path / "tl.jsonl"
+    tl = TimelineRecorder(path)
+    with tl.span("ft_rowcol", kind="stage") as info:
+        info["value"] = 321.0
+    assert cli.main(["cli", "timeline", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "ft_rowcol" in out and "321.0" in out
+    assert cli.main(["cli", "timeline", str(path), "--format=json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["stage_values"] == {"ft_rowcol": 321.0}
+    # Empty file: exit 1; missing file: exit 2.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert cli.main(["cli", "timeline", str(empty)]) == 1
+    assert cli.main(["cli", "timeline", str(tmp_path / "nope")]) == 2
