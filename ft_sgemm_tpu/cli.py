@@ -32,12 +32,14 @@ Usage:
         [--format=text|prom] [--by-device]
     python -m ft_sgemm_tpu.cli attribute LOG.jsonl [LOG2.jsonl ...]
     python -m ft_sgemm_tpu.cli timeline RUN.timeline.jsonl \
-        [--format=text|json]
+        [--format=text|json] [--phases]
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
         [--encode=vpu|mxu] [--dtype=...] [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
-        [--dry-run]
+        [--dry-run] [--prewarm]
     python -m ft_sgemm_tpu.cli tune-show
+    python -m ft_sgemm_tpu.cli prewarm [SIZE] [--dry-run] \
+        [--timeline=RUN.timeline.jsonl]
     python -m ft_sgemm_tpu.cli report ARTIFACT.json [--format=md|json]
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
@@ -82,7 +84,20 @@ counts — DESIGN.md §8).
 suspect first — the fleet-screening "which chip do I pull" view.
 ``timeline`` renders a bench run's streamed span timeline
 (``telemetry.timeline``): per-stage wall time, heartbeat gaps, kill
-markers, in-flight work — post hoc on a killed run or live mid-run.
+markers, in-flight work — post hoc on a killed run or live mid-run;
+``--phases`` appends the wall-clock phase attribution
+(``perf.wallclock``): how much of the run's wall went to import /
+backend init / XLA compile / tuning / transfer / execute vs
+unattributed ``other``.
+
+``prewarm`` is the warm-start actuator: it AOT-compiles
+(``jit.lower().compile()``) the exact bench rep-loop computations at
+the target size into the persistent compile cache
+(``FT_SGEMM_COMPILE_CACHE``; see ``perf/compile_cache.py``), so a bench
+run inside a later tunnel window pays cache retrieval instead of XLA
+compile — the attack on the compile-dominated deadline kills of
+BENCH_r02-r05. ``tune --prewarm`` chains the same compile pass after a
+tuning run, so the winner it just persisted dispatches warm too.
 
 ``--dtype=bfloat16`` runs the whole table (vendor row, plain kernels,
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
@@ -116,6 +131,7 @@ analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
@@ -417,15 +433,21 @@ def run_attribute(paths, out=None) -> int:
     return 0
 
 
-def run_timeline(path: str, out=None, fmt: str = "text") -> int:
+def run_timeline(path: str, out=None, fmt: str = "text",
+                 phases: bool = False) -> int:
     """``timeline`` subcommand: render a streamed run timeline.
 
     Reads the append-only span JSONL a bench worker streams
     (``telemetry.timeline``) — works post-hoc on a finished/killed run
     or mid-run on a live one (in-flight spans render as such) — and
     prints per-span wall time, heartbeat gaps, and any supervisor kill
-    markers. ``--format=json`` emits the summary dict instead. Exit 2 on
-    an unreadable file, 1 when the file holds no timeline records.
+    markers. ``--phases`` appends the wall-clock phase attribution
+    (``perf.wallclock``): the run's import / backend_init / compile /
+    tune / transfer / execute / other seconds and fractions — the view
+    that turns "the run died at stage X" into "the run spent N% of its
+    wall in XLA compile". ``--format=json`` emits the summary dict
+    instead (with a ``wall`` key under ``--phases``). Exit 2 on an
+    unreadable file, 1 when the file holds no timeline records.
     """
     import json as _json
 
@@ -442,11 +464,22 @@ def run_timeline(path: str, out=None, fmt: str = "text") -> int:
               file=sys.stderr)
         return 1
     summary = tl.summarize_timeline(records)
+    attribution = None
+    if phases:
+        from ft_sgemm_tpu.perf import wallclock
+
+        attribution = wallclock.attribute_wall(summary)
     if fmt == "json":
+        if attribution is not None:
+            summary = dict(summary, wall=attribution)
         print(_json.dumps(summary, indent=1, sort_keys=True), file=out)
     else:
         print(f"timeline of {path}", file=out)
         print(tl.format_timeline(summary), file=out)
+        if attribution is not None:
+            from ft_sgemm_tpu.perf import wallclock
+
+            print(wallclock.format_wall(attribution), file=out)
     return 0
 
 
@@ -627,6 +660,18 @@ def run_tune(args, flags, out=None) -> int:
           + (f"{best['gflops']:.1f} GFLOPS" if best.get("gflops")
              else f"score {best['score']:.0f}"), file=out)
     print(f"cache written: {report.get('cache_path')}", file=out)
+    if "--prewarm" in flags:
+        # Tune-time warm start: the tuner just spent a window's minutes
+        # finding winners — AOT-compile the bench computations at this
+        # size NOW so the winner (served through the cache the line
+        # above wrote) and every comparison stage hit the persistent
+        # compile cache when the bench relaunches.
+        if m == n == k:
+            tl_path = os.environ.get("FT_SGEMM_BENCH_TIMELINE")
+            _prewarm_compile(m, tl_path=tl_path, out=out)
+        else:
+            print("tune: --prewarm skipped (bench shapes are square;"
+                  f" got {m}x{n}x{k})", file=sys.stderr)
     return 0
 
 
@@ -653,6 +698,145 @@ def run_tune_show(out=None) -> int:
     return 0
 
 
+def _prewarm_variants(size: int):
+    """The bench worker's stage set as ``(name, operand_aval, thunk)``
+    triples — thunks so a dry run builds no kernels. Mirrors
+    ``scripts/compile_probe.py`` / ``bench.py``'s worker: same factory
+    args and injection schedule, so each AOT compile banks the exact
+    executable the later timed run will request."""
+    from ft_sgemm_tpu.configs import SHAPES
+
+    f32 = jax.ShapeDtypeStruct((size, size), jnp.float32)
+    bf16 = jax.ShapeDtypeStruct((size, size), jnp.bfloat16)
+    nk = size // SHAPES["huge"].bk
+
+    def ft(**kwargs):
+        kern = make_ft_sgemm("huge", alpha=ALPHA, beta=BETA, **kwargs)
+        inj = InjectionSpec.reference_like(size, kern.shape_config.bk)
+        return lambda a, b, x: kern(a, b, x, inj).c
+
+    variants = [
+        ("xla_dot", f32,
+         lambda: (lambda a, b, x: sgemm_reference(a, b, x, ALPHA, BETA))),
+        ("plain_huge", f32,
+         lambda: make_sgemm("huge", alpha=ALPHA, beta=BETA)),
+        # The headline ladder, rung by rung, then the comparison stages.
+        ("ft_weighted_precomp", f32, lambda: ft(strategy="weighted")),
+        ("ft_rowcol", f32, lambda: ft(strategy="rowcol")),
+        ("ft_rowcol_mxu", f32,
+         lambda: ft(strategy="rowcol", encode="mxu")),
+        ("ft_fused", f32, lambda: ft(strategy="fused")),
+        ("bf16_xla", bf16,
+         lambda: (lambda a, b, x: sgemm_reference(
+             a, b, x, ALPHA, BETA, in_dtype="bfloat16"))),
+        ("bf16_plain", bf16,
+         lambda: make_sgemm("huge", alpha=ALPHA, beta=BETA,
+                            in_dtype="bfloat16")),
+        ("bf16_abft", bf16,
+         lambda: ft(strategy="weighted", in_dtype="bfloat16")),
+        ("bf16_fused", bf16,
+         lambda: ft(strategy="fused", in_dtype="bfloat16")),
+    ]
+    if nk >= 2:
+        variants.insert(3, ("ft_weighted_inkernel", f32,
+                            lambda: ft(strategy="weighted",
+                                       check_every=nk // 2)))
+    return variants
+
+
+def _prewarm_compile(size: int, tl_path=None, out=None) -> int:
+    """AOT-compile the bench stage set at ``size``, each as a recorded
+    compile span, with the persistent compile cache enabled — the shared
+    core of ``cli prewarm`` and ``cli tune --prewarm``. Returns the
+    number of variants that FAILED to compile."""
+    from ft_sgemm_tpu.perf import compile_cache
+    from ft_sgemm_tpu.utils.timing import compile_bench_loop
+
+    out = sys.stdout if out is None else out
+    status = compile_cache.enable()
+    if status["enabled"]:
+        print(f"prewarm: compile cache at {status['path']}", file=out)
+    else:
+        print(f"prewarm: compile cache OFF ({status['reason']}) — "
+              "compiles will not persist past this process", file=out)
+    recorder = None
+    if tl_path:
+        from ft_sgemm_tpu.telemetry.timeline import TimelineRecorder
+
+        recorder = TimelineRecorder(tl_path)
+    import contextlib
+
+    f32_out = jax.ShapeDtypeStruct((size, size), jnp.float32)
+    failures = 0
+    for name, ab, thunk in _prewarm_variants(size):
+        span = (recorder.span(name, kind="compile")
+                if recorder is not None else contextlib.nullcontext({}))
+        t0 = time.perf_counter()
+        try:
+            with span:
+                compile_bench_loop(thunk(), ab, ab, f32_out)
+            dt = time.perf_counter() - t0
+            print(f"prewarm: {name:<22s} OK   {dt:7.1f}s", file=out,
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — per-variant report is the job
+            failures += 1
+            print(f"prewarm: {name:<22s} FAIL {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=out, flush=True)
+    s = compile_cache.stats()
+    print(f"prewarm: cache traffic — hits {s['hits']}, misses"
+          f" {s['misses']}, files written {s['files_written']}, bytes"
+          f" written {s['bytes_written']}", file=out)
+    return failures
+
+
+def run_prewarm(args, flags, out=None) -> int:
+    """``prewarm`` subcommand: the warm-start actuator.
+
+    AOT ``lower().compile()``s the EXACT jitted rep-loop computations
+    ``bench.py`` will time at the target size (default 4096) — operands
+    are ``ShapeDtypeStruct``s, so no data touches the device and on the
+    axon tunnel only the compile service is needed — with the persistent
+    compile cache (``FT_SGEMM_COMPILE_CACHE``) enabled, so a bench
+    relaunch inside a later tunnel window resumes warm: its compile
+    phase collapses to cache retrieval and the window's minutes go to
+    measurement. Each compile is recorded as a ``compile`` span when
+    ``--timeline=PATH`` (or ``FT_SGEMM_BENCH_TIMELINE``) names a stream.
+
+    ``--dry-run`` prints the variant plan and the resolved cache
+    location without compiling anything (CPU/CI-safe: compiling 4096
+    interpret-mode kernels on CPU is not). Exit 0 iff every variant
+    compiled (or dry run).
+    """
+    out = sys.stdout if out is None else out
+    size = 4096
+    if args:
+        try:
+            size = int(args[0])
+        except ValueError:
+            print(f"ft_sgemm: prewarm SIZE must be an integer, got"
+                  f" {args[0]!r}", file=sys.stderr)
+            return 2
+    tl_path = None
+    for f in flags:
+        if f.startswith("--timeline="):
+            tl_path = f.split("=", 1)[1]
+    tl_path = tl_path or os.environ.get("FT_SGEMM_BENCH_TIMELINE")
+    if "--dry-run" in flags:
+        from ft_sgemm_tpu.perf import compile_cache
+
+        path, reason = compile_cache.resolve_dir()
+        print(f"prewarm (dry run): size {size}, compile cache "
+              + (f"at {path}" if path else f"OFF ({reason})"), file=out)
+        for name, ab, _ in _prewarm_variants(size):
+            print(f"  would compile {name:<22s} operands"
+                  f" {tuple(ab.shape)} {ab.dtype}", file=out)
+        print("dry run: nothing compiled, nothing written", file=out)
+        return 0
+    print_device_info()
+    failures = _prewarm_compile(size, tl_path=tl_path, out=out)
+    return 0 if failures == 0 else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
@@ -661,6 +845,8 @@ def main(argv=None) -> int:
         return run_tune(args[1:], flags)
     if args and args[0] == "tune-show":
         return run_tune_show()
+    if args and args[0] == "prewarm":
+        return run_prewarm(args[1:], flags)
     if args and args[0] == "telemetry":
         if len(args) < 2:
             print(__doc__)
@@ -692,7 +878,7 @@ def main(argv=None) -> int:
                     print(f"--format must be text or json, got {fmt!r}",
                           file=sys.stderr)
                     return 2
-        return run_timeline(args[1], fmt=fmt)
+        return run_timeline(args[1], fmt=fmt, phases="--phases" in flags)
     if args and args[0] == "report":
         if len(args) < 2:
             print(__doc__)

@@ -179,6 +179,7 @@ def multihost_ft_sgemm(
     scatter_output: bool = False,
     interpret: Optional[bool] = None,
     inject_coords: Optional[Tuple[int, int, int]] = None,
+    donate_c: bool = False,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a ("host", "x", "y") mesh.
 
@@ -195,7 +196,10 @@ def multihost_ft_sgemm(
     ``telemetry.aggregate.merge_shards`` reassembles the pod-wide view
     without dedup (DESIGN.md §8). ``inject_coords=(h, i, j)`` restricts
     injection to the device at that mesh position — the cross-host
-    localization self-test.
+    localization self-test. ``donate_c=True`` donates C's buffer to the
+    output at the jit boundary (C is read once by the ``beta*C``
+    epilogue; the caller's ``c`` is invalidated — see
+    :func:`~ft_sgemm_tpu.parallel.sharded.sharded_ft_sgemm`).
     """
     # Keep string shapes as names: make_ft_sgemm resolves them through the
     # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
@@ -232,8 +236,9 @@ def multihost_ft_sgemm(
         out_specs=(c_spec, P(None, None), P(None, None),
                    P("host", "x", "y"), P("host", "x", "y")),
     )
+    jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
     with telemetry.trace_span("multihost_ft_sgemm"):
-        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
+        out, det, unc, dev_det, dev_unc = jax.jit(fn, **jit_kwargs)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
         # Each process attributes ITS addressable devices' counts; the

@@ -82,6 +82,7 @@ def ring_ft_sgemm(
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     inject_coords: Optional[tuple] = None,
+    donate_c: bool = False,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` as a ring collective matmul.
 
@@ -92,6 +93,11 @@ def ring_ft_sgemm(
     its ring position and host when telemetry is enabled, DESIGN.md §8).
     ``inject_coords=(i,)`` restricts injection to ring position ``i``
     (every hop on that device injects; all other devices run clean).
+    ``donate_c=True`` donates C's buffer to the output at the jit
+    boundary — C is read once by the ``beta*C`` epilogue and the output
+    shares its P("x", None) sharding, so XLA reuses the HBM buffer
+    (the caller's ``c`` is invalidated; see
+    :func:`~ft_sgemm_tpu.parallel.sharded.sharded_ft_sgemm`).
     """
     # String shapes stay names: make_ft_sgemm resolves them through the
     # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
@@ -154,8 +160,9 @@ def ring_ft_sgemm(
         out_specs=(P("x", None), P(None, None), P(None, None),
                    P("x"), P("x")),
     )
+    jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
     with telemetry.trace_span("ring_ft_sgemm"):
-        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
+        out, det, unc, dev_det, dev_unc = jax.jit(fn, **jit_kwargs)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
         # Ring counts psum over all hops and devices; the device label
@@ -182,8 +189,12 @@ def ring_sgemm(
     precision: str = "highest",
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    donate_c: bool = False,
 ) -> jax.Array:
-    """Plain (non-FT) ring collective matmul with the same layout."""
+    """Plain (non-FT) ring collective matmul with the same layout.
+
+    ``donate_c=True`` donates C's buffer to the output at the jit
+    boundary (caller's ``c`` invalidated)."""
     cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
     a = jnp.asarray(a, cast_dtype)
     b = jnp.asarray(b, cast_dtype)
@@ -220,7 +231,8 @@ def ring_sgemm(
         in_specs=(P("x", None), P("x", None), P("x", None)),
         out_specs=P("x", None),
     )
-    return jax.jit(fn)(a, b, c)
+    jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
+    return jax.jit(fn, **jit_kwargs)(a, b, c)
 
 
 __all__ = ["make_ring_mesh", "ring_ft_sgemm", "ring_sgemm"]

@@ -170,6 +170,7 @@ def sharded_ft_sgemm(
     scatter_output: bool = False,
     interpret: Optional[bool] = None,
     inject_coords: Optional[Tuple[int, int]] = None,
+    donate_c: bool = False,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a 2-D device mesh.
 
@@ -191,6 +192,17 @@ def sharded_ft_sgemm(
     This is the layout for outputs that feed further sharded computation;
     the returned array is still the assembled global C (XLA keeps it
     sharded until the caller forces it).
+
+    ``donate_c=True`` donates the C operand's buffer to the output at
+    the jit boundary (the PR-3 ``input_output_aliases`` C->output
+    aliasing inside the per-device Pallas kernel, extended to the OUTER
+    call): C is read exactly once by the ``beta*C`` epilogue and the
+    output shares its sharding (when ``scatter_output=False``), so XLA
+    reuses the HBM buffer instead of allocating a second (M, N) array
+    per call — the natural contract for an in-place-style GEMM update.
+    The caller's ``c`` array is invalidated by the call (jax donation
+    semantics); pass a fresh/numpy C or accept the invalidation. Off by
+    default for drop-in compatibility.
     """
     # String shapes stay names: make_ft_sgemm resolves them through the
     # per-dtype tile overrides (configs.BF16_TILE_OVERRIDES).
@@ -227,8 +239,9 @@ def sharded_ft_sgemm(
         out_specs=(c_spec, P(None, None), P(None, None),
                    P("x", "y"), P("x", "y")),
     )
+    jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
     with telemetry.trace_span("sharded_ft_sgemm"):
-        out, det, unc, dev_det, dev_unc = jax.jit(fn)(a, b, c)
+        out, det, unc, dev_det, dev_unc = jax.jit(fn, **jit_kwargs)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
         # Counters arrive already psum-aggregated across the mesh; the
@@ -256,8 +269,13 @@ def sharded_sgemm(
     precision: str = "highest",
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    donate_c: bool = False,
 ) -> jax.Array:
-    """Plain (non-FT) mesh-sharded SGEMM with the same layout."""
+    """Plain (non-FT) mesh-sharded SGEMM with the same layout.
+
+    ``donate_c=True`` donates C's buffer to the output at the jit
+    boundary (see :func:`sharded_ft_sgemm`); the caller's ``c`` is
+    invalidated."""
     cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
     a = jnp.asarray(a, cast_dtype)
     b = jnp.asarray(b, cast_dtype)
@@ -280,4 +298,5 @@ def sharded_sgemm(
         in_specs=(P("x", "y"), P(None, "y"), P("x", None)),
         out_specs=P("x", None),
     )
-    return jax.jit(fn)(a, b, c)
+    jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
+    return jax.jit(fn, **jit_kwargs)(a, b, c)

@@ -18,6 +18,14 @@ whether a candidate artifact regressed against a baseline:
 - :mod:`.compare` — A/B artifact comparison under a relative-delta
   tolerance: improvement / within-noise / regression / incomparable
   verdicts and the CI exit-code contract. Pure Python, no jax.
+- :mod:`.wallclock` — per-run wall-clock attribution: timeline spans
+  rolled up into {import, backend_init, compile, tune, transfer,
+  execute, other} fractions (``cli timeline --phases``, the RunReport
+  "Wall attribution" section, ``wall.*`` registry series). Pure Python.
+- :mod:`.compile_cache` — the persistent XLA compile cache as a
+  first-class observable: ``FT_SGEMM_COMPILE_CACHE`` location control,
+  hit/miss/bytes-written counting via ``jax.monitoring`` events, and
+  the named-reason enable status bench artifacts record.
 
 Importing this package never imports jax (the bench supervisor's
 constraint); modules that need it import lazily inside functions.
@@ -28,7 +36,14 @@ CLI: ``python -m ft_sgemm_tpu.cli report ARTIFACT.json`` and
 
 from __future__ import annotations
 
-from ft_sgemm_tpu.perf import compare, hlo, report, roofline
+from ft_sgemm_tpu.perf import (
+    compare,
+    compile_cache,
+    hlo,
+    report,
+    roofline,
+    wallclock,
+)
 from ft_sgemm_tpu.perf.compare import (
     DEFAULT_TOLERANCE,
     VERDICTS,
@@ -50,6 +65,7 @@ from ft_sgemm_tpu.perf.roofline import (
     find_spec,
     roofline_summary,
 )
+from ft_sgemm_tpu.perf.wallclock import attribute_wall
 
 __all__ = [
     "DEFAULT_TOLERANCE",
@@ -58,8 +74,10 @@ __all__ = [
     "RunReport",
     "VERDICTS",
     "abft_fractions",
+    "attribute_wall",
     "build_manifest",
     "compare",
+    "compile_cache",
     "exit_code",
     "extract_stages",
     "find_spec",
@@ -71,4 +89,5 @@ __all__ = [
     "roofline",
     "roofline_summary",
     "stage_row",
+    "wallclock",
 ]

@@ -1,0 +1,269 @@
+"""Persistent XLA compile-cache observability and control.
+
+``bench.py`` has configured ``jax_compilation_cache_dir`` since round 3 —
+silently: a fixed repo-local path, every setup failure swallowed
+anonymously, and no record of whether a run ever HIT the cache. Tunnel
+windows are ~20 minutes and the 4096 compiles are the prime suspect for
+every deadline-killed round, so the cache is promoted here to a
+first-class, observable module:
+
+- **One env-overridable location** (``FT_SGEMM_COMPILE_CACHE``), keyed
+  alongside the tuner cache under ``~/.cache/ft_sgemm_tpu/`` by default —
+  XLA keys entries by module content + compile options, so sharing one
+  directory across code versions is safe by construction (unlike the
+  bench's value records, which stay code-version keyed). ``0``/``off``
+  disables (the hermetic test/CI pin, mirroring ``FT_SGEMM_TUNER_CACHE``'s
+  conftest pattern).
+- **Counted, not guessed**: a ``jax.monitoring`` event listener counts
+  the runtime's own ``/jax/compilation_cache/`` hit/miss/request events,
+  and a directory snapshot at :func:`enable` time yields files/bytes
+  written since. :func:`stats` is what bench artifacts and RunReport
+  manifests embed; :func:`record` mirrors it into the telemetry registry
+  as ``compile_cache.*`` when enabled.
+- **Named failure, never a crash**: :func:`enable` returns a status dict
+  whose ``reason`` says exactly why caching is off (env pin, unwritable
+  dir, jax too old) instead of swallowing the exception — the
+  ``compile_cache_enabled`` / ``compile_cache_reason`` artifact context
+  fields come straight from it.
+
+jax is imported lazily inside :func:`enable`; importing this module (or
+:mod:`ft_sgemm_tpu.perf`) stays jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import stat as _stat
+import threading
+from typing import Optional
+
+ENV_COMPILE_CACHE = "FT_SGEMM_COMPILE_CACHE"
+_OFF_VALUES = ("0", "off", "false", "no")
+
+# The runtime's own cache telemetry (jax._src.compiler): one event per
+# compile request that consulted the cache, one per hit, one per miss.
+_EVENT_PREFIX = "/jax/compilation_cache/"
+_EVENT_MAP = {
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+}
+
+_LOCK = threading.Lock()
+_STATE = {
+    "enabled": False,
+    "path": None,
+    "reason": "enable() never called",
+    "listener_installed": False,
+    "events": {"hits": 0, "misses": 0, "requests": 0},
+    "baseline": None,  # {"files", "bytes"} dir snapshot at enable time
+}
+
+
+def default_cache_dir() -> str:
+    """The default cache directory — alongside the tuner cache."""
+    return os.path.join(os.path.expanduser("~"), ".cache", "ft_sgemm_tpu",
+                        "jaxcache")
+
+
+def resolve_dir(default: Optional[str] = None):
+    """``(path_or_None, reason_or_None)`` for the active cache location.
+
+    Resolution: ``FT_SGEMM_COMPILE_CACHE`` wins (a path points there; an
+    off-value disables with a named reason), then the caller's
+    ``default``, then :func:`default_cache_dir`. Pure — no filesystem or
+    jax touched."""
+    env = os.environ.get(ENV_COMPILE_CACHE)
+    if env:
+        if env.lower() in _OFF_VALUES:
+            return None, f"disabled by {ENV_COMPILE_CACHE}={env}"
+        return env, None
+    return (default or default_cache_dir()), None
+
+
+def _on_event(event: str, **kwargs) -> None:
+    key = _EVENT_MAP.get(event)
+    if key is None:
+        return
+    with _LOCK:
+        _STATE["events"][key] += 1
+
+
+def _install_listener() -> None:
+    """Register the jax.monitoring event listener once per process."""
+    with _LOCK:
+        if _STATE["listener_installed"]:
+            return
+        _STATE["listener_installed"] = True
+    try:
+        from jax import monitoring
+    except ImportError:  # older layout
+        from jax._src import monitoring  # type: ignore
+    monitoring.register_event_listener(_on_event)
+
+
+def _snapshot(path: str) -> Optional[dict]:
+    """``{"files", "bytes"}`` of the regular files under ``path``."""
+    files = 0
+    size = 0
+    try:
+        for name in os.listdir(path):
+            try:
+                st = os.stat(os.path.join(path, name))
+            except OSError:
+                continue
+            if _stat.S_ISREG(st.st_mode):
+                files += 1
+                size += st.st_size
+    except OSError:
+        return None
+    return {"files": files, "bytes": size}
+
+
+def enable(default: Optional[str] = None, *,
+           min_compile_time_secs: float = 0.0) -> dict:
+    """Point jax's persistent compilation cache at the resolved dir.
+
+    Returns :func:`status` (``{"enabled", "path", "reason"}``) and never
+    raises: an env pin, an unwritable directory, or a jax without the
+    config knob all land as ``enabled: False`` with a NAMED reason. Hit
+    and miss counters reset here, and the directory is snapshotted so
+    :func:`stats` can report bytes written by this run.
+
+    ``min_compile_time_secs`` defaults to 0: disk is cheap, tunnel
+    windows are not — every executable is worth banking (the bench's old
+    block used 0.5 s, which skips exactly the small-kernel compiles a
+    warm CI run needs to prove hits on).
+    """
+    path, reason = resolve_dir(default)
+    if path is None:
+        with _LOCK:
+            _STATE.update(enabled=False, path=None, reason=reason)
+        return status()
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        # Probe writability up front: jax swallows cache write errors per
+        # entry, which would report a "working" cache that banks nothing.
+        probe = os.path.join(path, ".writable")
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              0)
+        except Exception:  # noqa: BLE001 — knob absent on some versions
+            pass
+        try:
+            # jax latches a per-process used/unused decision at the FIRST
+            # compile (compilation_cache._cache_checked): any compile
+            # before this enable() — a suite's earlier tests, a library
+            # warmup — pins the cache off for good. Reset to pristine so
+            # the next compile re-evaluates against the dir just
+            # configured (disk content is untouched; only in-memory
+            # latches drop).
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — best effort, internal API
+            pass
+        _install_listener()
+        with _LOCK:
+            _STATE.update(enabled=True, path=path, reason=None,
+                          baseline=_snapshot(path))
+            _STATE["events"] = {"hits": 0, "misses": 0, "requests": 0}
+    except Exception as e:  # noqa: BLE001 — named failure, never a crash
+        with _LOCK:
+            _STATE.update(enabled=False, path=path,
+                          reason=f"{type(e).__name__}: {e}")
+    return status()
+
+
+def disable() -> dict:
+    """Turn the persistent cache back off (tests; the config is process
+    global, so a suite that enabled it must restore the default)."""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        # Drop the initialized cache object + used-latch too: without
+        # this, compiles after disable() keep writing to the old dir.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001
+        pass
+    with _LOCK:
+        _STATE.update(enabled=False, reason="disabled by disable()")
+    return status()
+
+
+def status() -> dict:
+    """The enable-state triple bench artifacts record:
+    ``{"enabled", "path", "reason"}``."""
+    with _LOCK:
+        return {"enabled": _STATE["enabled"], "path": _STATE["path"],
+                "reason": _STATE["reason"]}
+
+
+def stats() -> dict:
+    """Everything a run knows about its compile-cache traffic.
+
+    ``{"enabled", "path", "reason", "hits", "misses", "requests",
+    "files_written", "bytes_written"}`` — hits/misses/requests from the
+    runtime's own events since :func:`enable`; files/bytes from the
+    directory-snapshot diff (clamped at 0: a concurrent prune must not
+    produce negative writes). Never raises."""
+    with _LOCK:
+        out = {"enabled": _STATE["enabled"], "path": _STATE["path"],
+               "reason": _STATE["reason"]}
+        out.update(_STATE["events"])
+        baseline = _STATE["baseline"]
+        path = _STATE["path"]
+    now = _snapshot(path) if (path and baseline is not None) else None
+    if now is not None and baseline is not None:
+        out["files_written"] = max(0, now["files"] - baseline["files"])
+        out["bytes_written"] = max(0, now["bytes"] - baseline["bytes"])
+    else:
+        out["files_written"] = None
+        out["bytes_written"] = None
+    return out
+
+
+def record(registry=None) -> None:
+    """Mirror :func:`stats` into the telemetry registry as
+    ``compile_cache.*`` gauges (explicit registry, or the active one when
+    telemetry is enabled; otherwise a no-op)."""
+    try:
+        if registry is None:
+            from ft_sgemm_tpu import telemetry
+
+            if not telemetry.enabled():
+                return
+            registry = telemetry.get_registry()
+        s = stats()
+        registry.gauge("compile_cache.enabled").set(
+            1.0 if s["enabled"] else 0.0)
+        for key in ("hits", "misses", "requests", "files_written",
+                    "bytes_written"):
+            if isinstance(s.get(key), (int, float)):
+                registry.gauge(f"compile_cache.{key}").set(float(s[key]))
+    except Exception:  # noqa: BLE001 — observability never kills a run
+        pass
+
+
+def _reset_for_tests() -> None:
+    """Zero the module state (the listener stays installed — jax has no
+    unregister API; its counts simply restart from the next enable)."""
+    with _LOCK:
+        _STATE.update(enabled=False, path=None,
+                      reason="enable() never called", baseline=None)
+        _STATE["events"] = {"hits": 0, "misses": 0, "requests": 0}
+
+
+__all__ = ["ENV_COMPILE_CACHE", "default_cache_dir", "disable", "enable",
+           "record", "resolve_dir", "stats", "status"]

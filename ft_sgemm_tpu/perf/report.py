@@ -179,19 +179,26 @@ class RunReport:
     :func:`ft_sgemm_tpu.telemetry.timeline.summarize_timeline` dict of
     the streamed span log (per-stage wall time, in-flight work at kill
     time, heartbeat health) — so a report renders WHERE a run's time
-    went, not just how fast each stage ran once measured.
+    went, not just how fast each stage ran once measured. ``wall`` is
+    the phase rollup of that same timeline
+    (:func:`ft_sgemm_tpu.perf.wallclock.attribute_wall`): the
+    import/backend_init/compile/tune/transfer/execute/other fractions
+    the "Wall attribution" section renders.
     """
 
     manifest: dict
     stages: List[dict] = dataclasses.field(default_factory=list)
     schema: int = SCHEMA_VERSION
     timeline: Optional[dict] = None
+    wall: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = {"schema": self.schema, "manifest": self.manifest,
              "stages": self.stages}
         if self.timeline is not None:
             d["timeline"] = self.timeline
+        if self.wall is not None:
+            d["wall"] = self.wall
         return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -204,7 +211,8 @@ class RunReport:
         return RunReport(manifest=dict(d["manifest"]),
                          stages=list(d.get("stages") or []),
                          schema=int(d.get("schema", SCHEMA_VERSION)),
-                         timeline=d.get("timeline"))
+                         timeline=d.get("timeline"),
+                         wall=d.get("wall"))
 
     @staticmethod
     def from_json(text: str) -> "RunReport":
@@ -266,6 +274,31 @@ class RunReport:
                           "`AI` is arithmetic intensity, `ABFT overhead` "
                           "the checksum encode+check share of the "
                           "stage's FLOPs.")
+        wa = self.wall
+        if wa and wa.get("fractions"):
+            md += ["", "## Wall attribution", ""]
+            wall = wa.get("wall_seconds")
+            if wall is not None:
+                md.append(f"- **wall**: {wall:.1f}s")
+            md.append("")
+            md.append("| phase | seconds | fraction |")
+            md.append("|---|---|---|")
+            secs = wa.get("seconds") or {}
+            order = sorted(wa["fractions"],
+                           key=lambda p: -(secs.get(p) or 0.0))
+            for phase in order:
+                sec = secs.get(phase)
+                frac = wa["fractions"].get(phase)
+                if not sec and not frac:
+                    continue
+                md.append(
+                    f"| {phase} | "
+                    + (f"{sec:.2f}" if isinstance(sec, (int, float))
+                       else "—")
+                    + " | "
+                    + (f"{100 * frac:.1f}%"
+                       if isinstance(frac, (int, float)) else "—")
+                    + " |")
         tl = self.timeline
         if tl and (tl.get("spans") or tl.get("in_flight")):
             md += ["", "## Timeline", ""]

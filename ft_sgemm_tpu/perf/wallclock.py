@@ -1,0 +1,207 @@
+"""Wall-clock phase attribution: where a run's time actually went.
+
+Five rounds of the headline bench died on the supervisor deadline at the
+4096 ``abft_kernel_huge`` stage, and the PR-4/5 timelines say *where*
+the wall went but not *why*: "almost certainly XLA compile" stayed a
+guess because no layer rolled the streamed spans up into compile vs
+execute vs everything-else fractions. This module is that rollup. It
+consumes a :func:`ft_sgemm_tpu.telemetry.timeline.summarize_timeline`
+summary — whose stage spans now carry the ``compile_seconds`` /
+``execute_seconds`` split that ``utils.timing.bench_seconds_per_call``
+measures via the explicit ``lower()``/``.compile()`` separation — and
+attributes every attributed second to one of the phase buckets:
+
+    import        the jax import itself (``import_jax`` compile spans)
+    backend_init  device discovery / PJRT plugin init (the tunnel killer)
+    compile       lower + XLA/Mosaic compile wall (incl. cache retrieval)
+    tune          autotuner search spans
+    transfer      host->device input staging (``device_put_inputs``)
+    execute       measured device execution
+    other         wall the spans don't explain (scheduling, emit, gaps)
+
+Fractions are guaranteed to sum to <= 1: unattributed wall lands in the
+explicit ``other`` bucket, and if spans overlap (double-booked wall) the
+denominator grows to the attributed total instead of letting a fraction
+exceed 1. Surfaced in ``cli timeline --phases``, the RunReport "Wall
+attribution" section, and — when telemetry is enabled — ``wall.*``
+registry series.
+
+Pure stdlib, no jax: readers and renderers (including the jax-free bench
+supervisor's tooling) can import this from any process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+PHASES = ("import", "backend_init", "compile", "tune", "transfer",
+          "execute", "other")
+
+# Span names (of kind="compile") that are really their own phase: the
+# bench worker streams the jax import and the backend probe as compile
+# spans so they land on the timeline even when no kernel compiles.
+_COMPILE_NAME_PHASES = {
+    "import_jax": "import",
+    "backend_init": "backend_init",
+    # Cache setup is bookkeeping, not XLA compile wall.
+    "compile_cache_setup": "other",
+}
+
+# Stage names that are pure host->device staging, not measurement.
+_TRANSFER_STAGES = ("device_put_inputs",)
+
+
+def span_phase_seconds(span: dict) -> dict:
+    """One completed span -> ``{phase: seconds}``.
+
+    Envelope spans (``kind="attempt"``) attribute nothing — they bracket
+    the leaf spans that do. A stage span with a recorded
+    compile/execute split is decomposed (clamped so the parts never
+    exceed the span); one without a split is all ``execute`` (it was
+    measured device work as far as the timeline knows).
+    """
+    kind = span.get("kind")
+    name = span.get("name") or ""
+    sec = span.get("seconds")
+    if not isinstance(sec, (int, float)) or sec <= 0:
+        return {}
+    if kind == "attempt":
+        return {}
+    if kind == "compile":
+        return {_COMPILE_NAME_PHASES.get(name, "compile"): float(sec)}
+    if kind == "tune" or name.startswith("tune"):
+        return {"tune": float(sec)}
+    if kind == "stage":
+        if name in _TRANSFER_STAGES:
+            return {"transfer": float(sec)}
+        comp = span.get("compile_seconds")
+        if isinstance(comp, (int, float)):
+            comp = min(max(float(comp), 0.0), float(sec))
+            lower = span.get("lower_seconds")
+            if isinstance(lower, (int, float)):
+                # Tracing/lowering is compile-side wall too.
+                comp = min(comp + max(float(lower), 0.0), float(sec))
+            ex = span.get("execute_seconds")
+            if isinstance(ex, (int, float)):
+                ex = min(max(float(ex), 0.0), float(sec) - comp)
+            else:
+                ex = float(sec) - comp
+            out = {"compile": comp, "execute": ex}
+            rest = float(sec) - comp - ex
+            if rest > 1e-9:
+                out["other"] = rest
+            return out
+        return {"execute": float(sec)}
+    return {"other": float(sec)}
+
+
+def _drop_double_counted(spans: list) -> list:
+    """Filter spans that envelop other spans in the list.
+
+    The bench worker nests each headline-ladder rung span
+    (``ft_headline[...]``) inside the outer ``ft_headline`` span;
+    attributing both would double-book the rung wall. When rung spans
+    are present the envelope is dropped and the rungs attribute.
+    """
+    has_rungs = any(isinstance(s.get("name"), str)
+                    and s["name"].startswith("ft_headline[")
+                    for s in spans)
+    if not has_rungs:
+        return spans
+    return [s for s in spans if s.get("name") != "ft_headline"]
+
+
+def attribute_wall(summary: dict,
+                   wall_seconds: Optional[float] = None) -> dict:
+    """Roll a timeline summary up into per-phase seconds and fractions.
+
+    Returns::
+
+        {"wall_seconds": float|None,
+         "seconds":   {phase: float},   # every phase present, 0.0 incl.
+         "fractions": {phase: float}}   # sum <= 1.0 by construction
+
+    ``wall_seconds`` overrides the summary's own ``wall_seconds`` (e.g.
+    a supervisor that knows the true run wall including pre-import
+    time). Unattributed wall is the explicit ``other`` bucket; if the
+    spans overlap past the wall (double-booked time), the attributed
+    total becomes the denominator so no fraction can exceed 1.
+    """
+    spans = _drop_double_counted(list(summary.get("spans") or []))
+    seconds = {p: 0.0 for p in PHASES}
+    for span in spans:
+        for phase, sec in span_phase_seconds(span).items():
+            seconds[phase] += sec
+    attributed = sum(seconds.values())
+    wall = wall_seconds if wall_seconds is not None \
+        else summary.get("wall_seconds")
+    if isinstance(wall, (int, float)) and wall > 0:
+        gap = float(wall) - attributed
+        if gap > 0:
+            seconds["other"] += gap
+            denom = float(wall)
+        else:
+            denom = attributed  # overlapping spans: never report > 100%
+    else:
+        wall = attributed if attributed > 0 else None
+        denom = attributed
+    # Floor (not round) to 4 places: independently ROUNDING each phase
+    # can push the reported sum to 1.0001, breaking the sum<=1 contract
+    # the tests pin; flooring can only lose <=1e-4 per phase.
+    fractions = {p: (int(seconds[p] / denom * 10000) / 10000.0
+                     if denom else 0.0)
+                 for p in PHASES}
+    return {
+        "wall_seconds": round(float(wall), 3) if wall else None,
+        "seconds": {p: round(v, 3) for p, v in seconds.items()},
+        "fractions": fractions,
+    }
+
+
+def format_wall(attribution: dict) -> str:
+    """Human rendering: one line per phase, largest-share first."""
+    wall = attribution.get("wall_seconds")
+    lines = ["wall attribution"
+             + (f" ({wall:.1f}s wall)" if isinstance(wall, (int, float))
+                else "")]
+    seconds = attribution.get("seconds") or {}
+    fractions = attribution.get("fractions") or {}
+    for phase in sorted(PHASES, key=lambda p: -seconds.get(p, 0.0)):
+        sec = seconds.get(phase, 0.0)
+        if sec <= 0:
+            continue
+        frac = fractions.get(phase, 0.0)
+        lines.append(f"  {phase:<12s} {100 * frac:5.1f}%  {sec:8.2f}s")
+    if len(lines) == 1:
+        lines.append("  (no attributable spans)")
+    return "\n".join(lines)
+
+
+def record_wall(attribution: dict, registry=None) -> None:
+    """Mirror one attribution into the telemetry registry as ``wall.*``
+    gauges (``wall.<phase>_seconds`` / ``wall.<phase>_fraction``), the
+    subsystem's usual explicit-registry-or-enabled convention. No-op —
+    never an exception — when telemetry is off and no registry given."""
+    try:
+        if registry is None:
+            from ft_sgemm_tpu import telemetry
+
+            if not telemetry.enabled():
+                return
+            registry = telemetry.get_registry()
+        for phase in PHASES:
+            sec = (attribution.get("seconds") or {}).get(phase)
+            frac = (attribution.get("fractions") or {}).get(phase)
+            if isinstance(sec, (int, float)):
+                registry.gauge(f"wall.{phase}_seconds").set(float(sec))
+            if isinstance(frac, (int, float)):
+                registry.gauge(f"wall.{phase}_fraction").set(float(frac))
+        wall = attribution.get("wall_seconds")
+        if isinstance(wall, (int, float)):
+            registry.gauge("wall.total_seconds").set(float(wall))
+    except Exception:  # noqa: BLE001 — observability never kills a run
+        pass
+
+
+__all__ = ["PHASES", "attribute_wall", "format_wall", "record_wall",
+           "span_phase_seconds"]

@@ -201,12 +201,21 @@ def summarize_timeline(records: Iterable[dict]) -> dict:
         elif phase == "end":
             stack = open_spans.get(key)
             start = stack.pop() if stack else None
-            spans.append({
+            span = {
                 "kind": kind, "name": name,
                 "start": start.get("t") if start else None, "end": t,
                 "seconds": rec.get("seconds"),
                 "status": rec.get("status"),
-                "value": rec.get("value"), "error": rec.get("error")})
+                "value": rec.get("value"), "error": rec.get("error")}
+            # Wall-phase split: a stage span whose recorder attached the
+            # compile/execute decomposition (bench_seconds_per_call's
+            # phase_info) carries it through to the summary, where
+            # perf/wallclock.py rolls it into per-run phase fractions.
+            for extra in ("lower_seconds", "compile_seconds",
+                          "execute_seconds"):
+                if isinstance(rec.get(extra), (int, float)):
+                    span[extra] = rec[extra]
+            spans.append(span)
             if kind == "stage" and rec.get("status") == "ok" \
                     and rec.get("value") is not None:
                 stage_values[name] = rec.get("value")
@@ -261,10 +270,17 @@ def format_timeline(summary: dict) -> str:
     for s in summary["spans"]:
         dur = s.get("seconds")
         status = s.get("status") or "?"
+        split = ""
+        if isinstance(s.get("compile_seconds"), (int, float)):
+            split = f"  [compile {s['compile_seconds']:.2f}s"
+            if isinstance(s.get("execute_seconds"), (int, float)):
+                split += f" / exec {s['execute_seconds']:.2f}s"
+            split += "]"
         lines.append(
             f"  [{rel(s.get('start'))}] {s['kind']:<8s} {s['name']:<28s} "
             f"{status:<4s}"
             + (f" {dur:8.2f}s" if isinstance(dur, (int, float)) else "")
+            + split
             + _fmt_value(s.get("value"))
             + (f"  ({s['error']})" if s.get("error") else ""))
     for s in summary["in_flight"]:

@@ -24,10 +24,6 @@ import sys
 import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(_ROOT, ".bench", "jaxcache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-
 sys.path.insert(0, _ROOT)
 
 import jax  # noqa: E402
@@ -35,7 +31,13 @@ import jax.numpy as jnp  # noqa: E402
 
 from ft_sgemm_tpu import InjectionSpec, SHAPES, make_ft_sgemm, make_sgemm  # noqa: E402
 from ft_sgemm_tpu.ops.reference import sgemm_reference  # noqa: E402
+from ft_sgemm_tpu.perf import compile_cache  # noqa: E402
 from ft_sgemm_tpu.utils.timing import compile_bench_loop  # noqa: E402
+
+# Shared, observable persistent cache (FT_SGEMM_COMPILE_CACHE overrides
+# or disables) — the probe's compiles are the warm-start deposit the
+# later bench withdraws, and the final JSON line reports the traffic.
+_CACHE_STATUS = compile_cache.enable()
 
 SIZE = 4096
 
@@ -101,6 +103,7 @@ def main():
     ok = all(r["ok"] for r in results.values())
     print(json.dumps({"metric": "compile_probe", "size": size,
                       "backend": jax.default_backend(), "ok": ok,
+                      "compile_cache": compile_cache.stats(),
                       "variants": results}))
     return 0 if ok else 1
 

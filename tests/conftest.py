@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # exercise the tuner monkeypatch this to their own tmp path.
 os.environ["FT_SGEMM_TUNER_CACHE"] = os.path.join(
     tempfile.mkdtemp(prefix="ft_sgemm_test_tuner_"), "tuner_cache.json")
+
+# Hermetic compile cache, same pattern: bench.py/prewarm/tune enable the
+# persistent XLA compilation cache by default (perf/compile_cache.py),
+# and the suite's subprocess runs (bench --smoke, CLI entry points) must
+# neither read nor write a developer's ~/.cache executables. Pinned OFF;
+# tests that exercise the cache monkeypatch this to their own tmp dir.
+os.environ["FT_SGEMM_COMPILE_CACHE"] = "0"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

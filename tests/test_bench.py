@@ -525,6 +525,142 @@ def test_encode_comparison_context_from_partial_records(tmp_path):
     assert payload["context"]["abft_rowcol_mxu_gflops"] == 28100.0
 
 
+def test_headline_rung_timeline_salvage(tmp_path):
+    """Headline-first salvage at RUNG granularity: a deadline kill
+    between ladder rungs leaves the completed rung's measurement only in
+    the streamed timeline (under ``ft_headline[<label>]`` — the outer
+    ft_headline record never landed). The emit must promote it."""
+    records = tmp_path / "records.jsonl"
+    records.write_text(json.dumps(
+        {"name": "backend", "ok": True,
+         "value": {"backend": "tpu", "device": "d",
+                   "num_devices": 1}}) + "\n")
+    bench = _load_bench()
+    tlmod = bench._load_timeline_mod()
+    tl = tlmod.TimelineRecorder(str(records) + ".timeline.jsonl")
+    with tl.span("ft_headline", kind="stage"):
+        with tl.span("ft_headline[weighted (deferred single-check "
+                     "localization)]", kind="stage") as info:
+            info["value"] = 24800.0
+            info["compile_seconds"] = 300.0
+            info["execute_seconds"] = 40.0
+        # Next rung starts, never ends: the kill point.
+        tl._write({"kind": "stage", "name": "ft_headline[rowcol]",
+                   "phase": "start", "t": 12345.0})
+    tl.close()
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="5",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="99"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    assert payload["value"] == 24800.0
+    assert payload["context"]["partial"] is True
+    assert payload["context"]["strategy"] == (
+        "weighted (deferred single-check localization)")
+
+
+def test_compile_cache_context_from_records(tmp_path):
+    """The artifact context must carry the compile-cache triple — the
+    enabled flag flattened, the reason NAMED (never swallowed), and the
+    full stats dict — straight from the banked compile_cache record."""
+    records = tmp_path / "records.jsonl"
+    records.write_text(
+        json.dumps({"name": "ft_headline", "ok": True,
+                    "value": {"gflops": 30000.0, "strategy": "w"}}) + "\n"
+        + json.dumps({"name": "compile_cache", "ok": True,
+                      "value": {"enabled": False, "path": None,
+                                "reason": "disabled by "
+                                          "FT_SGEMM_COMPILE_CACHE=0",
+                                "hits": 0, "misses": 0}}) + "\n")
+    proc = _run(_env(tmp_path, FT_SGEMM_BENCH_DEADLINE="5",
+                     FT_SGEMM_BENCH_MIN_ATTEMPT="99"))
+    payload = _payload(proc)
+    assert proc.returncode == 0
+    ctx = payload["context"]
+    assert ctx["compile_cache_enabled"] is False
+    assert "FT_SGEMM_COMPILE_CACHE" in ctx["compile_cache_reason"]
+    assert ctx["compile_cache"]["misses"] == 0
+
+
+def test_double_smoke_warm_start(tmp_path):
+    """The warm-start acceptance path, run locally exactly as CI runs
+    it: two --smoke runs sharing one FT_SGEMM_COMPILE_CACHE dir. The
+    second must report cache hits > 0, zero misses of the first run's
+    entries, and a STRICTLY lower compile-wall fraction; both artifacts
+    carry stage spans with a compile/execute split and wall fractions
+    summing to <= 1."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FT_SGEMM_TUNER_CACHE"] = str(tmp_path / "tuner_cache.json")
+    env["FT_SGEMM_COMPILE_CACHE"] = str(tmp_path / "jaxcache")
+
+    def smoke(tag):
+        e = dict(env)
+        e["FT_SGEMM_BENCH_TIMELINE"] = str(tmp_path / f"{tag}.tl.jsonl")
+        proc = subprocess.run([sys.executable, str(BENCH), "--smoke"],
+                              env=e, capture_output=True, text=True,
+                              timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return _payload(proc)
+
+    cold = smoke("cold")
+    warm = smoke("warm")
+    for artifact in (cold, warm):
+        ctx = artifact["context"]
+        assert ctx["compile_cache_enabled"] is True
+        rr = ctx["run_report"]
+        fractions = rr["wall"]["fractions"]
+        assert sum(fractions.values()) <= 1.0 + 1e-9
+        assert "other" in fractions
+        # Every measured stage span carries the compile/execute split.
+        stage_spans = [s for s in rr["timeline"]["spans"]
+                       if s["kind"] == "stage"]
+        assert stage_spans
+        for s in stage_spans:
+            assert isinstance(s.get("compile_seconds"), (int, float)), s
+            assert isinstance(s.get("execute_seconds"), (int, float)), s
+    assert cold["context"]["compile_cache"]["misses"] > 0
+    assert cold["context"]["compile_cache"]["bytes_written"] > 0
+    assert warm["context"]["compile_cache"]["hits"] > 0
+    assert warm["context"]["compile_cache"]["misses"] == 0
+    cold_frac = cold["context"]["run_report"]["wall"]["fractions"]["compile"]
+    warm_frac = warm["context"]["run_report"]["wall"]["fractions"]["compile"]
+    assert warm_frac < cold_frac, (cold_frac, warm_frac)
+
+
+def test_headline_baseline_gate(tmp_path):
+    """The committed 25.6 TFLOPS rowcol@4096 reference: a measured TPU
+    headline regressing past tolerance fails the gate (exit 1), a
+    matching-or-better one passes, and a CPU/smoke artifact (no headline
+    stage) is incomparable — exit 0, never a failure."""
+    from ft_sgemm_tpu import cli
+
+    baseline = str(BENCH.parent / "BASELINE_HEADLINE.json")
+
+    def artifact(payload):
+        p = tmp_path / f"a{artifact.n}.json"
+        artifact.n += 1
+        p.write_text(json.dumps(payload) + "\n")
+        return str(p)
+    artifact.n = 0
+
+    slow = artifact({"metric": "abft_kernel_huge_gflops_4096",
+                     "value": 20000.0, "unit": "GFLOPS",
+                     "vs_baseline": 4.994, "context": {}})
+    good = artifact({"metric": "abft_kernel_huge_gflops_4096",
+                     "value": 26100.0, "unit": "GFLOPS",
+                     "vs_baseline": 6.517, "context": {}})
+    nullv = artifact({"metric": "abft_kernel_huge_gflops_4096",
+                      "value": None, "unit": "GFLOPS",
+                      "vs_baseline": None,
+                      "context": {"platform_used": "cpu"}})
+    smoke = artifact({"metric": "bench_smoke", "value": 1, "unit": "ok",
+                      "vs_baseline": None, "context": {"smoke": True}})
+    assert cli.main(["cli", "bench-compare", baseline, slow]) == 1
+    assert cli.main(["cli", "bench-compare", baseline, good]) == 0
+    assert cli.main(["cli", "bench-compare", baseline, nullv]) == 0
+    assert cli.main(["cli", "bench-compare", baseline, smoke]) == 0
+
+
 def test_stage_budget_sizing():
     """Per-stage wall budget (graceful early-stop): 1.5x the largest
     completed stage, floored at the old 20 s guard, capped by

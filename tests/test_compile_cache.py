@@ -1,0 +1,109 @@
+"""Persistent compile-cache observability (perf/compile_cache.py).
+
+Contract: the cache location resolves env-first (the hermetic pin), a
+failure to enable is a NAMED reason rather than a swallowed exception,
+and hits/misses/bytes-written are counted from the runtime's own
+monitoring events + a directory snapshot — the numbers the bench
+artifact context and RunReport manifest embed, and the CI double-smoke
+job asserts warm-start on.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ft_sgemm_tpu.perf import compile_cache
+from ft_sgemm_tpu.telemetry.registry import MetricsRegistry
+
+
+@pytest.fixture
+def cache_restore():
+    """Restore the process-global cache config after a test enables it
+    (the suite runs with FT_SGEMM_COMPILE_CACHE=0 — see conftest)."""
+    yield
+    compile_cache.disable()
+    compile_cache._reset_for_tests()
+
+
+def test_env_off_pin_disables_with_named_reason(monkeypatch):
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE, "0")
+    status = compile_cache.enable()
+    assert status["enabled"] is False
+    assert compile_cache.ENV_COMPILE_CACHE in status["reason"]
+    # stats() degrades, never raises.
+    s = compile_cache.stats()
+    assert s["enabled"] is False and s["bytes_written"] is None
+
+
+def test_resolve_order_env_then_default(monkeypatch):
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE, "/some/dir")
+    assert compile_cache.resolve_dir("/caller/default") == ("/some/dir",
+                                                           None)
+    monkeypatch.delenv(compile_cache.ENV_COMPILE_CACHE)
+    assert compile_cache.resolve_dir("/caller/default") == (
+        "/caller/default", None)
+    path, reason = compile_cache.resolve_dir()
+    assert path == compile_cache.default_cache_dir() and reason is None
+
+
+def test_unwritable_dir_is_a_named_failure(monkeypatch, tmp_path):
+    target = tmp_path / "blocked"
+    target.write_text("a file where a directory must go")
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE, str(target))
+    status = compile_cache.enable()
+    assert status["enabled"] is False
+    assert status["reason"], "failure must carry a named reason"
+    compile_cache._reset_for_tests()
+
+
+def test_miss_then_hit_counting_and_bytes_written(monkeypatch, tmp_path,
+                                                  cache_restore):
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE,
+                       str(tmp_path / "jaxcache"))
+    status = compile_cache.enable()
+    assert status["enabled"] is True, status
+
+    @jax.jit
+    def f(x):
+        return (x @ x.T).sum() * 3.0
+
+    x = jnp.ones((160, 160))
+    float(f(x))  # cold: persistent-cache miss, entry written
+    s1 = compile_cache.stats()
+    assert s1["misses"] >= 1
+    assert s1["files_written"] >= 1 and s1["bytes_written"] > 0
+
+    # Drop the in-memory jit cache: the recompile must be served from
+    # the persistent cache — the warm-start path a bench relaunch takes.
+    jax.clear_caches()
+    float(f(x))
+    s2 = compile_cache.stats()
+    assert s2["hits"] >= 1, s2
+    assert s2["requests"] >= s2["hits"] + s2["misses"] - 1
+
+    reg = MetricsRegistry()
+    compile_cache.record(registry=reg)
+    names = {m["name"] for m in reg.collect()}
+    assert {"compile_cache.enabled", "compile_cache.hits",
+            "compile_cache.misses"} <= names
+
+
+def test_second_enable_with_same_path_keeps_counting(monkeypatch, tmp_path,
+                                                     cache_restore):
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE,
+                       str(tmp_path / "jaxcache"))
+    compile_cache.enable()
+
+    @jax.jit
+    def g(x):
+        return (x * 2.0).sum()
+
+    float(g(jnp.ones((96, 96))))
+    # Re-enable (a resumed bench worker does this): counters reset, the
+    # snapshot re-bases, and traffic after it still counts.
+    compile_cache.enable()
+    s = compile_cache.stats()
+    assert s["enabled"] and s["hits"] == 0 and s["misses"] == 0
+    jax.clear_caches()
+    float(g(jnp.ones((96, 96))))
+    assert compile_cache.stats()["hits"] >= 1

@@ -1,0 +1,222 @@
+"""C->output buffer reuse across every hot entry point.
+
+PR 3 made the plain and FT pallas_calls alias their C operand onto the
+f32 output (``input_output_aliases`` — the beta*C epilogue reads each C
+tile in the grid step that retires its output tile, so XLA reuses the
+HBM buffer instead of allocating a second (M, N) array). This file
+extends the pin to the REMAINING hot entry points: every ``parallel/``
+path and both attention factories must reach a pallas_call that carries
+the alias (the wrapper layers — shard_map, fori_loop ring hops, vjp
+plumbing — must not launder it away), and the parallel wrappers'
+``donate_c=True`` must additionally donate the OUTER C buffer at their
+jit boundary (``donated_invars`` pinned in the traced pjit params) with
+unchanged numerics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.attention import make_ft_attention
+from ft_sgemm_tpu.ops.reference import sgemm_reference
+from ft_sgemm_tpu.parallel import (
+    make_mesh,
+    make_multihost_mesh,
+    make_ring_mesh,
+    multihost_ft_sgemm,
+    ring_ft_attention,
+    ring_ft_sgemm,
+    ring_sgemm,
+    sharded_ft_sgemm,
+    sharded_sgemm,
+)
+
+ALPHA, BETA = 1.0, -1.5
+TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+
+
+def _scan_pallas_params(jaxpr, out=None):
+    """Every pallas_call eqn's params in a jaxpr, recursing through BOTH
+    ClosedJaxpr params (pjit, while/fori bodies) and raw Jaxpr params
+    (shard_map) — the wrapper layers the parallel paths stack up."""
+    out = [] if out is None else out
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            out.append(eqn.params)
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):  # raw Jaxpr (shard_map)
+                _scan_pallas_params(v, out)
+            elif hasattr(v, "jaxpr"):  # ClosedJaxpr (pjit, loops)
+                _scan_pallas_params(v.jaxpr, out)
+    return out
+
+
+def _scan_donations(jaxpr, out=None):
+    """Every pjit eqn's ``donated_invars`` tuple in a jaxpr."""
+    out = [] if out is None else out
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("pjit", "jit"):
+            di = eqn.params.get("donated_invars")
+            if di is not None:
+                out.append(tuple(di))
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _scan_donations(v, out)
+            elif hasattr(v, "jaxpr"):
+                _scan_donations(v.jaxpr, out)
+    return out
+
+
+def _alias_pairs(params):
+    alias = params.get("input_output_aliases")
+    return tuple(tuple(p) for p in alias) if alias else ()
+
+
+def _assert_all_ft_aliased(jaxpr, expect_calls):
+    """Every pallas_call reached must alias its C operand (slot 3 for the
+    FT kernels' (inj, a, b, c) operand order) onto f32 output 0."""
+    params = _scan_pallas_params(jaxpr)
+    assert len(params) == expect_calls, (
+        f"expected {expect_calls} pallas_call(s), found {len(params)}")
+    for p in params:
+        assert _alias_pairs(p) == ((3, 0),), p.get("input_output_aliases")
+
+
+def _inputs(rng, m=256, n=128, k=512):
+    return (rng.standard_normal((m, k)).astype(np.float32),
+            rng.standard_normal((n, k)).astype(np.float32),
+            rng.standard_normal((m, n)).astype(np.float32))
+
+
+# -- parallel/ family: pallas alias survives the wrapper layers --------------
+
+
+def test_sharded_ft_alias_pinned(rng):
+    a, b, c = _inputs(rng)
+    mesh = make_mesh(8)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: sharded_ft_sgemm(a, b, c, mesh, TILE).c)(a, b, c)
+    _assert_all_ft_aliased(jaxpr.jaxpr, expect_calls=1)
+
+
+def test_sharded_plain_alias_pinned(rng):
+    a, b, c = _inputs(rng)
+    mesh = make_mesh(8)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: sharded_sgemm(a, b, c, mesh, TILE))(a, b, c)
+    (params,) = _scan_pallas_params(jaxpr.jaxpr)
+    # Plain kernel operand order (a, b, c): C is slot 2.
+    assert _alias_pairs(params) == ((2, 0),), params.get(
+        "input_output_aliases")
+
+
+def test_ring_ft_alias_pinned(rng):
+    a, b, c = _inputs(rng, 256, 256, 512)
+    mesh = make_ring_mesh(8)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: ring_ft_sgemm(a, b, c, mesh, TILE).c)(a, b, c)
+    _assert_all_ft_aliased(jaxpr.jaxpr, expect_calls=1)
+
+
+def test_ring_plain_alias_pinned(rng):
+    a, b, c = _inputs(rng, 256, 256, 512)
+    mesh = make_ring_mesh(8)
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: ring_sgemm(a, b, c, mesh, TILE))(a, b, c)
+    (params,) = _scan_pallas_params(jaxpr.jaxpr)
+    assert _alias_pairs(params) == ((2, 0),), params.get(
+        "input_output_aliases")
+
+
+def test_multihost_ft_alias_pinned(rng):
+    a, b, c = _inputs(rng)
+    mesh = make_multihost_mesh(hosts=2, ici_axes=(2, 2))
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: multihost_ft_sgemm(a, b, c, mesh, TILE).c)(a, b, c)
+    _assert_all_ft_aliased(jaxpr.jaxpr, expect_calls=1)
+
+
+# -- attention factories: both protected GEMMs alias -------------------------
+
+
+def test_attention_qk_pv_alias_pinned(rng):
+    q = rng.standard_normal((256, 128)).astype(np.float32)
+    k = rng.standard_normal((256, 128)).astype(np.float32)
+    v = rng.standard_normal((256, 128)).astype(np.float32)
+    attn = make_ft_attention(qk_shape=TILE, pv_shape=TILE)
+    jaxpr = jax.make_jaxpr(lambda q, k, v: attn(q, k, v).out)(q, k, v)
+    # QK and PV kernels: two pallas_calls, both with the C->output alias.
+    _assert_all_ft_aliased(jaxpr.jaxpr, expect_calls=2)
+
+
+def test_ring_attention_alias_pinned(rng):
+    q = rng.standard_normal((256, 128)).astype(np.float32)
+    k = rng.standard_normal((256, 128)).astype(np.float32)
+    v = rng.standard_normal((256, 128)).astype(np.float32)
+    mesh = make_ring_mesh(8)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: ring_ft_attention(
+            q, k, v, mesh, qk_shape=TILE, pv_shape=TILE).out)(q, k, v)
+    params = _scan_pallas_params(jaxpr.jaxpr)
+    assert params, "ring attention reached no pallas_call"
+    for p in params:
+        assert _alias_pairs(p) == ((3, 0),), p.get("input_output_aliases")
+
+
+# -- donate_c: the OUTER jit boundary donates C too --------------------------
+
+
+@pytest.mark.parametrize("path", ["sharded_ft", "sharded_plain", "ring_ft",
+                                  "ring_plain", "multihost_ft"])
+def test_donate_c_pins_donation_and_preserves_numerics(rng, path):
+    if path in ("ring_ft", "ring_plain"):
+        a, b, c = _inputs(rng, 256, 256, 512)
+        mesh = make_ring_mesh(8)
+        call = ring_ft_sgemm if path == "ring_ft" else ring_sgemm
+    elif path == "multihost_ft":
+        a, b, c = _inputs(rng)
+        mesh = make_multihost_mesh(hosts=2, ici_axes=(2, 2))
+        call = multihost_ft_sgemm
+    else:
+        a, b, c = _inputs(rng)
+        mesh = make_mesh(8)
+        call = sharded_ft_sgemm if path == "sharded_ft" else sharded_sgemm
+
+    def run(a, b, c, donate):
+        out = call(a, b, c, mesh, TILE, donate_c=donate)
+        return out if path.endswith("plain") else out.c
+
+    # Donation pinned in the traced pjit params: exactly the C argument
+    # (invar 2) is donated, nothing else.
+    jaxpr = jax.make_jaxpr(lambda a, b, c: run(a, b, c, True))(a, b, c)
+    donations = _scan_donations(jaxpr.jaxpr)
+    assert (False, False, True) in donations, donations
+    # And with donation OFF nothing is donated anywhere.
+    jaxpr0 = jax.make_jaxpr(lambda a, b, c: run(a, b, c, False))(a, b, c)
+    assert all(not any(d) for d in _scan_donations(jaxpr0.jaxpr))
+
+    # Numerics identical (numpy inputs: each call gets a fresh buffer,
+    # so the donated path is observable only as the saved allocation).
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    got = np.asarray(run(a, b, c, True))
+    np.testing.assert_allclose(got, want, atol=5e-4)
+
+
+def test_donated_ft_still_corrects_injected_faults(rng):
+    """Donation must not change the detect/correct story: an injected
+    fault on the donated path is corrected and counted exactly as on
+    the undonated one."""
+    a, b, c = _inputs(rng)
+    mesh = make_mesh(8)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    res = sharded_ft_sgemm(a, b, c, mesh, TILE, inject=inj, donate_c=True)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    from ft_sgemm_tpu.utils import verify_matrix
+
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{nbad} corrupted elements survived on the donated path"
+    assert int(res.num_detected) > 0
+    assert int(np.sum(np.asarray(res.uncorrectable))) == 0
