@@ -1915,6 +1915,64 @@ def _smoke_measure(context, *, device_kind=None, facts=None, tl=None):
             context["errors"][enc] = f"{type(e).__name__}: {e}"
             sys.stderr.write(traceback.format_exc())
             ok_all = False
+    # Low-precision stages (ISSUE 7): one bf16-adaptive row (the V-ABFT
+    # per-tile thresholds riding the in-kernel encode) and one int8 row
+    # (int32-exact accumulation) — CI's proof that BOTH new axes
+    # (threshold mode x dtype) run end to end on any backend, with
+    # dtype-correct roofline rows (stage peak picked by dtype).
+    context.setdefault("low_precision", {})
+    lp_stages = [
+        ("ft_rowcol[bf16-adaptive]", "bfloat16", "adaptive", a, b,
+         np.asarray(sgemm_reference(a, b, c, 1.0, -1.5,
+                                    in_dtype="bfloat16"))),
+        ("ft_rowcol[int8]", "int8", "adaptive", np.round(a * 10.0),
+         np.round(b * 10.0), None),
+    ]
+    for lp_name, lp_dtype, lp_thr, lp_a, lp_b, lp_want in lp_stages:
+        try:
+            if lp_want is None:
+                lp_want = np.asarray(sgemm_reference(
+                    lp_a, lp_b, c, 1.0, -1.5, in_dtype=lp_dtype))
+            with tl.span(lp_name, kind="stage") as span_info:
+                ft = make_ft_sgemm(tile, alpha=1.0, beta=-1.5,
+                                   strategy="rowcol", threshold=lp_thr,
+                                   in_dtype=lp_dtype)
+                t1 = time.monotonic()
+                res = ft(lp_a, lp_b, c, inj)
+                jax.block_until_ready(res.c)
+                lp_first = time.monotonic() - t1
+                # Same smoke-grade compile/execute split as the encode
+                # stages above: warm second call's wall is pure execute,
+                # first-minus-warm is the trace+compile share.
+                t2 = time.monotonic()
+                jax.block_until_ready(ft(lp_a, lp_b, c, inj).c)
+                lp_warm = time.monotonic() - t2
+                ok, nbad, _ = verify_matrix(lp_want, np.asarray(res.c),
+                                            verbose=False)
+                unc = int(res.num_uncorrectable)
+                row = {
+                    "corrected_ok": bool(ok),
+                    "detections": int(res.num_detected),
+                    "uncorrectable": unc,
+                    "seconds": round(lp_first, 3),
+                    "warm_seconds": round(lp_warm, 3)}
+                context["low_precision"][lp_name] = row
+                span_info["value"] = row
+                span_info["compile_seconds"] = round(
+                    max(lp_first - lp_warm, 0.0), 6)
+                span_info["execute_seconds"] = round(
+                    min(lp_first, lp_warm) + lp_warm, 6)
+            ok_all &= bool(ok) and unc == 0
+            stages.append(perf.stage_row(
+                lp_name, lp_first, m=size, n=size, k=size,
+                block=SMOKE_BLOCK, strategy="rowcol", encode="vpu",
+                dtype=lp_dtype,
+                in_itemsize=1 if lp_dtype == "int8" else 2,
+                device_kind=device_kind))
+        except Exception as e:  # noqa: BLE001 — record per-stage, keep going
+            context["errors"][lp_name] = f"{type(e).__name__}: {e}"
+            sys.stderr.write(traceback.format_exc())
+            ok_all = False
     # Compiled-artifact introspection of the vendor-path dot at this size
     # (guarded per backend: cost/memory analysis may be unavailable —
     # the dict then names what's missing instead of raising).

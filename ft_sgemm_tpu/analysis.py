@@ -152,6 +152,53 @@ def estimate_noise_floor(a, b, c=None, *, alpha: float = 1.0,
     return float(min(noise, float(np.finfo(np.float32).max) / 16.0))
 
 
+def adaptive_threshold_estimate(a, b, *, bm: int, bn: int,
+                                margin: float = 8.0,
+                                tile: Optional[tuple] = None):
+    """Host twin of the in-kernel ``threshold="adaptive"`` derivation.
+
+    Evaluates the SAME variance-bound formula
+    (``ops.common.variance_bound_threshold`` — one implementation, two
+    array modules) that the kernels evaluate per tile per check, at the
+    full-K final-check point: moments over one (bm, K) row tile of A and
+    one (bn, K) row tile of B (``tile=(i, j)`` picks which; default the
+    whole operands — the moment-averaged view telemetry records).
+    Returns ``(threshold, variance)`` where ``variance`` is the
+    mean-square product statistic ``E[a^2] * E[b^2]`` the bound's random
+    term scales by. Pure numpy — no jax import, callable from the
+    bench supervisor and offline tooling.
+
+    The brute-force-moment unit tests pin this twin against directly
+    computed ``sum``/``sum(x^2)`` statistics, which transitively pins the
+    kernels' in-kernel math (same shared formula, same inputs).
+    """
+    from ft_sgemm_tpu.ops.common import variance_bound_threshold
+
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    if tile is not None:
+        i, j = tile
+        a = a[i * bm:(i + 1) * bm]
+        b = b[j * bn:(j + 1) * bn]
+    k = a.shape[1]
+    rows_a = min(bm, a.shape[0])
+    rows_b = min(bn, b.shape[0])
+    n_a = float(rows_a * k)
+    n_b = float(rows_b * k)
+    t_ab = float(k) * float(max(bm, bn))
+    thr = variance_bound_threshold(
+        float(np.sum(a, dtype=np.float64)),
+        float(np.sum(np.square(a, dtype=np.float64))),
+        float(np.sum(b, dtype=np.float64)),
+        float(np.sum(np.square(b, dtype=np.float64))),
+        n_a=n_a, n_b=n_b, t_ab=t_ab,
+        log2_t=float(np.log2(max(t_ab, 2.0))), margin=margin, xp=np)
+    variance = float(
+        (np.sum(np.square(a, dtype=np.float64)) / n_a)
+        * (np.sum(np.square(b, dtype=np.float64)) / n_b))
+    return float(thr), variance
+
+
 @dataclasses.dataclass(frozen=True)
 class ThresholdCalibration:
     noise_floor: float        # max clean residual observed
@@ -269,6 +316,7 @@ def detection_rate_sweep(
 __all__ = [
     "DetectionPoint",
     "ThresholdCalibration",
+    "adaptive_threshold_estimate",
     "calibrate_threshold",
     "detection_rate_sweep",
     "estimate_noise_floor",

@@ -26,15 +26,20 @@ has no tunnel overhead to cancel).
 Usage:
     python -m ft_sgemm_tpu.cli 1024 6144 512 0 16 \
         [--mintime=SECONDS] [--no-verify] [--no-perf] [--trace=DIR]
-        [--dtype=bfloat16] [--strategy=weighted|rowcol|global|fused]
-        [--encode=vpu|mxu] [--telemetry=LOG.jsonl]
+        [--dtype=bfloat16|float8_e4m3|int8]
+        [--strategy=weighted|rowcol|global|fused]
+        [--encode=vpu|mxu] [--threshold=static|auto|adaptive|FLOAT]
+        [--telemetry=LOG.jsonl]
+    python -m ft_sgemm_tpu.cli roc [--smoke] [--out=ROC.json] \
+        [--margin=8.0]
     python -m ft_sgemm_tpu.cli telemetry LOG.jsonl \
         [--format=text|prom] [--by-device]
     python -m ft_sgemm_tpu.cli attribute LOG.jsonl [LOG2.jsonl ...]
     python -m ft_sgemm_tpu.cli timeline RUN.timeline.jsonl \
         [--format=text|json] [--phases]
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
-        [--encode=vpu|mxu] [--dtype=...] [--plain] [--inject] [--budget=N] \
+        [--encode=vpu|mxu] [--dtype=...] [--threshold=static|adaptive] \
+        [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
         [--dry-run] [--prewarm]
     python -m ft_sgemm_tpu.cli tune-show
@@ -103,6 +108,24 @@ tuning run, so the winner it just persisted dispatches warm too.
 two-pass baseline, fused-ABFT kernels) in the bf16 input mode — the MXU's
 full-rate path, an axis the CUDA reference has no analog for. Verification
 then diffs against the XLA dot over the same bf16-rounded inputs.
+``--dtype=float8_e4m3`` (aliases ``fp8``/``fp8_e4m3``) runs the fp8
+serving mode (f32 accumulation, f32 checksums over the fp8-rounded
+values); ``--dtype=int8`` runs the int32-EXACT mode — inputs are scaled
+to the integer lattice ±{0..9}, the FT rows accumulate and checksum in
+wrapping int32 (clean residuals are identically zero), and the plain/
+baseline rows are skipped (they accumulate f32).
+
+``--threshold`` picks the detection-threshold mode for the FT rows:
+``static`` (default — the reference's fixed 9500 operating point, or any
+explicit float), ``auto`` (one traced per-call threshold from the full
+inputs' moments), or ``adaptive`` (per-tile per-check thresholds derived
+INSIDE the kernel from running encode-pass moment statistics — the
+V-ABFT capability that keeps false positives at zero when operand
+statistics vary; the mode that opens bf16-and-below to production use).
+``roc`` runs the proof: clean false-positive rates and injected-fault
+detection rates, static vs adaptive, per dtype x strategy x encode
+across input scales, with a JSON artifact (``--out=``) and a per-combo
+domination verdict; ``--smoke`` is the CPU-runnable CI grid.
 
 ``--strategy`` picks the fused-ABFT checksum design for the FT rows:
 ``weighted`` (default — deferred per-column localization; at its default
@@ -142,8 +165,11 @@ import jax.numpy as jnp
 
 from ft_sgemm_tpu.configs import (
     ENCODE_MODES,
+    IN_DTYPES,
     KERNEL_TABLE,
     PERF_ROW_IDS,
+    THRESHOLD_MODES,
+    canonical_in_dtype,
     kernel_for_id,
 )
 from ft_sgemm_tpu.injection import InjectionSpec
@@ -159,21 +185,30 @@ BETA = -1.5   # sgemm.cu:24,234
 
 
 def _build_ft(kernel_id: int, size: int, in_dtype: str, strategy: str,
-              encode: str = "vpu"):
+              encode: str = "vpu", threshold="static"):
     """The fused-ABFT kernel + reference-like injection for one kernel id —
     the ONE place the verification and perf paths get their FT recipe
     (kernel from the shape NAME so per-dtype tile overrides apply;
     injection cadence following the tile the kernel actually runs)."""
     _, shape, _ = kernel_for_id(kernel_id)
     ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA, in_dtype=in_dtype,
-                       strategy=strategy, encode=encode)
+                       strategy=strategy, encode=encode, threshold=threshold)
     inj = InjectionSpec.reference_like(size, ft.shape_config.bk)
     return ft, inj
 
 
+def _int8_capable(kernel_id: int) -> bool:
+    """Whether a kernel id can run the int8 input mode: the XLA oracle
+    row and the fused-ABFT rows (whose kernels carry the int32-exact
+    accumulation path). The plain Pallas rows and the two-pass baseline
+    accumulate in f32 and are skipped under ``--dtype=int8``."""
+    _, _, is_abft = kernel_for_id(kernel_id)
+    return kernel_id == 0 or (is_abft and kernel_id != 10)
+
+
 def _build_callable(kernel_id: int, size: int, inject_ft: bool,
                     in_dtype: str = "float32", strategy: str = "weighted",
-                    encode: str = "vpu"):
+                    encode: str = "vpu", threshold="static"):
     """Return fn(a, b, c) -> (M, N) array for one kernel id, or None."""
     name, shape, is_abft = kernel_for_id(kernel_id)
     if kernel_id == 0:
@@ -187,7 +222,8 @@ def _build_callable(kernel_id: int, size: int, inject_ft: bool,
     if not is_abft:
         return make_sgemm(shape.name, alpha=ALPHA, beta=BETA,
                           in_dtype=in_dtype)
-    ft, inj = _build_ft(kernel_id, size, in_dtype, strategy, encode)
+    ft, inj = _build_ft(kernel_id, size, in_dtype, strategy, encode,
+                        threshold)
     if not inject_ft:
         inj = InjectionSpec.none()
     return lambda a, b, c: ft(a, b, c, inj).c
@@ -210,8 +246,18 @@ def print_device_info(out=None) -> None:
         print(f"Device: unavailable ({e})", file=out)
 
 
+def _quantize_for_dtype(x: np.ndarray, in_dtype: str) -> np.ndarray:
+    """int8 input mode: scale the quantized ±{0,.1,...,.9} distribution to
+    the integer lattice ±{0..9} (the int8 cast truncates fractions — the
+    unscaled distribution would collapse to zero). Other dtypes pass
+    through; the kernels' own casts do the rounding."""
+    if canonical_in_dtype(in_dtype) == "int8":
+        return np.round(x * 10.0).astype(np.float32)
+    return x
+
+
 @functools.lru_cache(maxsize=1)
-def _host_inputs(size: int):
+def _host_inputs(size: int, in_dtype: str = "float32"):
     """Host-side A/B/C for one sweep size. The perf sweep iterates
     SIZE-major (all kernel rows per size), so this generates each size's
     ~O(n^2) RNG draws exactly once per sweep — and only the current
@@ -219,14 +265,17 @@ def _host_inputs(size: int):
     would hold ~450 MB of dead host memory at sweep end)."""
     rng = np.random.default_rng(10)
     return (
-        generate_random_matrix(size, size, rng=rng),
-        generate_random_matrix(size, size, rng=rng),
+        _quantize_for_dtype(generate_random_matrix(size, size, rng=rng),
+                            in_dtype),
+        _quantize_for_dtype(generate_random_matrix(size, size, rng=rng),
+                            in_dtype),
         generate_random_matrix(size, size, rng=rng),
     )
 
 
 def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
-                            in_dtype: str, encode: str = "vpu"):
+                            in_dtype: str, encode: str = "vpu",
+                            threshold="static"):
     """Verification gate for the detect-only ``global`` design: the output
     keeps injected corruption by definition, so the diff gate moves to
     (a) exact fault-event counting with injection ON and (b) a clean-run
@@ -235,7 +284,8 @@ def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
 
     _, shape, _ = kernel_for_id(kernel_id)
     ft = make_ft_sgemm(shape.name, alpha=ALPHA, beta=BETA,
-                       in_dtype=in_dtype, strategy="global", encode=encode)
+                       in_dtype=in_dtype, strategy="global", encode=encode,
+                       threshold=threshold)
     eff = shrink_block(ft.shape_config, end_size, end_size, end_size)
     inj = InjectionSpec.reference_like(end_size, eff.bk)
     res = ft(a, b, c, inj)
@@ -258,9 +308,10 @@ def _verify_global_strategy(kernel_id: int, end_size: int, a, b, c, want,
 def run_verification(end_size: int, st_kernel: int, end_kernel: int,
                      out=sys.stdout, in_dtype: str = "float32",
                      strategy: str = "weighted",
-                     encode: str = "vpu") -> bool:
+                     encode: str = "vpu", threshold="static") -> bool:
     """Pass 1: diff every selected kernel against the XLA oracle (for bf16
-    mode: the XLA dot over the same bf16-rounded inputs).
+    mode: the XLA dot over the same bf16-rounded inputs; for int8: the
+    exact int32-accumulating dot over the integer-scaled inputs).
 
     A and B reproduce the reference driver's post-``srand(10)`` buffers
     bit-for-bit when the native toolchain is available
@@ -270,24 +321,33 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
     from ft_sgemm_tpu import runtime
 
     a, b = runtime.generate_reference_driver_inputs(end_size)
+    a = _quantize_for_dtype(a, in_dtype)
+    b = _quantize_for_dtype(b, in_dtype)
     c = np.zeros((end_size, end_size), np.float32)  # fill_vector(C,0)
 
     want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA, in_dtype=in_dtype))
     all_ok = True
+    int8_mode = canonical_in_dtype(in_dtype) == "int8"
     for kernel_id in sorted(KERNEL_TABLE):
         if kernel_id < st_kernel or kernel_id > end_kernel:
             continue
         name, _, is_abft = kernel_for_id(kernel_id)
+        if int8_mode and not _int8_capable(kernel_id):
+            print(f"Verification of kernel {kernel_id:2d} ({name:20s}): "
+                  "skipped (int8 runs the FT rows' int32-exact kernels"
+                  " only)", file=out)
+            continue
         if is_abft and kernel_id != 10 and strategy == "global":
             ok, status = _verify_global_strategy(
-                kernel_id, end_size, a, b, c, want, in_dtype, encode)
+                kernel_id, end_size, a, b, c, want, in_dtype, encode,
+                threshold)
             all_ok &= ok
         elif is_abft and kernel_id != 10:
             # Correcting FT rows: diff gate PLUS the residual-after-correct
             # re-check — an interval the kernel itself could not verify
             # fails the row even if the diff happens to pass.
             ft, inj = _build_ft(kernel_id, end_size, in_dtype, strategy,
-                                encode)
+                                encode, threshold)
             res = ft(a, b, c, inj)
             ok, nbad, first = verify_matrix(want, np.asarray(res.c),
                                             verbose=False)
@@ -303,7 +363,7 @@ def run_verification(end_size: int, st_kernel: int, end_kernel: int,
         else:
             fn = _build_callable(kernel_id, end_size, inject_ft=True,
                                  in_dtype=in_dtype, strategy=strategy,
-                                 encode=encode)
+                                 encode=encode, threshold=threshold)
             got = np.asarray(fn(a, b, c))
             ok, nbad, first = verify_matrix(want, got, verbose=False)
             status = "pass" if ok else f"FAIL ({nbad} bad, first at {first})"
@@ -318,7 +378,7 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
                    min_device_time: float = 1.0, out=sys.stdout,
                    in_dtype: str = "float32",
                    strategy: str = "weighted",
-                   encode: str = "vpu") -> dict:
+                   encode: str = "vpu", threshold="static") -> dict:
     """Pass 2: the GFLOPS table (format parity with sgemm.cu:240-439).
 
     The sweep runs SIZE-major — all kernel rows measured per size — so
@@ -331,17 +391,24 @@ def run_perf_table(start_size: int, end_size: int, gap_size: int,
     """
     sizes = list(range(start_size, end_size + 1, gap_size))
     row_ids = [kid for kid in PERF_ROW_IDS if st_kernel <= kid <= end_kernel]
+    if canonical_in_dtype(in_dtype) == "int8":
+        skipped = [kid for kid in row_ids if not _int8_capable(kid)]
+        if skipped:
+            print(f"ft_sgemm: int8 mode skips rows {skipped} (plain/"
+                  "baseline kernels accumulate f32; the FT rows carry the"
+                  " int32-exact path)", file=sys.stderr, flush=True)
+        row_ids = [kid for kid in row_ids if _int8_capable(kid)]
 
     cells = {}
     for size in sizes:
         print(f"ft_sgemm: measuring size {size} "
               f"({len(row_ids)} kernel rows)...", file=sys.stderr, flush=True)
-        ah, bh, ch = _host_inputs(size)
+        ah, bh, ch = _host_inputs(size, canonical_in_dtype(in_dtype))
         a, b, c = map(jax.device_put, (ah, bh, ch))
         for kernel_id in row_ids:
             fn = _build_callable(kernel_id, size, inject_ft=True,
                                  in_dtype=in_dtype, strategy=strategy,
-                                 encode=encode)
+                                 encode=encode, threshold=threshold)
             sec_per_rep = bench_seconds_per_call(
                 fn, a, b, c, min_device_time=min_device_time)
             gf = 2.0 * size**3 / 1e9 / sec_per_rep
@@ -574,6 +641,7 @@ def run_tune(args, flags, out=None) -> int:
     strategy = "weighted"
     encode = "vpu"
     in_dtype = "float32"
+    threshold_mode = "static"
     budget = 8
     method = None
     reps, samples = 3, 3
@@ -592,9 +660,18 @@ def run_tune(args, flags, out=None) -> int:
                 return 2
         elif f.startswith("--dtype="):
             in_dtype = f.split("=", 1)[1]
-            if in_dtype not in ("float32", "bfloat16"):
-                print(f"--dtype must be float32 or bfloat16, got"
-                      f" {in_dtype!r}", file=sys.stderr)
+            try:
+                in_dtype = canonical_in_dtype(in_dtype)
+            except ValueError:
+                print(f"--dtype must be one of {IN_DTYPES} (or an fp8"
+                      f" alias), got {in_dtype!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--threshold="):
+            threshold_mode = f.split("=", 1)[1]
+            if threshold_mode not in ("static", "adaptive"):
+                print("--threshold must be static or adaptive for tune"
+                      " (auto shares static's program and key), got"
+                      f" {threshold_mode!r}", file=sys.stderr)
                 return 2
         elif f.startswith("--budget="):
             budget = int(f.split("=", 1)[1])
@@ -625,13 +702,20 @@ def run_tune(args, flags, out=None) -> int:
             print(f"  {str(tuple(r.block)):>18s}  FAILED: {r.error}",
                   file=out, flush=True)
 
-    report = tuner.tune(
-        m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
-        inject="--inject" in flags, method=method, budget=budget,
-        reps=reps, samples=samples, dry_run=dry_run, progress=progress)
+    try:
+        report = tuner.tune(
+            m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
+            threshold_mode=threshold_mode,
+            inject="--inject" in flags, method=method, budget=budget,
+            reps=reps, samples=samples, dry_run=dry_run, progress=progress)
+    except ValueError as e:
+        # Illegal (strategy, encode, dtype, threshold) combination: the
+        # kernel factory's message says which constraint and why.
+        print(f"ft_sgemm: {e}", file=sys.stderr)
+        return 2
     strat = report["strategy"]
     print(f"tune {m}x{n}x{k} strategy={strat} encode={report['encode']}"
-          f" dtype={in_dtype}"
+          f" dtype={in_dtype} thr={report.get('threshold_mode', 'static')}"
           f" method={report['method']} key={report['key']}", file=out)
     print(f"candidates: {len(report['feasible'])} feasible,"
           f" {len(report['pruned'])} pruned", file=out)
@@ -673,6 +757,66 @@ def run_tune(args, flags, out=None) -> int:
             print("tune: --prewarm skipped (bench shapes are square;"
                   f" got {m}x{n}x{k})", file=sys.stderr)
     return 0
+
+
+def run_roc(flags, out=None) -> int:
+    """``roc`` subcommand: the static-vs-adaptive threshold ROC sweep.
+
+    Runs ``injection.roc_sweep`` — clean false-positive rates and
+    injected-fault detection rates across input scales, per
+    (dtype, strategy, encode) combo, static threshold (calibrated at
+    scale 1) vs ``threshold="adaptive"`` — and prints the per-combo
+    verdict table. ``--smoke`` cuts to the CI-sized grid
+    (bf16 + int8, rowcol + global — CPU-runnable in ~1 min);
+    ``--out=PATH`` writes the full JSON artifact. Exit 0 iff adaptive
+    Pareto-dominates static for every combo AND adaptive produced zero
+    clean-run false positives (the acceptance contract CI grep-asserts).
+    """
+    import json as _json
+
+    from ft_sgemm_tpu.injection import roc_sweep
+
+    out = sys.stdout if out is None else out
+    kwargs = {}
+    out_path = None
+    for f in flags:
+        if f.startswith("--out="):
+            out_path = f.split("=", 1)[1]
+        elif f.startswith("--margin="):
+            kwargs["margin"] = float(f.split("=", 1)[1])
+    if "--smoke" in flags:
+        kwargs.update(dtypes=("bfloat16", "int8"),
+                      strategies=("rowcol", "global"))
+    print_device_info()
+
+    def progress(p):
+        print(f"  {p.dtype:>14s}/{p.strategy}/{p.encode} {p.mode:>8s} "
+              f"scale={p.scale:<6g} clean_det={p.clean_detections:<4d} "
+              f"det={p.detected}/{p.expected_faults}", file=out, flush=True)
+
+    artifact = roc_sweep(progress=progress, **kwargs)
+    s = artifact["summary"]
+    print("\nROC summary (aggregate over scales "
+          f"{artifact['config']['scales']}):", file=out)
+    for key, v in s["combos"].items():
+        a, st = v["adaptive"], v["static"]
+        verdict = ("STRICT" if v["strict"]
+                   else "dominates" if v["dominates"] else "DOMINATED")
+        print(f"  {key:<34s} static fp={st['fp_rate']:.3f}"
+              f" det={st['detection_rate']:.3f} | adaptive"
+              f" fp={a['fp_rate']:.3f} det={a['detection_rate']:.3f}"
+              f"  [{verdict}]", file=out)
+    print(f"adaptive false positives: {s['adaptive_false_positives']}",
+          file=out)
+    print(f"all combos dominated by adaptive: {s['all_dominate']}",
+          file=out)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            _json.dump(artifact, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"roc artifact written to {out_path}", file=out)
+    ok = s["all_dominate"] and s["adaptive_false_positives"] == 0
+    return 0 if ok else 1
 
 
 def run_tune_show(out=None) -> int:
@@ -845,6 +989,8 @@ def main(argv=None) -> int:
         return run_tune(args[1:], flags)
     if args and args[0] == "tune-show":
         return run_tune_show()
+    if args and args[0] == "roc":
+        return run_roc(flags)
     if args and args[0] == "prewarm":
         return run_prewarm(args[1:], flags)
     if args and args[0] == "telemetry":
@@ -930,8 +1076,9 @@ def main(argv=None) -> int:
     min_device_time = 1.0
     trace_dir = None
     in_dtype = "float32"
-    strategy = "weighted"
+    strategy = None  # resolved per-dtype after flag parsing
     encode = "vpu"
+    threshold = "static"
     telemetry_log = None
     for f in flags:
         if f.startswith("--mintime="):
@@ -942,10 +1089,21 @@ def main(argv=None) -> int:
             telemetry_log = f.split("=", 1)[1]
         elif f.startswith("--dtype="):
             in_dtype = f.split("=", 1)[1]
-            if in_dtype not in ("float32", "bfloat16"):
-                print(f"--dtype must be float32 or bfloat16, got {in_dtype!r}",
-                      file=sys.stderr)
+            try:
+                in_dtype = canonical_in_dtype(in_dtype)
+            except ValueError:
+                print(f"--dtype must be one of {IN_DTYPES} (or an fp8"
+                      f" alias), got {in_dtype!r}", file=sys.stderr)
                 return 2
+        elif f.startswith("--threshold="):
+            threshold = f.split("=", 1)[1]
+            if threshold not in THRESHOLD_MODES:
+                try:
+                    threshold = float(threshold)
+                except ValueError:
+                    print(f"--threshold must be one of {THRESHOLD_MODES} or"
+                          f" a float, got {threshold!r}", file=sys.stderr)
+                    return 2
         elif f.startswith("--strategy="):
             strategy = f.split("=", 1)[1]
             if strategy not in STRATEGIES:
@@ -958,6 +1116,14 @@ def main(argv=None) -> int:
                 print(f"--encode must be one of {ENCODE_MODES}, got"
                       f" {encode!r}", file=sys.stderr)
                 return 2
+    if strategy is None:
+        # weighted is the reference default, but int8 only ships the
+        # exact strategies (configs.check_kernel_legality); an explicit
+        # illegal --strategy= still errors with the constraint.
+        strategy = "rowcol" if in_dtype == "int8" else "weighted"
+        if in_dtype == "int8":
+            print("--dtype=int8: defaulting --strategy=rowcol (weighted-"
+                  "ratio localization is illegal for int8)", file=sys.stderr)
 
     if telemetry_log is not None:
         # Observability mode: events + host-side residual measurements
@@ -973,7 +1139,7 @@ def main(argv=None) -> int:
         if "--no-verify" not in flags:
             ok = run_verification(end_size, st_kernel, end_kernel,
                                   in_dtype=in_dtype, strategy=strategy,
-                                  encode=encode)
+                                  encode=encode, threshold=threshold)
         if "--no-perf" not in flags:
             import contextlib
 
@@ -983,7 +1149,7 @@ def main(argv=None) -> int:
                 run_perf_table(start_size, end_size, gap_size, st_kernel,
                                end_kernel, min_device_time=min_device_time,
                                in_dtype=in_dtype, strategy=strategy,
-                               encode=encode)
+                               encode=encode, threshold=threshold)
     finally:
         if telemetry_log is not None:
             from ft_sgemm_tpu import telemetry

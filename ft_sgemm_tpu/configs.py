@@ -81,11 +81,23 @@ class KernelShape:
 # appended to an operand tile must keep the tile's sublane dim aligned, so
 # the row count is padded to the dtype's sublane granule — 8 rows for f32
 # (3 moment rows padded), 16 for bf16 (up to 9 hi/lo/lo2 term rows padded;
-# bf16 sublane tiling is 16). One source for the kernels (ops/ft_sgemm) and
-# the VMEM footprint model (ops/vmem).
+# bf16 sublane tiling is 16), 32 for the 1-byte dtypes (sublane tiling is
+# 32; the MXU encode itself is ILLEGAL there — check_kernel_legality — but
+# the granule is the per-dtype KernelShape constraint every layout-facing
+# consumer keys on). One source for the kernels (ops/ft_sgemm) and the
+# VMEM footprint model (ops/vmem).
 def aug_rows(in_itemsize: int) -> int:
     """Sublane-aligned augmented-row count for one operand's checksum rows."""
-    return 8 if in_itemsize == 4 else 16
+    return {4: 8, 2: 16, 1: 32}[in_itemsize]
+
+
+def sublane_granule(in_itemsize: int) -> int:
+    """Mosaic's minimum sublane tile for one input width: (8, 128) f32,
+    (16, 128) bf16, (32, 128) int8/fp8. Every ``KernelShape`` block dim is
+    a multiple of 128, so all shipped tiles are legal at every dtype; the
+    granule is exported for tuner-space validation and the augmentation
+    row padding (:func:`aug_rows`)."""
+    return {4: 8, 2: 16, 1: 32}[in_itemsize]
 
 
 # Checksum-encode modes of the FT kernel family (ops/ft_sgemm):
@@ -95,6 +107,112 @@ def aug_rows(in_itemsize: int) -> int:
 #           operand rows: one dot_general per K step yields the partial
 #           product AND the expected-checksum accumulators.
 ENCODE_MODES = ("vpu", "mxu")
+
+# Detection-threshold modes of the FT kernel family (ops/ft_sgemm):
+#   "static"   — one fixed threshold for the whole run (the reference's
+#                9500 operating point; the default, spelled either as a
+#                float or as the literal "static").
+#   "auto"     — one threshold PER CALL, traced from the full inputs'
+#                moments (margin x the calibrated noise-floor bound,
+#                analysis.estimate_noise_floor). Same kernel program as
+#                static: the threshold rides the runtime SMEM scalars.
+#   "adaptive" — one threshold PER TILE PER CHECK, derived INSIDE the
+#                kernel from running per-tile moment statistics (sum +
+#                sum-of-squares -> variance bound, V-ABFT style,
+#                arXiv 2602.08043) accumulated during the checksum-encode
+#                pass. The mode that makes detection calibrated under
+#                heterogeneous/varying operand statistics — the blocker
+#                for ABFT at bf16 and below (DESIGN.md §10).
+THRESHOLD_MODES = ("static", "auto", "adaptive")
+
+# Input-dtype family of the kernels. f32 is the dtype-of-record; bf16 the
+# MXU's full-rate input mode; fp8_e4m3 / int8 the low-precision serving
+# dtypes (2-8x MXU throughput on parts that accelerate them). Accumulation
+# is always dtype-legal-widened: f32 for the float dtypes, int32 for int8
+# (exact — integer checksum residuals are identically zero on clean runs).
+IN_DTYPES = ("float32", "bfloat16", "float8_e4m3fn", "int8")
+
+# Accepted spellings for the fp8 dtype (jax's canonical name is the
+# e4m3fn variant; papers and CLI flags commonly drop the suffix).
+_IN_DTYPE_ALIASES = {
+    "fp8": "float8_e4m3fn",
+    "fp8_e4m3": "float8_e4m3fn",
+    "float8_e4m3": "float8_e4m3fn",
+}
+
+
+def canonical_in_dtype(in_dtype) -> str:
+    """The canonical ``IN_DTYPES`` name for one in-dtype spelling.
+
+    Raises a ValueError naming the legal family for anything else, so
+    every entry point (kernel factories, CLI flags, tuner keys) rejects a
+    bad dtype with the same message.
+    """
+    if isinstance(in_dtype, str):
+        name = _IN_DTYPE_ALIASES.get(in_dtype, in_dtype)
+    else:
+        # dtype objects / scalar types (np, jnp, ml_dtypes all register
+        # with numpy's dtype machinery).
+        import numpy as np
+
+        try:
+            name = np.dtype(in_dtype).name
+        except TypeError:
+            name = str(in_dtype)
+    if name not in IN_DTYPES:
+        raise ValueError(
+            f"in_dtype must be one of {IN_DTYPES} (aliases:"
+            f" {tuple(sorted(_IN_DTYPE_ALIASES))}), got {in_dtype!r}")
+    return name
+
+
+def check_kernel_legality(*, strategy: str, encode: str, in_dtype,
+                          threshold_mode: str = "static",
+                          multifault: Optional[bool] = None) -> str:
+    """Validate one (strategy, encode, dtype, threshold-mode) combination.
+
+    Returns the canonical dtype name. The low-precision constraints are
+    representational, not policy (DESIGN.md §10 derives each):
+
+    - **1-byte dtypes cannot carry checksum rows** (``encode="mxu"`` /
+      ``strategy="fused"``): an augmented-operand checksum row holds sums
+      of up to ``bm`` elements — magnitude ~``bm * max|x|`` — which
+      saturates fp8_e4m3 (max 448) and overflows int8 (max 127) for every
+      legal tile. The VPU encode computes the same checksums in the
+      32-bit accumulation domain, so it is the low-precision encode.
+    - **int8 localizing strategies**: ``weighted``/``fused`` (and the
+      rowcol multifault extension) localize the fault row by the
+      weighted-residual RATIO — exact only while the weighted int32
+      checksum stream has not wrapped, which weights up to ``bm`` (and
+      ``bm^2`` for the re-check moment) cannot guarantee. int8 therefore
+      ships ``rowcol`` (plain row+col intersection, exact in wrapping
+      int32 arithmetic) and ``global``.
+    """
+    dtype_name = canonical_in_dtype(in_dtype)
+    if threshold_mode not in THRESHOLD_MODES:
+        raise ValueError(
+            f"unknown threshold mode {threshold_mode!r}; pick from"
+            f" {THRESHOLD_MODES}")
+    if dtype_name in ("float8_e4m3fn", "int8"):
+        if encode == "mxu" or strategy == "fused":
+            raise ValueError(
+                f"encode='mxu' (and strategy='fused') is illegal for"
+                f" {dtype_name}: checksum rows of magnitude ~bm * max|x|"
+                " are not representable in a 1-byte operand dtype; use"
+                " encode='vpu' (checksums are computed in the 32-bit"
+                " accumulation domain there)")
+    if dtype_name == "int8":
+        if strategy not in ("rowcol", "global"):
+            raise ValueError(
+                f"strategy {strategy!r} is illegal for int8: weighted-"
+                "ratio fault localization needs non-wrapping moment"
+                " checksums; int8 supports ('rowcol', 'global')")
+        if multifault:
+            raise ValueError(
+                "multifault=True is illegal for int8: the multifault"
+                " extension localizes by the weighted-residual ratio,"
+                " which wrapping int32 checksums cannot guarantee")
+    return dtype_name
 
 
 # The 6 shipped shapes (+ the reference's unused "test" shape), mirroring the

@@ -114,9 +114,279 @@ def _num_k_steps(K: int, bk: int) -> int:
 # ±{0,.1,...,.9} (``utils.cu:23-31``) keep f32 checksum noise orders of
 # magnitude below it even at K=6144.
 
+
+# ---------------------------------------------------------------------------
+# ROC sweep: static vs adaptive thresholds, per dtype x strategy x encode
+# ---------------------------------------------------------------------------
+#
+# The artifact that closes the low-precision loop (ISSUE 7 / ROADMAP item
+# 2): a STATIC detection threshold is one number for every run, but clean
+# checksum-residual noise scales with the operands' variance (~scale^2 when
+# both operands scale) — so a static threshold calibrated on one operating
+# point false-positives when the data runs hotter and silently misses
+# faults when it runs colder. The sweep makes that concrete: the same
+# kernel family runs at several input scales, clean and fault-injected,
+# under (a) the static threshold a careful engineer would ship (margin x
+# the calibrated noise bound AT THE CALIBRATION SCALE) and (b)
+# ``threshold="adaptive"`` (per-tile in-kernel variance bounds). Per
+# (dtype, strategy, encode) the summary reports aggregate false-positive
+# and detection rates for both modes and whether adaptive dominates
+# (fp <= static AND detection >= static; ``strict`` when at least one is
+# a strict improvement — everywhere noise exists, i.e. every float dtype;
+# int8's exact integer arithmetic makes both modes perfect, an honest
+# tie).
+
+# Fault magnitude per run: FAULT_FACTOR x the run's noise bound — 8x the
+# adaptive threshold (margin 8), so adaptive detection has the same
+# headroom at every scale; the static threshold (calibrated at scale 1)
+# overshoots it at CAL_SCALE/sqrt-ish colder scales and drowns under the
+# clean noise at hotter ones.
+ROC_FAULT_FACTOR = 64.0
+ROC_CAL_SCALE = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RocPoint:
+    """One (combo, mode, scale) cell of the ROC sweep."""
+
+    dtype: str
+    strategy: str
+    encode: str
+    mode: str                 # "static" | "adaptive"
+    scale: float
+    threshold: float | None   # the static threshold (None for adaptive)
+    magnitude: float          # injected |fault|
+    clean_detections: int     # detections on the CLEAN run (false positives)
+    checks: int               # detection opportunities (tiles x checks)
+    expected_faults: int      # faults injected over the run
+    detected: int             # detections on the injected run
+
+    @property
+    def fp_rate(self) -> float:
+        return self.clean_detections / self.checks if self.checks else 0.0
+
+    @property
+    def detection_rate(self) -> float:
+        if not self.expected_faults:
+            return 0.0
+        return min(1.0, self.detected / self.expected_faults)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fp_rate"] = self.fp_rate
+        d["detection_rate"] = self.detection_rate
+        return d
+
+
+def _roc_combos(dtypes, strategies, encodes):
+    """The legal (dtype, strategy, encode) grid, canonical spellings only
+    (``weighted``+mxu IS ``fused``: enumerate each program once)."""
+    from ft_sgemm_tpu.configs import canonical_in_dtype, check_kernel_legality
+
+    combos = []
+    for dtype in dtypes:
+        name = canonical_in_dtype(dtype)
+        for strategy in strategies:
+            for encode in encodes:
+                if strategy == "fused" and encode != "mxu":
+                    continue
+                if strategy == "weighted" and encode == "mxu":
+                    continue  # the fused spelling of the same program
+                try:
+                    check_kernel_legality(strategy=strategy, encode=encode,
+                                          in_dtype=name,
+                                          threshold_mode="adaptive")
+                except ValueError:
+                    continue
+                combos.append((name, strategy, encode))
+    return combos
+
+
+def _roc_inputs(m, n, k, scale, dtype_name, seed):
+    """Operands at one input scale.
+
+    Float dtypes draw CONTINUOUS standard-normal data scaled by
+    ``scale`` — the production distribution whose products genuinely
+    round (the reference's quantized ±{0,.1,...,.9} lattice turns into
+    exact small integers at 10x scale, where f32 accumulation is EXACT
+    and no threshold can false-positive — a degenerate sweep). int8
+    draws integer values of magnitude ~9 * scale (floored at ±1 so the
+    fault domain never vanishes)."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    if dtype_name == "int8":
+        scale_i = max(1.0, round(9.0 * scale))
+        a = np.clip(np.round(a * scale_i / 2.0), -127, 127).astype(
+            np.float32)
+        b = np.clip(np.round(b * scale_i / 2.0), -127, 127).astype(
+            np.float32)
+    else:
+        a = a * np.float32(scale)
+        b = b * np.float32(scale)
+    return a, b
+
+
+def roc_sweep(
+    *,
+    m: int = 128,
+    n: int = 128,
+    k: int = 256,
+    dtypes=("float32", "bfloat16", "float8_e4m3fn", "int8"),
+    strategies=("rowcol", "global", "weighted", "fused"),
+    encodes=("vpu", "mxu"),
+    scales=(0.1, 1.0, 16.0),
+    margin: float | None = None,
+    seed: int = 10,
+    interpret=None,
+    progress=None,
+) -> dict:
+    """Run the static-vs-adaptive ROC sweep; returns the artifact dict.
+
+    Per legal (dtype, strategy, encode) combo and per input ``scale``:
+    one CLEAN run (detections are false positives) and one
+    fault-injected run (``every=1``, magnitude ``ROC_FAULT_FACTOR`` x
+    that scale's noise bound), under the statically calibrated threshold
+    and under ``threshold="adaptive"``. ``progress`` is an optional
+    ``fn(point)`` streaming callback. The summary's per-combo verdict is
+    the acceptance contract: ``dominates`` = adaptive's aggregate
+    (fp_rate, detection_rate) Pareto-dominates static's.
+    """
+    from ft_sgemm_tpu.analysis import estimate_noise_floor
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.ops.common import DEFAULT_THRESHOLD_MARGIN
+    from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+    margin = DEFAULT_THRESHOLD_MARGIN if margin is None else margin
+    tile = KernelShape("roc", 128, 128, 128, (0,) * 7)
+    bm, bn, bk = tile.block
+    tiles = (-(-m // bm)) * (-(-n // bn))
+    nk = _num_k_steps(k, bk)
+    points: list[RocPoint] = []
+
+    def noise_bound(dtype_name, scale):
+        if dtype_name == "int8":
+            return 0.0  # exact int32 accumulation: clean residuals are 0
+        a, b = _roc_inputs(m, n, k, scale, dtype_name, seed)
+        # beta=0 below: the sweep isolates the product-term noise.
+        return estimate_noise_floor(a, b, None, alpha=1.0, beta=0.0)
+
+    for dtype_name, strategy, encode in _roc_combos(dtypes, strategies,
+                                                    encodes):
+        # The static operating point a careful engineer ships: margin x
+        # the calibrated bound at the calibration scale (the auto-mode
+        # formula, including its global-strategy sqrt(bn) scaling). For
+        # int8 the bound is 0: the sane static threshold is the half-ulp.
+        cal = noise_bound(dtype_name, ROC_CAL_SCALE)
+        static_thr = margin * cal if cal > 0 else 0.5
+        if strategy == "global" and cal > 0:
+            # The whole-tile residual aggregates ~bn column residuals
+            # (the auto-mode sqrt(bn) scaling); meaningless for int8's
+            # exact arithmetic, where the half-ulp is the whole story.
+            static_thr *= float(np.sqrt(bn))
+        for mode in ("static", "adaptive"):
+            ft = make_ft_sgemm(
+                tile, alpha=1.0, beta=0.0, strategy=strategy,
+                encode=encode, in_dtype=dtype_name,
+                threshold=("adaptive" if mode == "adaptive"
+                           else float(static_thr)),
+                threshold_margin=margin, interpret=interpret)
+            for scale in scales:
+                a, b = _roc_inputs(m, n, k, scale, dtype_name, seed)
+                c = np.zeros((m, n), np.float32)
+                bound = noise_bound(dtype_name, scale)
+                if dtype_name == "int8":
+                    mag = max(1.0, round(3.0 * scale))
+                else:
+                    mag = ROC_FAULT_FACTOR * bound
+                    if strategy == "global":
+                        # The whole-tile residual's noise (and both
+                        # modes' thresholds) carry the sqrt(bn)
+                        # aggregation factor: faults worth detecting
+                        # there are correspondingly larger.
+                        mag *= float(np.sqrt(bn))
+                clean = ft(a, b, c)
+                inj = InjectionSpec(enabled=True, every=1,
+                                    magnitude=float(mag))
+                faulty = ft(a, b, c, inj)
+                expected = tiles * inj.expected_faults(k, bk)
+                point = RocPoint(
+                    dtype=dtype_name, strategy=strategy, encode=encode,
+                    mode=mode, scale=float(scale),
+                    threshold=(None if mode == "adaptive"
+                               else float(static_thr)),
+                    magnitude=float(mag),
+                    clean_detections=int(clean.num_detected),
+                    checks=tiles * nk,
+                    expected_faults=expected,
+                    detected=int(faulty.num_detected))
+                points.append(point)
+                if progress is not None:
+                    progress(point)
+
+    return {
+        "config": {"m": m, "n": n, "k": k, "tile": list(tile.block),
+                   "scales": list(map(float, scales)),
+                   "margin": float(margin), "seed": seed,
+                   "fault_factor": ROC_FAULT_FACTOR,
+                   "cal_scale": ROC_CAL_SCALE},
+        "points": [p.to_dict() for p in points],
+        "summary": summarize_roc(points),
+    }
+
+
+def summarize_roc(points) -> dict:
+    """Aggregate ROC points into per-combo verdicts + the headline.
+
+    Per (dtype, strategy, encode): each mode's aggregate false-positive
+    rate (summed clean detections / summed check opportunities) and
+    detection rate (summed detected, capped per scale / summed expected).
+    ``dominates`` = adaptive fp <= static fp AND adaptive detection >=
+    static detection; ``strict`` additionally requires one strict
+    inequality. ``adaptive_false_positives`` totals adaptive clean
+    detections across the WHOLE sweep — the number CI grep-asserts is 0.
+    """
+    combos: dict = {}
+    for p in points:
+        key = f"{p.dtype}|{p.strategy}|{p.encode}"
+        combos.setdefault(key, {"static": [], "adaptive": []})[
+            p.mode].append(p)
+
+    def agg(ps):
+        checks = sum(p.checks for p in ps)
+        expected = sum(p.expected_faults for p in ps)
+        detected = sum(min(p.detected, p.expected_faults) for p in ps)
+        fps = sum(p.clean_detections for p in ps)
+        return {"false_positives": fps,
+                "fp_rate": fps / checks if checks else 0.0,
+                "detection_rate": detected / expected if expected else 0.0}
+
+    summary: dict = {"combos": {}}
+    adaptive_fps = 0
+    all_dominate = True
+    for key, modes in sorted(combos.items()):
+        s = agg(modes["static"])
+        a = agg(modes["adaptive"])
+        adaptive_fps += a["false_positives"]
+        dominates = (a["fp_rate"] <= s["fp_rate"]
+                     and a["detection_rate"] >= s["detection_rate"])
+        strict = dominates and (a["fp_rate"] < s["fp_rate"]
+                                or a["detection_rate"]
+                                > s["detection_rate"])
+        all_dominate &= dominates
+        summary["combos"][key] = {"static": s, "adaptive": a,
+                                  "dominates": dominates, "strict": strict}
+    summary["all_dominate"] = all_dominate
+    summary["adaptive_false_positives"] = adaptive_fps
+    return summary
+
+
 __all__ = [
     "InjectionSpec",
     "REFERENCE_MAGNITUDE",
     "REFERENCE_THRESHOLD",
     "REFERENCE_NUM_FAULTS",
+    "RocPoint",
+    "roc_sweep",
+    "summarize_roc",
 ]

@@ -146,6 +146,46 @@ def estimate_noise_floor_jnp(a, b, c, alpha: float, beta: float):
     return jnp.minimum(noise, jnp.float32(np.finfo(np.float32).max) / 16.0)
 
 
+def variance_bound_threshold(s_a1, s_a2, s_b1, s_b2, *, n_a, n_b, t_ab,
+                             log2_t, margin, c_rand=NOISE_C_RAND,
+                             c_bias=NOISE_C_BIAS, eps=None, xp=np):
+    """Per-tile variance-bound detection threshold from running moments
+    (the V-ABFT capability, arXiv 2602.08043; ``threshold="adaptive"``).
+
+    ``s_a1``/``s_a2`` are the running sum and sum-of-squares of every A
+    element this tile's checksum-encode pass has consumed so far (``n_a``
+    elements), ``s_b1``/``s_b2``/``n_b`` the B-side twins; all four are
+    nearly free VPU reductions of blocks already resident in VMEM. The
+    bound is the calibrated clean-residual noise model of
+    ``analysis.estimate_noise_floor`` evaluated on THIS tile's moments:
+
+        sigma = rms(a) * rms(b)        (sqrt of the mean-square product)
+        mu    = mean(a) * mean(b)
+        noise = eps * (c_rand * sqrt(t_ab) * sigma
+                       + c_bias * log2_t * t_ab * |mu|)
+
+    with ``t_ab`` the residual's accumulation length (``K_so_far *
+    max(bm, bn)``) and ``log2_t`` its log factor — callers pass the
+    STATIC full-run ``log2`` (monotone in t, so early checks get a
+    slightly conservative bias term and no in-kernel transcendental).
+    Returns ``margin * noise`` saturated far below f32 max (downstream
+    re-check moments scale it by up to ``bm^2``; an inf threshold would
+    silently disable the very check it parameterizes).
+
+    ``xp`` picks the array module: jnp inside the kernels (traced SMEM
+    scalars), np for the host twin (``analysis`` must stay jax-free —
+    the bench-supervisor constraint), so the two evaluations share one
+    formula and can never drift.
+    """
+    eps = float(np.finfo(np.float32).eps) if eps is None else eps
+    mu_ab = (s_a1 / n_a) * (s_b1 / n_b)
+    sigma = xp.sqrt((s_a2 / n_a) * (s_b2 / n_b))
+    noise = eps * (c_rand * xp.sqrt(t_ab) * sigma
+                   + c_bias * log2_t * t_ab * xp.abs(mu_ab))
+    cap = float(np.finfo(np.float32).max) / 16.0
+    return xp.minimum(margin * noise, cap)
+
+
 def should_interpret(interpret: Optional[bool]) -> bool:
     """Pallas interpret mode: explicit wins; otherwise interpret unless a
     real TPU backend is active (tests/CI run on CPU, SURVEY.md §4)."""
@@ -154,17 +194,30 @@ def should_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
-def resolve_in_dtype(in_dtype, precision: str):
+def resolve_in_dtype(in_dtype, precision: str, *, allow_low_precision=False):
     """Validate an ``in_dtype`` and resolve the dot precision to use with it.
 
     Returns ``(dtype, precision)``. bf16 operands force ``"default"``
     precision: Mosaic rejects fp32 contract precision on bf16 vectors ("Bad
-    lhs type"), and bf16 inputs are single-pass on the MXU anyway.
+    lhs type"), and bf16 inputs are single-pass on the MXU anyway; the
+    1-byte dtypes are likewise single-pass and take ``"default"``.
+
+    ``allow_low_precision`` opens the fp8_e4m3 / int8 serving dtypes —
+    passed by the FT factories, whose kernels carry the dtype-legal
+    widened accumulation (f32 / int32) those inputs need. The plain
+    kernels accept fp8 (the f32-accumulating dot consumes it directly)
+    but not int8.
     """
-    dt = jnp.dtype(in_dtype)
-    if dt not in (jnp.float32, jnp.bfloat16):
-        raise ValueError(f"in_dtype must be float32 or bfloat16, got {dt}")
-    return dt, ("default" if dt == jnp.bfloat16 else precision)
+    from ft_sgemm_tpu.configs import canonical_in_dtype
+
+    dt = jnp.dtype(canonical_in_dtype(in_dtype))
+    low = dt not in (jnp.float32, jnp.bfloat16)
+    if low and not allow_low_precision and dt == jnp.int8:
+        raise ValueError(
+            f"in_dtype {dt.name!r} needs the FT kernels' int32-exact"
+            " accumulation path (make_ft_sgemm); the plain kernels take"
+            " float32/bfloat16/float8_e4m3fn")
+    return dt, (precision if dt == jnp.float32 else "default")
 
 
 def dtype_suffix(in_dtype) -> str:

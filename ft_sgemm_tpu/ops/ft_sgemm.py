@@ -105,6 +105,19 @@ unchanged at the ``check_every`` cadence:
 ``encode="vpu"`` (the default) is the original per-step VPU encode,
 bit-for-bit: the encode axis changes nothing unless selected (HLO pinned
 in ``tests/test_encode_mxu.py``).
+
+**Threshold modes and the low-precision dtypes.** Detection thresholds
+come in three modes (``configs.THRESHOLD_MODES``): a fixed float /
+``"static"`` (the reference's operating point — the default, HLO pinned
+in ``tests/test_low_precision.py``), ``"auto"`` (one traced per-call
+bound from the full inputs' moments), and ``"adaptive"`` (per-tile
+per-check variance bounds derived INSIDE the kernel from running
+encode-pass moment statistics — V-ABFT, DESIGN.md §10). Adaptive mode is
+what opens the low-precision input dtypes: ``in_dtype="float8_e4m3fn"``
+runs fp8 operands over the f32-accumulating float kernels, and
+``in_dtype="int8"`` runs an int32-EXACT variant of the rowcol/global
+kernels (separate int32 accumulator block, wrapping int32 checksum
+streams — clean residuals identically zero, exact correction).
 """
 
 from __future__ import annotations
@@ -124,8 +137,10 @@ from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import (
     ENCODE_MODES,
     SHAPES,
+    THRESHOLD_MODES,
     KernelShape,
     aug_rows as _aug_rows,
+    check_kernel_legality as _check_kernel_legality,
     shape_for_dtype,
     vmem_limit_bytes,
 )
@@ -140,6 +155,7 @@ from ft_sgemm_tpu.ops.common import (
     resolve_in_dtype as _resolve_in_dtype,
     should_interpret as _should_interpret,
     shrink_block as _shrink_block,
+    variance_bound_threshold as _variance_bound_threshold,
 )
 from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
 
@@ -209,7 +225,7 @@ class FtSgemmResult(NamedTuple):
         return jnp.sum(self.uncorrectable)
 
 
-def _inject(out_ref, inj_ref, k, i, j, bm, bn):
+def _inject(out_ref, inj_ref, k, i, j, bm, bn, exact=False):
     """Add inj.magnitude to one rotating accumulator element when scheduled.
 
     Models SDC in the f32 accumulator (reference rotates the target thread:
@@ -244,8 +260,14 @@ def _inject(out_ref, inj_ref, k, i, j, bm, bn):
         rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
         hit = (rows == m0 - m0a) & (cols == n0 - n0a)
-        out_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)] = sub + jnp.where(
-            hit, magnitude, 0.0)
+        if exact:
+            # int32-exact accumulator (int8 inputs): the injected value is
+            # the rounded magnitude — SDC in the integer domain.
+            out_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)] = sub + jnp.where(
+                hit, jnp.round(magnitude).astype(jnp.int32), 0)
+        else:
+            out_ref[pl.ds(m0a, 8), pl.ds(n0a, 128)] = sub + jnp.where(
+                hit, magnitude, 0.0)
 
 
 def _moment_detect_correct(acc, exp_c, exp_cw, exp_cw2, thresholds,
@@ -321,8 +343,55 @@ def _correction_pads(delta, axis, *weights):
     return pads
 
 
+def _adaptive_threshold(mom_ref, k, *, bk, bm, bn, nk, margin,
+                        global_tile=False):
+    """Per-tile detection threshold from the running moment scratch
+    (``threshold="adaptive"`` — the V-ABFT capability).
+
+    ``mom_ref`` is the SMEM ``(4,)`` f32 scratch ``[sum_a, sumsq_a,
+    sum_b, sumsq_b]`` the encode pass accumulates over every A/B element
+    this tile has consumed through K step ``k``. The bound is the
+    calibrated noise model (``ops.common.variance_bound_threshold``, one
+    implementation shared with the host twin) evaluated on THIS tile's
+    statistics at THIS check's accumulation depth — a threshold that
+    tracks per-tile operand variance instead of assuming one global
+    operating point, which is what keeps false positives at zero when
+    tile statistics are heterogeneous or drift run-to-run (the static-
+    threshold failure mode at bf16 and below; DESIGN.md §10). The bias
+    term's log factor uses the STATIC full-run ``log2`` (monotone in t:
+    early checks get a slightly conservative bias bound and the kernel
+    traces no transcendental). The detect-only ``global`` strategy's
+    whole-tile residual aggregates ~bn column residuals, hence its
+    ``sqrt(bn)`` scale — mirroring the wrapper's ``threshold="auto"``
+    scalings exactly.
+    """
+    tk = ((k + 1) * bk).astype(jnp.float32)
+    tmax = float(max(bm, bn))
+    t_full = float(nk * bk) * tmax
+    thr = _variance_bound_threshold(
+        mom_ref[0], mom_ref[1], mom_ref[2], mom_ref[3],
+        n_a=tk * float(bm), n_b=tk * float(bn), t_ab=tk * tmax,
+        log2_t=float(np.log2(max(t_full, 2.0))), margin=margin, xp=jnp)
+    if global_tile:
+        thr = thr * float(np.sqrt(bn))
+    return thr
+
+
+def _accumulate_moments(mom_ref, af, bf):
+    """Running per-tile moment statistics of the encode pass: sum and
+    sum-of-squares per operand (``_adaptive_threshold``'s input). Four
+    whole-block VPU reductions of values already resident in VMEM —
+    overlapping the MXU dot, the "nearly free" half of the V-ABFT
+    design."""
+    mom_ref[0] += jnp.sum(af)
+    mom_ref[1] += jnp.sum(af * af)
+    mom_ref[2] += jnp.sum(bf)
+    mom_ref[3] += jnp.sum(bf * bf)
+
+
 def _rowcol_detect_correct(out_ref, count_ref, unc_count_ref, res_r, res_c,
-                           thresholds, bm, bn, multifault, moments_fn):
+                           thresholds, bm, bn, multifault, moments_fn,
+                           exact=False):
     """Shared rowcol detect / correct / re-check, from residuals to stores.
 
     The VPU-encode and MXU-encode rowcol kernels differ ONLY in where
@@ -333,10 +402,21 @@ def _rowcol_detect_correct(out_ref, count_ref, unc_count_ref, res_r, res_c,
     ``thresholds`` is ``(thr, thr_m1)``; ``moments_fn()`` returns
     ``(w_col, res_cw)`` — the weighted-residual pieces, evaluated only in
     multifault mode so the plain kernel traces no weighted-moment ops.
+    ``exact`` marks the int32 accumulation path (int8 inputs): residuals
+    are exact integers compared against the f32 threshold scalar, the
+    correction is exact integer addition, and the re-check needs no
+    rounding-floor pads.
     """
     threshold, thr_m1 = thresholds
-    det_r = jnp.abs(res_r) > threshold
-    det_c = jnp.abs(res_c) > threshold
+
+    def mag(x):
+        # |residual| in the threshold's f32 domain — a no-op cast for the
+        # float kernels (same-dtype convert is elided), the int32->f32
+        # compare domain for the exact ones.
+        return jnp.abs(x).astype(jnp.float32)
+
+    det_r = mag(res_r) > threshold
+    det_c = mag(res_c) > threshold
     hit = jnp.logical_and(det_r, det_c)                 # (bm, bn)
     # Residual source: with exactly one flagged row and several flagged
     # columns, the faults all sit in that row and the *column* residuals
@@ -361,7 +441,7 @@ def _rowcol_detect_correct(out_ref, count_ref, unc_count_ref, res_r, res_c,
         hit = jnp.where(ambiguous, hit_w, hit)
         corr = jnp.where(ambiguous, jnp.broadcast_to(res_c, hit.shape),
                          corr)
-    delta = jnp.where(hit, corr, 0.0)
+    delta = jnp.where(hit, corr, 0 if exact else 0.0)
     out_ref[:] += delta
     count_ref[0] += jnp.sum(hit.astype(jnp.int32))
     # Residual-after-correct re-check: residuals are linear in the
@@ -372,13 +452,18 @@ def _rowcol_detect_correct(out_ref, count_ref, unc_count_ref, res_r, res_c,
     # >1-row/>1-col case): REPORT instead of staying silent.
     res_r2 = res_r - jnp.sum(delta, axis=1, keepdims=True)
     res_c2 = res_c - jnp.sum(delta, axis=0, keepdims=True)
-    # Correction-rounding floors shared with the moment kernels
-    # (_correction_pads): remnants of large corrected faults must not
-    # false-flag tiny auto thresholds.
-    (pad_r,) = _correction_pads(delta, 1)
-    (pad_c,) = _correction_pads(delta, 0)
-    bad_c = jnp.abs(res_c2) > threshold + pad_c
-    bad = (jnp.sum((jnp.abs(res_r2) > threshold + pad_r)
+    if exact:
+        # Integer correction leaves no rounding remnant: the re-check
+        # compares the exact post-correction residuals unpadded.
+        pad_r = pad_c = 0.0
+    else:
+        # Correction-rounding floors shared with the moment kernels
+        # (_correction_pads): remnants of large corrected faults must not
+        # false-flag tiny auto thresholds.
+        (pad_r,) = _correction_pads(delta, 1)
+        (pad_c,) = _correction_pads(delta, 0)
+    bad_c = mag(res_c2) > threshold + pad_c
+    bad = (jnp.sum((mag(res_r2) > threshold + pad_r)
                    .astype(jnp.int32))
            + jnp.sum(bad_c.astype(jnp.int32)))
     if multifault:
@@ -418,11 +503,24 @@ def _ft_kernel_rowcol(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
     r_exp_ref, c_exp_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn, multifault,
+    exact=False, adaptive=False, bk=None,
 ):
+    # Optional scratch tail, in declaration order (_scratch_for): the
+    # multifault weighted stream, the int32-exact accumulator (int8
+    # inputs accumulate apart from the f32 output block), the adaptive
+    # moment scalars, then the counters.
+    idx = 0
     if multifault:
-        cw_exp_ref, count_ref, unc_count_ref = rest
-    else:
-        count_ref, unc_count_ref = rest
+        cw_exp_ref = rest[idx]
+        idx += 1
+    acc_ref = out_ref
+    if exact:
+        acc_ref = rest[idx]
+        idx += 1
+    if adaptive and not exact:
+        mom_ref = rest[idx]
+        idx += 1
+    count_ref, unc_count_ref = rest[idx], rest[idx + 1]
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -431,34 +529,40 @@ def _ft_kernel_rowcol(
 
     @pl.when(k == 0)
     def _zero():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
         r_exp_ref[:] = jnp.zeros_like(r_exp_ref)
         c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
         if multifault:
             cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
+        if adaptive and not exact:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
         unc_count_ref[0] = 0
 
-    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+    _inject(acc_ref, inj_ref, k, i, j, bm, bn, exact=exact)
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
 
-    # MXU: main partial product.
-    out_ref[:] += jax.lax.dot_general(
+    # MXU: main partial product. f32 accumulation for the float dtypes;
+    # int8 inputs accumulate EXACTLY in int32 (preferred_element_type) —
+    # clean checksum residuals are then identically zero mod 2^32.
+    acc_ref[:] += jax.lax.dot_general(
         a_blk, b_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.int32 if exact else jnp.float32,
         precision=prec,
     )
 
     # VPU: panel input checksums (replaces __shfl_xor butterflies) and
-    # expected row/col sums of the accumulated product. Always f32: for bf16
-    # inputs the checksums are computed on the same rounded values the MXU
-    # consumes, so input rounding cancels out of the residual and only f32
-    # accumulation-order noise remains (same class as the f32 path).
-    af = a_blk.astype(jnp.float32)
-    bf = b_blk.astype(jnp.float32)
+    # expected row/col sums of the accumulated product. Always the
+    # accumulation dtype: for bf16/fp8 inputs the checksums are computed in
+    # f32 on the same rounded values the MXU consumes, so input rounding
+    # cancels out of the residual and only f32 accumulation-order noise
+    # remains; for int8 the int32 checksum arithmetic wraps consistently
+    # with the accumulator (mod 2^32), keeping clean residuals exactly 0.
+    af = a_blk.astype(jnp.int32 if exact else jnp.float32)
+    bf = b_blk.astype(jnp.int32 if exact else jnp.float32)
     s_b = jnp.sum(bf, axis=0, keepdims=True)               # (1, bk)
     s_a = jnp.sum(af, axis=0, keepdims=True)               # (1, bk)
     r_exp_ref[:] += jnp.sum(af * s_b, axis=1, keepdims=True)     # (bm, 1)
@@ -472,16 +576,30 @@ def _ft_kernel_rowcol(
             jnp.int32, (bm, 1), 0).astype(jnp.float32) + 1.0
         s_aw = jnp.sum(af * w_col, axis=0, keepdims=True)  # (1, bk)
         cw_exp_ref[:] += jnp.sum(bf * s_aw, axis=1, keepdims=True)  # (bn, 1)
+    if adaptive and not exact:
+        _accumulate_moments(mom_ref, af, bf)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
     @pl.when(do_check)
     def _detect_correct():
-        acc = out_ref[:]
+        acc = acc_ref[:]
         rs = jnp.sum(acc, axis=1, keepdims=True)            # (bm, 1)
         cs = jnp.sum(acc, axis=0, keepdims=True)            # (1, bn)
         res_r = r_exp_ref[:] - rs                           # (bm, 1)
         res_c = jnp.swapaxes(c_exp_ref[:], 0, 1) - cs       # (1, bn)
+        if adaptive:
+            if exact:
+                # Exact integer arithmetic: any nonzero residual is a
+                # fault — the adaptive "variance bound" is the half-ulp.
+                thr = thr_w = jnp.float32(0.5)
+            else:
+                thr = _adaptive_threshold(mom_ref, k, bk=bk, bm=bm, bn=bn,
+                                          nk=nk, margin=inj_ref[7])
+                thr_w = thr * float(bm / np.sqrt(3.0))
+            thrs = (thr, thr_w)
+        else:
+            thrs = (threshold, thr_m1)
 
         def moments():
             w_col = jax.lax.broadcasted_iota(
@@ -490,21 +608,26 @@ def _ft_kernel_rowcol(
             res_cw = jnp.swapaxes(cw_exp_ref[:], 0, 1) - csw     # (1, bn)
             return w_col, res_cw
 
-        _rowcol_detect_correct(out_ref, count_ref, unc_count_ref,
-                               res_r, res_c, (threshold, thr_m1), bm, bn,
-                               multifault, moments)
+        _rowcol_detect_correct(acc_ref, count_ref, unc_count_ref,
+                               res_r, res_c, thrs, bm, bn,
+                               multifault, moments, exact=exact)
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        if exact:
+            out_ref[:] = (alpha * acc_ref[:].astype(jnp.float32)
+                          + beta * c_ref[:])
+        else:
+            out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
 
 
 def _ft_kernel_rowcol_mxu(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
-    r_exp_ref, c_exp_ref, count_ref, unc_count_ref,
-    *, alpha, beta, nk, prec, check_every, bm, bn, multifault, n_terms,
+    r_exp_ref, c_exp_ref, *rest,
+    alpha, beta, nk, prec, check_every, bm, bn, multifault, n_terms,
+    adaptive=False, bk=None,
 ):
     """Rowcol with MXU-fused encode (``encode="mxu"`` — module docstring).
 
@@ -522,7 +645,17 @@ def _ft_kernel_rowcol_mxu(
     at the same cadence. SDC landing in a checksum row/column itself
     surfaces as a residual with no consistent intersection: the re-check
     flags the interval as uncorrectable (those rows never touch C).
+
+    ``adaptive`` appends the moment scratch and accumulates the per-tile
+    operand statistics on the VPU from the UN-augmented block slices
+    (the checksum tail rows are derived data, not operand samples) while
+    the MXU runs the augmented dot — the two-unit overlap the V-ABFT
+    design counts on.
     """
+    if adaptive:
+        (mom_ref, count_ref, unc_count_ref) = rest
+    else:
+        count_ref, unc_count_ref = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -534,13 +667,17 @@ def _ft_kernel_rowcol_mxu(
         out_ref[:] = jnp.zeros_like(out_ref)
         r_exp_ref[:] = jnp.zeros_like(r_exp_ref)
         c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
+        if adaptive:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
         unc_count_ref[0] = 0
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
     prod = jax.lax.dot_general(
-        a_ref[:], b_ref[:],
+        a_blk, b_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
@@ -548,6 +685,9 @@ def _ft_kernel_rowcol_mxu(
     out_ref[:] += prod[:bm, :bn]
     c_exp_ref[:] += prod[bm:, :bn]
     r_exp_ref[:] += prod[:bm, bn:]
+    if adaptive:
+        _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
+                            b_blk[:bn].astype(jnp.float32))
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -566,6 +706,12 @@ def _ft_kernel_rowcol_mxu(
             c_exp = c_exp + c_exp_ref[2 * t:2 * t + 1, :]
             cw_exp = cw_exp + c_exp_ref[2 * t + 1:2 * t + 2, :]
         res_c = c_exp - cs
+        if adaptive:
+            thr = _adaptive_threshold(mom_ref, k, bk=bk, bm=bm, bn=bn,
+                                      nk=nk, margin=inj_ref[7])
+            thrs = (thr, thr * float(bm / np.sqrt(3.0)))
+        else:
+            thrs = (threshold, thr_m1)
 
         def moments():
             w_col = jax.lax.broadcasted_iota(
@@ -574,7 +720,7 @@ def _ft_kernel_rowcol_mxu(
             return w_col, cw_exp - csw
 
         _rowcol_detect_correct(out_ref, count_ref, unc_count_ref,
-                               res_r, res_c, (threshold, thr_m1), bm, bn,
+                               res_r, res_c, thrs, bm, bn,
                                multifault, moments)
 
     @pl.when(k == nk - 1)
@@ -586,8 +732,9 @@ def _ft_kernel_rowcol_mxu(
 
 def _ft_kernel_global_mxu(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
-    t_exp_ref, prev_ref, count_ref,
-    *, alpha, beta, nk, prec, check_every, bm, bn,
+    t_exp_ref, prev_ref, count_ref, *rest,
+    alpha, beta, nk, prec, check_every, bm, bn,
+    adaptive=False, bk=None,
 ):
     """Global (scalar-checksum, detect-only) with MXU-fused encode.
 
@@ -597,6 +744,8 @@ def _ft_kernel_global_mxu(
     product's expected sum (zero pad rows/columns contribute nothing).
     Detection is byte-for-byte :func:`_ft_kernel_global`'s.
     """
+    if adaptive:
+        (mom_ref,) = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -607,18 +756,25 @@ def _ft_kernel_global_mxu(
         out_ref[:] = jnp.zeros_like(out_ref)
         t_exp_ref[0] = 0.0
         prev_ref[0] = 0.0
+        if adaptive:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
     prod = jax.lax.dot_general(
-        a_ref[:], b_ref[:],
+        a_blk, b_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
     )                             # (bm + aug, bn + aug)
     out_ref[:] += prod[:bm, :bn]
     t_exp_ref[0] += jnp.sum(prod[bm:, bn:])
+    if adaptive:
+        _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
+                            b_blk[:bn].astype(jnp.float32))
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -626,7 +782,13 @@ def _ft_kernel_global_mxu(
     def _detect():
         # Fault EVENTS, not failed checks — see _ft_kernel_global.
         res = t_exp_ref[0] - jnp.sum(out_ref[:])
-        count_ref[0] += (jnp.abs(res - prev_ref[0]) > threshold).astype(
+        if adaptive:
+            thr = _adaptive_threshold(mom_ref, k, bk=bk, bm=bm, bn=bn,
+                                      nk=nk, margin=inj_ref[7],
+                                      global_tile=True)
+        else:
+            thr = threshold
+        count_ref[0] += (jnp.abs(res - prev_ref[0]) > thr).astype(
             jnp.int32)
         prev_ref[0] = res
 
@@ -641,10 +803,19 @@ def _ft_kernel_global_mxu(
 
 def _ft_kernel_global(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
-    t_exp_ref, prev_ref, count_ref,
-    *, alpha, beta, nk, prec, check_every, bm, bn,
+    t_exp_ref, prev_ref, count_ref, *rest,
+    alpha, beta, nk, prec, check_every, bm, bn,
+    exact=False, adaptive=False, bk=None,
 ):
     """Scalar-checksum, detect-only variant (``ft_sgemm_huge_thread.cuh``)."""
+    idx = 0
+    acc_ref = out_ref
+    if exact:
+        acc_ref = rest[idx]
+        idx += 1
+    if adaptive and not exact:
+        mom_ref = rest[idx]
+        idx += 1
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -652,25 +823,31 @@ def _ft_kernel_global(
 
     @pl.when(k == 0)
     def _zero():
-        out_ref[:] = jnp.zeros_like(out_ref)
-        t_exp_ref[0] = 0.0
-        prev_ref[0] = 0.0
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        t_exp_ref[0] = 0 if exact else 0.0
+        prev_ref[0] = 0 if exact else 0.0
+        if adaptive and not exact:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
 
-    _inject(out_ref, inj_ref, k, i, j, bm, bn)
+    _inject(acc_ref, inj_ref, k, i, j, bm, bn, exact=exact)
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    out_ref[:] += jax.lax.dot_general(
+    acc_ref[:] += jax.lax.dot_general(
         a_blk, b_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.int32 if exact else jnp.float32,
         precision=prec,
     )
-    s_b = jnp.sum(b_blk.astype(jnp.float32), axis=0, keepdims=True)  # (1, bk)
+    enc_t = jnp.int32 if exact else jnp.float32
+    s_b = jnp.sum(b_blk.astype(enc_t), axis=0, keepdims=True)  # (1, bk)
     # Total expected sum of this panel's product: sum_k s_a[k] * s_b[k].
     t_exp_ref[0] += jnp.sum(
-        jnp.sum(a_blk.astype(jnp.float32), axis=0, keepdims=True) * s_b)
+        jnp.sum(a_blk.astype(enc_t), axis=0, keepdims=True) * s_b)
+    if adaptive and not exact:
+        _accumulate_moments(mom_ref, a_blk.astype(jnp.float32),
+                            b_blk.astype(jnp.float32))
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -681,14 +858,24 @@ def _ft_kernel_global(
         # residual — only NEW corruption (residual moved by > threshold)
         # increments the count. Makes num_detected comparable across
         # strategies (FtSgemmResult docstring).
-        res = t_exp_ref[0] - jnp.sum(out_ref[:])
-        count_ref[0] += (jnp.abs(res - prev_ref[0]) > threshold).astype(
-            jnp.int32)
+        res = t_exp_ref[0] - jnp.sum(acc_ref[:])
+        if adaptive:
+            thr = (jnp.float32(0.5) if exact else _adaptive_threshold(
+                mom_ref, k, bk=bk, bm=bm, bn=bn, nk=nk, margin=inj_ref[7],
+                global_tile=True))
+        else:
+            thr = threshold
+        count_ref[0] += (jnp.abs(res - prev_ref[0]).astype(jnp.float32)
+                         > thr).astype(jnp.int32)
         prev_ref[0] = res
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        if exact:
+            out_ref[:] = (alpha * acc_ref[:].astype(jnp.float32)
+                          + beta * c_ref[:])
+        else:
+            out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
         det_ref[i, j] = count_ref[0]
         # Detect-only strategy: every detection is by definition
         # uncorrected (FtSgemmResult docstring).
@@ -697,8 +884,9 @@ def _ft_kernel_global(
 
 def _ft_kernel_weighted(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
-    c_exp_ref, cw_exp_ref, cw2_exp_ref, count_ref, unc_count_ref,
-    *, alpha, beta, nk, prec, check_every, bm, bn,
+    c_exp_ref, cw_exp_ref, cw2_exp_ref, *rest,
+    alpha, beta, nk, prec, check_every, bm, bn,
+    adaptive=False, bk=None,
 ):
     """Weighted-checksum variant with fault *localization*.
 
@@ -708,6 +896,10 @@ def _ft_kernel_weighted(
     reference's ``correct_t`` macro, ``include/ft_sgemm_huge.cuh:13-17``,
     with weight base {1..8} generalized to {1..bm}).
     """
+    if adaptive:
+        (mom_ref, count_ref, unc_count_ref) = rest
+    else:
+        count_ref, unc_count_ref = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -724,6 +916,8 @@ def _ft_kernel_weighted(
         c_exp_ref[:] = jnp.zeros_like(c_exp_ref)
         cw_exp_ref[:] = jnp.zeros_like(cw_exp_ref)
         cw2_exp_ref[:] = jnp.zeros_like(cw2_exp_ref)
+        if adaptive:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
         unc_count_ref[0] = 0
 
@@ -750,16 +944,25 @@ def _ft_kernel_weighted(
     c_exp_ref[:] += jnp.sum(bf * s_a, axis=1, keepdims=True)       # (bn, 1)
     cw_exp_ref[:] += jnp.sum(bf * s_aw, axis=1, keepdims=True)     # (bn, 1)
     cw2_exp_ref[:] += jnp.sum(bf * s_aw2, axis=1, keepdims=True)   # (bn, 1)
+    if adaptive:
+        _accumulate_moments(mom_ref, af, bf)
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
     @pl.when(do_check)
     def _detect_correct():
+        if adaptive:
+            thr = _adaptive_threshold(mom_ref, k, bk=bk, bm=bm, bn=bn,
+                                      nk=nk, margin=inj_ref[7])
+            thrs = (thr, thr * float(bm / np.sqrt(3.0)),
+                    thr * float(bm ** 2 / np.sqrt(5.0)))
+        else:
+            thrs = (threshold, thr_m1, thr_m2)
         corrected, n_hit, n_unc = _moment_detect_correct(
             out_ref[:], jnp.swapaxes(c_exp_ref[:], 0, 1),
             jnp.swapaxes(cw_exp_ref[:], 0, 1),
             jnp.swapaxes(cw2_exp_ref[:], 0, 1),
-            (threshold, thr_m1, thr_m2), bm, bn)
+            thrs, bm, bn)
         out_ref[:] = corrected
         count_ref[0] += n_hit
         unc_count_ref[0] = n_unc  # LEVEL semantics (helper docstring)
@@ -833,8 +1036,9 @@ def _ft_kernel_weighted_precomp(
 
 def _ft_kernel_fused(
     inj_ref, a_ref, b_ref, c_ref, out_ref, det_ref, unc_ref,
-    exp_ref, count_ref, unc_count_ref,
-    *, alpha, beta, nk, prec, check_every, bm, bn, n_terms,
+    exp_ref, *rest,
+    alpha, beta, nk, prec, check_every, bm, bn, n_terms,
+    adaptive=False, bk=None,
 ):
     """MXU-fused checksum variant (warp-level analog — module docstring).
 
@@ -850,6 +1054,10 @@ def _ft_kernel_fused(
     misses, the re-check flags, and the interval is reported uncorrectable
     (never applied to C, which those rows never touch).
     """
+    if adaptive:
+        (mom_ref, count_ref, unc_count_ref) = rest
+    else:
+        count_ref, unc_count_ref = rest
     k = pl.program_id(2)
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -861,19 +1069,26 @@ def _ft_kernel_fused(
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
         exp_ref[:] = jnp.zeros_like(exp_ref)
+        if adaptive:
+            mom_ref[:] = jnp.zeros_like(mom_ref)
         count_ref[0] = 0
         unc_count_ref[0] = 0
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
+    a_blk = a_ref[:]
+    b_blk = b_ref[:]
     prod = jax.lax.dot_general(
-        a_ref[:], b_ref[:],
+        a_blk, b_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
     )                                   # (bm + aug, bn): C rows + moments
     out_ref[:] += prod[:bm, :]
     exp_ref[:] += prod[bm:, :]
+    if adaptive:
+        _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
+                            b_blk.astype(jnp.float32))
 
     do_check = ((k + 1) % check_every == 0) | (k == nk - 1)
 
@@ -885,9 +1100,16 @@ def _ft_kernel_fused(
         for t in range(1, n_terms):
             exp = [e + exp_ref[3 * t + mi:3 * t + mi + 1, :]
                    for mi, e in enumerate(exp)]
+        if adaptive:
+            thr = _adaptive_threshold(mom_ref, k, bk=bk, bm=bm, bn=bn,
+                                      nk=nk, margin=inj_ref[7])
+            thrs = (thr, thr * float(bm / np.sqrt(3.0)),
+                    thr * float(bm ** 2 / np.sqrt(5.0)))
+        else:
+            thrs = (threshold, thr_m1, thr_m2)
         corrected, n_hit, n_unc = _moment_detect_correct(
             out_ref[:], exp[0], exp[1], exp[2],
-            (threshold, thr_m1, thr_m2), bm, bn)
+            thrs, bm, bn)
         out_ref[:] = corrected
         count_ref[0] += n_hit
         unc_count_ref[0] = n_unc  # LEVEL semantics (helper docstring)
@@ -969,6 +1191,15 @@ def _expected_col_checksums(ap, bp, bm, prec):
     """
     rows = _tile_moments(ap, bm)                     # (gm, R, K)
     gm, r, kdim = rows.shape
+    if bp.dtype.itemsize == 1:
+        # fp8 operands: the moment rows are f32 (magnitudes ~bm * max|x|
+        # are unrepresentable in e4m3 — the same reason encode="mxu" is
+        # illegal for 1-byte dtypes), so the precompute dot upcasts B and
+        # runs at full f32 precision; expectations then carry only f32
+        # accumulation noise over the SAME fp8-rounded values the kernel
+        # consumes, exactly like the in-kernel VPU encode.
+        bp = bp.astype(jnp.float32)
+        prec = jax.lax.Precision("highest")
     exp = jax.lax.dot_general(
         rows.reshape(gm * r, kdim), bp,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -982,24 +1213,35 @@ def _expected_col_checksums(ap, bp, bm, prec):
     return grouped.reshape(8 * gm, exp.shape[2])
 
 
-def _scratch_for(strategy, bm, bn, multifault):
-    # No accumulator scratch: the kernels accumulate in the resident f32
-    # output block (see _matmul_kernel in ops/sgemm.py for the rationale).
+def _scratch_for(strategy, bm, bn, multifault, exact=False, adaptive=False):
+    # No accumulator scratch on the float paths: the kernels accumulate in
+    # the resident f32 output block (see _matmul_kernel in ops/sgemm.py for
+    # the rationale). The int8-exact path (``exact``) accumulates apart in
+    # an int32 VMEM block (the f32 output cannot hold wrapping int32
+    # partials) with int32 checksum streams; adaptive mode appends the
+    # (4,) SMEM moment scalars the in-kernel threshold derivation reads
+    # (skipped for exact — its threshold is the constant half-ulp).
     count = pltpu.SMEM((1,), jnp.int32)
     unc = pltpu.SMEM((1,), jnp.int32)
+    acc_t = jnp.int32 if exact else jnp.float32
+    extra = []
+    if exact:
+        extra.append(pltpu.VMEM((bm, bn), jnp.int32))      # acc
+    if adaptive and not exact:
+        extra.append(pltpu.SMEM((4,), jnp.float32))        # moments
     if strategy == "rowcol":
-        vecs = [pltpu.VMEM((bm, 1), jnp.float32),
-                pltpu.VMEM((bn, 1), jnp.float32)]
+        vecs = [pltpu.VMEM((bm, 1), acc_t),
+                pltpu.VMEM((bn, 1), acc_t)]
         if multifault:
             vecs.append(pltpu.VMEM((bn, 1), jnp.float32))  # cw_exp
-        return [*vecs, count, unc]
+        return [*vecs, *extra, count, unc]
     if strategy == "global":
-        return [pltpu.SMEM((1,), jnp.float32),
-                pltpu.SMEM((1,), jnp.float32), count]
+        return [pltpu.SMEM((1,), acc_t),
+                pltpu.SMEM((1,), acc_t), count, *extra]
     if strategy == "weighted":
         return [pltpu.VMEM((bn, 1), jnp.float32),
                 pltpu.VMEM((bn, 1), jnp.float32),
-                pltpu.VMEM((bn, 1), jnp.float32), count, unc]
+                pltpu.VMEM((bn, 1), jnp.float32), *extra, count, unc]
     raise ValueError(f"unknown strategy {strategy!r}; pick from {STRATEGIES}")
 
 
@@ -1033,13 +1275,13 @@ def resolve_kernel_strategy(strategy: str, encode: str) -> str:
     jax.jit,
     static_argnames=(
         "shape", "alpha", "beta", "precision", "check_every",
-        "strategy", "interpret", "multifault",
+        "strategy", "interpret", "multifault", "adaptive",
     ),
 )
 def _ft_sgemm_padded(
     a, b, c, inj,
     *, shape: KernelShape, alpha, beta, precision, threshold, check_every,
-    strategy, interpret, multifault=False,
+    strategy, interpret, multifault=False, adaptive=False, margin=None,
 ):
     m, k = a.shape
     n, _ = b.shape
@@ -1048,6 +1290,9 @@ def _ft_sgemm_padded(
     gm, gn = m // bm, n // bn
     prec = jax.lax.Precision(precision)
     check_every = max(1, check_every)
+    # int8 inputs run the int32-exact accumulation bodies (rowcol/global
+    # only — configs.check_kernel_legality gates the rest).
+    exact = a.dtype == jnp.int8
     # Runtime thresholds ride the scalar operand (slots 4-6: detection,
     # weighted-moment re-check, second-moment re-check): per-call —
     # including traced, data-dependent "auto" — thresholds at zero
@@ -1056,16 +1301,23 @@ def _ft_sgemm_padded(
     # scalings (bm, bm^2) could re-overflow an already-saturated bound to
     # inf, which would silently disable the very check it parameterizes.
     cap = jnp.float32(np.finfo(np.float32).max / 16.0)
-    inj = jnp.concatenate([
+    parts = [
         jnp.asarray(inj, jnp.float32),
         jnp.stack([jnp.minimum(jnp.asarray(t, jnp.float32), cap)
-                   for t in threshold])])
+                   for t in threshold])]
+    if adaptive:
+        # Slot 7: the threshold margin the in-kernel variance-bound
+        # derivation multiplies (slots 4-6 are unread in adaptive mode).
+        parts.append(jnp.asarray(margin, jnp.float32)[None])
+    inj = jnp.concatenate(parts)
 
     # Weighted strategy at its default single-final-check cadence: expected
     # checksums are closed-form totals, precomputed by XLA outside the
     # kernel (see _ft_kernel_weighted_precomp). Intermediate cadences need
-    # the running in-kernel encode.
-    precomp = strategy == "weighted" and check_every >= nk
+    # the running in-kernel encode — as does adaptive mode, whose moment
+    # statistics ride the encode pass.
+    precomp = (strategy == "weighted" and check_every >= nk
+               and not adaptive)
 
     a_rows = bm  # A block / output block row count (augmented for MXU encode)
     b_rows = bn  # B block row count (augmented when B carries checksum rows)
@@ -1094,9 +1346,12 @@ def _ft_sgemm_padded(
             _ft_kernel_fused,
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn, n_terms=n_terms,
+            adaptive=adaptive, bk=bk,
         )
-        scratch = [pltpu.VMEM((aug, bn), jnp.float32),
-                   pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
+        scratch = [pltpu.VMEM((aug, bn), jnp.float32)]
+        if adaptive:
+            scratch.append(pltpu.SMEM((4,), jnp.float32))
+        scratch += [pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
     elif strategy == "rowcol_mxu":
         aug = _aug_rows(a.dtype.itemsize)
         a_rows, b_rows, _ = shape.aug_block(aug, aug)
@@ -1107,10 +1362,13 @@ def _ft_sgemm_padded(
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
             multifault=multifault, n_terms=n_terms,
+            adaptive=adaptive, bk=bk,
         )
         scratch = [pltpu.VMEM((bm, aug), jnp.float32),   # r_exp term cols
-                   pltpu.VMEM((aug, bn), jnp.float32),   # c_exp moment rows
-                   pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
+                   pltpu.VMEM((aug, bn), jnp.float32)]   # c_exp moment rows
+        if adaptive:
+            scratch.append(pltpu.SMEM((4,), jnp.float32))
+        scratch += [pltpu.SMEM((1,), jnp.int32), pltpu.SMEM((1,), jnp.int32)]
     elif strategy == "global_mxu":
         aug = _aug_rows(a.dtype.itemsize)
         a_rows, b_rows, _ = shape.aug_block(aug, aug)
@@ -1120,18 +1378,25 @@ def _ft_sgemm_padded(
             _ft_kernel_global_mxu,
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
+            adaptive=adaptive, bk=bk,
         )
         scratch = [pltpu.SMEM((1,), jnp.float32),
                    pltpu.SMEM((1,), jnp.float32), pltpu.SMEM((1,), jnp.int32)]
+        if adaptive:
+            scratch.append(pltpu.SMEM((4,), jnp.float32))
     else:
         extra = {"multifault": multifault} if strategy == "rowcol" else {}
+        if strategy in ("rowcol", "global"):
+            extra["exact"] = exact
         kernel = functools.partial(
             _KERNELS[strategy],
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
+            adaptive=adaptive, bk=bk,
             **extra,
         )
-        scratch = _scratch_for(strategy, bm, bn, multifault)
+        scratch = _scratch_for(strategy, bm, bn, multifault,
+                               exact=exact, adaptive=adaptive)
     in_specs[1] = pl.BlockSpec((a_rows, bk), lambda i, j, kk: (i, kk))
     in_specs[2] = pl.BlockSpec((b_rows, bk), lambda i, j, kk: (j, kk))
 
@@ -1212,7 +1477,18 @@ def make_ft_sgemm(
     format; the accumulator, checksums, and detect/correct math all stay
     f32. Checksums are computed on the bf16-rounded values the MXU actually
     consumes, so the residual noise floor is unchanged from the f32 path and
-    the same thresholds apply.
+    the same thresholds apply. ``in_dtype="float8_e4m3fn"`` (aliases
+    ``fp8``/``fp8_e4m3``) works the same way — fp8 operands, f32
+    accumulation, f32 checksums over the rounded values.
+    ``in_dtype="int8"`` runs the int32-EXACT path: the dot accumulates in
+    int32 (a separate VMEM accumulator block), the checksum streams are
+    int32, and wrapping arithmetic keeps residuals exact mod 2^32 — clean
+    residuals are identically zero and corrections are exact. Pass
+    integer-valued data (the cast truncates fractions). Per-dtype
+    legality (``configs.check_kernel_legality``): the 1-byte dtypes
+    cannot carry MXU checksum rows (``encode="vpu"`` only, no ``fused``),
+    and int8 ships the non-ratio-localizing strategies
+    (``rowcol``/``global``, no ``multifault``) — see DESIGN.md §10.
 
     ``strategy="fused"`` runs the MXU-augmented variant (module docstring):
     checksum moments ride extra A rows through the same dot — weighted-
@@ -1229,15 +1505,29 @@ def make_ft_sgemm(
     always encodes on the MXU. Detection, correction, cadence, threshold,
     and reporting semantics are identical across encodes.
 
-    ``threshold="auto"`` computes the detection threshold PER CALL from
-    the inputs' moments: ``threshold_margin`` x the calibrated
-    closed-form noise-floor bound (``analysis.estimate_noise_floor``; the
-    V-ABFT-style adaptive-threshold capability). Thresholds are runtime
-    scalars riding the kernels' SMEM operand, so auto mode — and any
-    threshold change — costs zero recompiles and composes under ``jit``.
-    With the reference's quantized inputs at 4096 this lands near 0.02
-    instead of 9500: faults five orders of magnitude smaller become
-    reliably detectable, at an unchanged false-positive margin.
+    ``threshold`` is a float (one fixed detection threshold — the
+    reference's operating point; the literal ``"static"`` names this
+    default and lowers to byte-identical HLO) or a mode string:
+
+    - ``"auto"`` computes the threshold PER CALL from the full inputs'
+      moments: ``threshold_margin`` x the calibrated closed-form
+      noise-floor bound (``analysis.estimate_noise_floor``). Same kernel
+      program as static — thresholds are runtime scalars riding the SMEM
+      operand, so the mode costs zero recompiles and composes under
+      ``jit``. With the reference's quantized inputs at 4096 this lands
+      near 0.02 instead of 9500: faults five orders of magnitude smaller
+      become reliably detectable, at an unchanged false-positive margin.
+    - ``"adaptive"`` derives the threshold PER TILE PER CHECK inside the
+      kernel (the V-ABFT capability, DESIGN.md §10): the encode pass
+      accumulates each tile's running sum and sum-of-squares (four VPU
+      reductions overlapping the MXU dot, both encodes), and every check
+      evaluates ``threshold_margin`` x the variance bound at that tile's
+      statistics and accumulation depth. The mode that holds zero false
+      positives under heterogeneous or drifting operand statistics —
+      what makes detection calibrated at bf16 and below (``cli roc``
+      produces the static-vs-adaptive domination artifact). Correction
+      semantics are unchanged; the weighted strategy runs its in-kernel
+      encode body (the precomp body has no encode pass to ride).
 
     ``tunable`` controls whether dispatch consults the autotuner's tile
     cache (``ft_sgemm_tpu.tuner``). Default ``None`` resolves to "named
@@ -1254,13 +1544,29 @@ def make_ft_sgemm(
     if encode not in ENCODE_MODES:
         raise ValueError(
             f"unknown encode mode {encode!r}; pick from {ENCODE_MODES}")
+    if isinstance(threshold, str):
+        if threshold not in THRESHOLD_MODES:
+            raise ValueError(
+                f"threshold must be a float or one of {THRESHOLD_MODES},"
+                f" got {threshold!r}")
+        threshold_mode = threshold
+        if threshold == "static":
+            threshold = REFERENCE_THRESHOLD  # the named default spelling
+    else:
+        threshold_mode = "static"
+    adaptive = threshold_mode == "adaptive"
+    # Low-precision / threshold-mode legality (per-dtype constraints:
+    # 1-byte dtypes cannot carry MXU checksum rows; int8 runs the exact
+    # non-localizing strategies) — one gate shared with the CLI and tuner.
+    in_dtype = _check_kernel_legality(
+        strategy=strategy, encode=encode, in_dtype=in_dtype,
+        threshold_mode=threshold_mode, multifault=multifault)
     if strategy == "fused":
         encode = "mxu"  # the fused strategy IS the weighted MXU encode
     kernel_strategy = resolve_kernel_strategy(strategy, encode)
-    if isinstance(threshold, str) and threshold != "auto":
-        raise ValueError(
-            f"threshold must be a float or 'auto', got {threshold!r}")
-    in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
+    in_dtype, precision = _resolve_in_dtype(in_dtype, precision,
+                                            allow_low_precision=True)
+    exact = in_dtype == jnp.int8
     named = isinstance(shape, str)
     tunable = named if tunable is None else bool(tunable)
     if named:
@@ -1291,7 +1597,8 @@ def make_ft_sgemm(
                 m, n, a.shape[1],
                 strategy=("weighted" if strategy == "fused" else strategy),
                 encode=encode, in_dtype=in_dtype,
-                injection_enabled=inject.enabled)
+                injection_enabled=inject.enabled,
+                threshold_mode=("adaptive" if adaptive else "static"))
             if tuned is not None:
                 eff = tuned
 
@@ -1341,13 +1648,15 @@ def make_ft_sgemm(
         # call, scripts/tune_tiles.py).
         nk0, ce0 = resolve_cadence(eff)
         variant = kernel_strategy
-        if kernel_strategy == "weighted" and ce0 >= nk0:
+        if kernel_strategy == "weighted" and ce0 >= nk0 and not adaptive:
+            # Adaptive mode always runs the in-kernel encode body: its
+            # moment statistics ride the encode pass (_ft_sgemm_padded).
             variant = "weighted_precomp"
         limit = vmem_limit_bytes()
         itemsize = jnp.dtype(in_dtype).itemsize
         eff = _fit_block_to_vmem(
             eff, variant, limit=limit, in_itemsize=itemsize,
-            allow_shrink=named)
+            allow_shrink=named, adaptive=adaptive, exact=exact)
         if variant == "weighted_precomp":
             nk1, ce1 = resolve_cadence(eff)
             if ce1 < nk1:
@@ -1356,22 +1665,32 @@ def make_ft_sgemm(
                 # encode body will run after all — re-fit against it.
                 eff = _fit_block_to_vmem(
                     eff, "weighted", limit=limit, in_itemsize=itemsize,
-                    allow_shrink=named)
+                    allow_shrink=named, adaptive=adaptive, exact=exact)
         bm, bn, bk = eff.block
         ap = _pad_to(a, bm, bk)
         bp = _pad_to(b, bn, bk)
         cp = _pad_to(c, bm, bn)
         nk = ap.shape[1] // bk
         _, ce = resolve_cadence(eff)
-        if strategy != "rowcol":
-            mf = False  # only rowcol reads the flag; keep jit keys stable
+        if strategy != "rowcol" or exact:
+            # Only rowcol reads the flag (keep jit keys stable); the
+            # int8-exact path never localizes by weighted ratio
+            # (configs.check_kernel_legality rejects an explicit True).
+            mf = False
         elif multifault is None:
             # Auto: the weighted checksum is dead weight iff the injection
             # schedule guarantees <= 1 fault per check interval.
             mf = not (inject.enabled and ce <= max(1, inject.every))
         else:
             mf = multifault
-        if threshold == "auto":
+        margin = None
+        if adaptive:
+            # Per-tile thresholds are derived INSIDE the kernel from the
+            # encode pass's running moments; only the margin crosses the
+            # host boundary (slots 4-6 ride along zeroed and unread).
+            thr = thr_m1 = thr_m2 = jnp.float32(0.0)
+            margin = jnp.float32(threshold_margin)
+        elif threshold == "auto":
             # Data-dependent thresholds from the PRE-pad inputs (padding
             # zeros would dilute the moments); traced, so they follow the
             # actual call-time data even under jit. The weighted (w) and
@@ -1398,6 +1717,7 @@ def make_ft_sgemm(
                 shape=eff, alpha=alpha, beta=beta, precision=precision,
                 threshold=(thr, thr_m1, thr_m2), check_every=ce,
                 strategy=kernel_strategy, multifault=mf,
+                adaptive=adaptive, margin=margin,
                 interpret=_should_interpret(interpret),
             )
         result = FtSgemmResult(out[:m, :n], det, unc)
@@ -1405,19 +1725,39 @@ def make_ft_sgemm(
             # Host-side observation of the already-materialized counters
             # (skipped automatically when they are tracers — a caller's
             # jit); the jitted computation above is untouched either way.
+            # Adaptive mode records the host-recomputed full-run threshold
+            # estimate and the variance statistic it derives from (the
+            # in-kernel per-tile values never materialize on host).
+            variance = thr_rec = None
+            if adaptive:
+                try:
+                    from ft_sgemm_tpu.analysis import (
+                        adaptive_threshold_estimate)
+
+                    thr_rec, variance = adaptive_threshold_estimate(
+                        np.asarray(a, np.float32), np.asarray(b, np.float32),
+                        bm=eff.bm, bn=eff.bn, margin=threshold_margin)
+                except Exception:  # noqa: BLE001 — telemetry is best-effort
+                    pass
+            else:
+                thr_rec = thr
             telemetry.record_gemm(
                 op_name, result, strategy=strategy, encode=encode,
-                threshold=thr, operands=(a, b, c), alpha=alpha, beta=beta)
+                threshold=thr_rec, threshold_mode=threshold_mode,
+                variance=variance, operands=(a, b, c), alpha=alpha,
+                beta=beta)
         return result
 
     op_name = (f"ft_sgemm_{shape.name}_{strategy}"
                + ("_mxu" if encode == "mxu" and strategy != "fused" else "")
+               + ("_adaptive" if adaptive else "")
                + _dtype_suffix(in_dtype))
     fn.__name__ = op_name
     fn.shape_config = shape
     fn.strategy = strategy
     fn.encode = encode
     fn.in_dtype = in_dtype
+    fn.threshold_mode = threshold_mode
     return fn
 
 

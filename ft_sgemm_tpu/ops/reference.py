@@ -20,12 +20,21 @@ from ft_sgemm_tpu.ops.common import resolve_in_dtype
 
 @functools.partial(jax.jit, static_argnames=("precision", "in_dtype"))
 def _sgemm_reference_jit(a, b, c, alpha, beta, *, precision, in_dtype):
-    out = jnp.dot(
-        a.astype(jnp.dtype(in_dtype)),
-        b.astype(jnp.dtype(in_dtype)).T,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision(precision),
-    )
+    dt = jnp.dtype(in_dtype)
+    if dt == jnp.int8:
+        # int8 oracle: exact int32 accumulation (what the FT kernels'
+        # exact path computes), widened to f32 only for the epilogue.
+        out = jnp.dot(
+            a.astype(dt), b.astype(dt).T,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    else:
+        out = jnp.dot(
+            a.astype(dt),
+            b.astype(dt).T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision(precision),
+        )
     return alpha * out + beta * c.astype(jnp.float32)
 
 
@@ -39,9 +48,14 @@ def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest",
       precision: lax matmul precision; "highest" keeps true-f32 MXU passes
         so the oracle matches f32 CUDA semantics.
       in_dtype: "bfloat16" rounds A/B to bf16 before the dot (accumulation
-        stays f32) — the oracle for the kernels' bf16 input mode.
+        stays f32) — the oracle for the kernels' bf16 input mode;
+        "float8_e4m3fn" likewise rounds to fp8 with f32 accumulation;
+        "int8" truncates to int8 (pass integer-valued data) and
+        accumulates exactly in int32 — the oracle for the FT kernels'
+        low-precision variants.
     """
-    dt, precision = resolve_in_dtype(in_dtype, precision)
+    dt, precision = resolve_in_dtype(in_dtype, precision,
+                                     allow_low_precision=True)
     return _sgemm_reference_jit(a, b, c, alpha, beta, precision=precision,
                                 in_dtype=dt.name)
 

@@ -106,11 +106,18 @@ def fused_aug_rows(in_itemsize: int) -> int:
 
 
 def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
-                        in_itemsize: int = 4, multifault: bool = True) -> int:
+                        in_itemsize: int = 4, multifault: bool = True,
+                        adaptive: bool = False, exact: bool = False) -> int:
     """Predicted scoped-VMEM bytes for one kernel variant at ``shape``.
 
     ``variant`` is a :data:`TEMP_TILE_FACTORS` key. ``in_itemsize`` is the
-    A/B input width (4 f32, 2 bf16); the accumulator/output is always f32.
+    A/B input width (4 f32, 2 bf16, 1 int8/fp8); the accumulator/output is
+    f32 except on the int8-exact path. ``adaptive`` adds the
+    ``threshold="adaptive"`` moment scratch (one (4,) f32 SMEM vector —
+    16 bytes, modeled so the "every declared scratch is counted" claim
+    holds); ``exact`` adds the int8 path's separate (bm, bn) int32
+    accumulator block — the one low-precision term that actually moves
+    the estimate.
     """
     if variant not in TEMP_TILE_FACTORS:
         raise ValueError(
@@ -130,6 +137,10 @@ def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
         buffers += 2 * 8 * bn * 4               # expected-checksum window
 
     scratch = _SMEM_SCRATCH_BYTES[variant]
+    if adaptive and not exact:
+        scratch += 16                           # (4,) f32 moment scalars
+    if exact:
+        scratch += bm * bn * 4                  # int32 accumulator block
     if variant == "rowcol":
         scratch += (bm + (2 if multifault else 1) * bn) * 4
     elif variant == "rowcol_mxu":
@@ -157,7 +168,8 @@ def _variant_for(strategy: str | None) -> str:
 
 def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
                       limit: int, in_itemsize: int = 4,
-                      allow_shrink: bool) -> KernelShape:
+                      allow_shrink: bool, adaptive: bool = False,
+                      exact: bool = False) -> KernelShape:
     """Guard one kernel launch against a Mosaic scoped-VMEM OOM.
 
     Estimates the footprint at ``shape``; if it exceeds ``limit`` either
@@ -175,7 +187,12 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
     (over budget at 128^3) raises instead of dying inside Mosaic.
     """
     variant = _variant_for(strategy)
-    est = estimate_vmem_bytes(shape, variant, in_itemsize=in_itemsize)
+
+    def est_for(s):
+        return estimate_vmem_bytes(s, variant, in_itemsize=in_itemsize,
+                                   adaptive=adaptive, exact=exact)
+
+    est = est_for(shape)
     if est <= limit:
         return shape
     if not allow_shrink:
@@ -196,9 +213,7 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
     bm, bn, bk = shape.block
 
     def est_at(bm_, bn_, bk_):
-        return estimate_vmem_bytes(
-            dataclasses.replace(shape, bm=bm_, bn=bn_, bk=bk_), variant,
-            in_itemsize=in_itemsize)
+        return est_for(dataclasses.replace(shape, bm=bm_, bn=bn_, bk=bk_))
 
     while True:
         est = est_at(bm, bn, bk)
@@ -237,7 +252,7 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
     fitted = dataclasses.replace(shape, bm=bm, bn=bn, bk=bk)
     warnings.warn(
         f"ft_sgemm_tpu: tile {shape.block} for kernel {variant!r} predicted"
-        f" at ~{estimate_vmem_bytes(shape, variant, in_itemsize=in_itemsize) / MIB:.1f}"
+        f" at ~{est_for(shape) / MIB:.1f}"
         f" MiB of scoped VMEM, over the {limit / MIB:.0f} MiB limit —"
         f" auto-shrunk to {fitted.block} (~{est / MIB:.1f} MiB) instead of"
         f" failing Mosaic compilation. Perf characteristics change; tune"

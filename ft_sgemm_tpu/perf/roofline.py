@@ -55,7 +55,19 @@ class DeviceSpec:
     estimated: bool = False
 
     def peak_for(self, dtype: str) -> Optional[float]:
-        return self.peak_flops.get(str(dtype))
+        """The peak for one stage dtype — the roofline summary picks its
+        ceiling by the dtype the stage actually ran (an int8 stage judged
+        against the f32 peak would read as a >100%-of-peak fiction).
+        Accepts the configs dtype aliases (``fp8_e4m3`` etc.); unknown
+        dtypes return None (the row renders with null percentages)."""
+        name = str(dtype)
+        try:
+            from ft_sgemm_tpu.configs import canonical_in_dtype
+
+            name = canonical_in_dtype(name)
+        except Exception:  # noqa: BLE001 — foreign dtype: raw lookup
+            pass
+        return self.peak_flops.get(name)
 
     def ridge_point(self, dtype: str) -> Optional[float]:
         """FLOPs/byte above which this device is compute-bound."""
@@ -66,29 +78,52 @@ class DeviceSpec:
 
 
 def _tpu(name: str, bf16_tflops: float, hbm_gbps: float,
-         source: str) -> DeviceSpec:
+         source: str, int8_tflops: Optional[float] = None,
+         fp8_tflops: Optional[float] = None) -> DeviceSpec:
     bf16 = bf16_tflops * 1e12
+    peaks = {"bfloat16": bf16, "float32": bf16 / F32_DERATE}
+    # Low-precision serving dtypes (ISSUE 7): int8 from the published
+    # per-chip TOPS figure where one exists; parts with no published int8
+    # acceleration run int8 operands at the bf16 MXU rate (same systolic
+    # passes, narrower operands), so bf16 is the honest ceiling there.
+    peaks["int8"] = (int8_tflops * 1e12 if int8_tflops is not None
+                     else bf16)
+    # fp8 (e4m3): native only on Trillium-class parts (2x bf16); earlier
+    # generations consume fp8 via upcast at the bf16 rate.
+    peaks["float8_e4m3fn"] = (fp8_tflops * 1e12 if fp8_tflops is not None
+                              else bf16)
     return DeviceSpec(
         name=name,
-        peak_flops={"bfloat16": bf16, "float32": bf16 / F32_DERATE},
+        peak_flops=peaks,
         hbm_bytes_per_s=hbm_gbps * 1e9,
         source=source,
     )
 
 
 # Per-chip peaks (Cloud TPU system architecture docs; bandwidth in GB/s).
+# int8/fp8 provenance per entry: v5e publishes 394 int8 TOPS (2x bf16),
+# v5p 918 int8 TOPS, v6e (Trillium) 1836 int8 TOPS and fp8 at the same
+# doubled rate; v4 publishes no separate int8 figure (its MXU runs int8
+# at the bf16 rate). Where no native figure exists the bf16 ceiling is
+# used — documented in _tpu, marked only via `source` (the row itself
+# stays exact: that IS the achievable rate).
 DEVICE_SPECS = (
     _tpu("TPU v4", 275.0, 1228.0, "cloud.google.com/tpu v4: 275 TFLOPS "
-         "bf16, 1228 GB/s HBM2 per chip"),
+         "bf16, 1228 GB/s HBM2 per chip; no published int8/fp8 "
+         "acceleration (bf16 rate applies)"),
     _tpu("TPU v5e", 197.0, 819.0, "cloud.google.com/tpu v5e: 197 TFLOPS "
-         "bf16, 819 GB/s HBM2 per chip"),
+         "bf16 / 394 TOPS int8, 819 GB/s HBM2 per chip; fp8 via upcast "
+         "at bf16 rate", int8_tflops=394.0),
     _tpu("TPU v5p", 459.0, 2765.0, "cloud.google.com/tpu v5p: 459 TFLOPS "
-         "bf16, 2765 GB/s HBM2e per chip"),
+         "bf16 / 918 TOPS int8, 2765 GB/s HBM2e per chip; fp8 via "
+         "upcast at bf16 rate", int8_tflops=918.0),
     _tpu("TPU v6e", 918.0, 1640.0, "cloud.google.com/tpu v6e (Trillium): "
-         "918 TFLOPS bf16, 1640 GB/s HBM per chip"),
+         "918 TFLOPS bf16 / 1836 TOPS int8 / 1836 TFLOPS fp8, 1640 GB/s "
+         "HBM per chip", int8_tflops=1836.0, fp8_tflops=1836.0),
     DeviceSpec(
         name="cpu",
-        peak_flops={"float32": 1e11, "bfloat16": 1e11},
+        peak_flops={"float32": 1e11, "bfloat16": 1e11, "int8": 1e11,
+                    "float8_e4m3fn": 1e11},
         hbm_bytes_per_s=5e10,
         source="order-of-magnitude placeholder for a dev-box CPU "
                "(~100 GFLOP/s, ~50 GB/s); utilization numbers on CPU are "

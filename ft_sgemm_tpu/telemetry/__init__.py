@@ -296,12 +296,15 @@ def _emit(event: FaultEvent) -> None:
         sink.write(event)
 
 
-def _series_labels(op, strategy, layer, device, encode=None) -> dict:
+def _series_labels(op, strategy, layer, device, encode=None,
+                   threshold_mode=None) -> dict:
     labels = {"op": op}
     if strategy:
         labels["strategy"] = strategy
     if encode:
         labels["encode"] = encode
+    if threshold_mode:
+        labels["threshold_mode"] = threshold_mode
     if layer:
         labels["layer"] = layer
     if device:
@@ -311,6 +314,8 @@ def _series_labels(op, strategy, layer, device, encode=None) -> dict:
 
 def record_gemm(op: str, result, *, strategy: Optional[str] = None,
                 encode: Optional[str] = None,
+                threshold_mode: Optional[str] = None,
+                variance: Optional[float] = None,
                 step: Optional[int] = None, layer: Optional[str] = None,
                 device: Optional[str] = None, threshold=None,
                 operands=None, alpha: float = 1.0, beta: float = 0.0,
@@ -325,8 +330,12 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
     the counters are tracers (call inside a caller's jit). ``operands``
     — ``(a, b)`` or ``(a, b, c_in)`` — enables the host-side residual
     measurement when ``configure(measure_residual=True)``; ``threshold``
-    is recorded when it is a concrete scalar. Returns the event (or None
-    when nothing was recorded).
+    is recorded when it is a concrete scalar (for adaptive-threshold
+    calls the factory passes its host-recomputed full-run estimate).
+    ``threshold_mode`` ("static"/"auto"/"adaptive") labels the registry
+    series and lands in ``extra``, as does ``variance`` — the operand
+    mean-square statistic the adaptive bound derives from. Returns the
+    event (or None when nothing was recorded).
     """
     if not _STATE.enabled or _suppressed():
         return None
@@ -344,9 +353,15 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
             c_out, operands[0], operands[1],
             operands[2] if len(operands) > 2 else None,
             alpha=alpha, beta=beta)
-    if encode is not None:
+    if encode is not None or threshold_mode is not None or (
+            variance is not None):
         extra = dict(extra or {})
-        extra["encode"] = encode
+        if encode is not None:
+            extra["encode"] = encode
+        if threshold_mode is not None:
+            extra["threshold_mode"] = threshold_mode
+        if variance is not None:
+            extra["variance"] = _float_or_none(variance)
     event = FaultEvent(
         outcome=outcome, op=op, detected=det, corrected=corrected,
         uncorrectable=unc,
@@ -356,7 +371,8 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
         tiles=_nonzero_tiles(getattr(result, "detections", None)),
         extra=extra, devices=devices or None, host=host, ts=time.time())
     reg = _STATE.registry
-    labels = _series_labels(op, strategy, layer, device, encode)
+    labels = _series_labels(op, strategy, layer, device, encode,
+                            threshold_mode)
     reg.counter("ft_calls", **labels).inc()
     reg.counter("ft_detections", **labels).inc(det)
     reg.counter("ft_corrected", **labels).inc(corrected)

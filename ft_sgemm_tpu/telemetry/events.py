@@ -228,6 +228,8 @@ def registry_from_events(events: Iterable[FaultEvent]):
             labels["device"] = ev.device
         if isinstance(ev.extra, dict) and ev.extra.get("encode"):
             labels["encode"] = ev.extra["encode"]
+        if isinstance(ev.extra, dict) and ev.extra.get("threshold_mode"):
+            labels["threshold_mode"] = ev.extra["threshold_mode"]
         reg.counter("ft_calls", **labels).inc()
         reg.counter("ft_detections", **labels).inc(ev.detected)
         reg.counter("ft_corrected", **labels).inc(ev.corrected)

@@ -128,19 +128,23 @@ def override_disabled():
 
 def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
                 in_dtype, injection_enabled: bool,
-                encode: str = "vpu") -> Optional[KernelShape]:
+                encode: str = "vpu",
+                threshold_mode: str = "static") -> Optional[KernelShape]:
     """The cached winning tile for one dispatch site, or None (heuristics).
 
     Pure host-side and cheap (one ``os.stat`` + dict probe in the steady
     state); returns None without touching anything when tuning is off, so
     the no-entry/disabled dispatch path is bit-for-bit the heuristic one.
     ``encode`` is the checksum-encode mode the dispatch will run — a key
-    component since schema 2 (MXU-encode winners differ).
+    component since schema 2 (MXU-encode winners differ);
+    ``threshold_mode`` the detection-threshold axis — a component since
+    schema 3 (adaptive kernels carry in-kernel derivation work).
     """
     if not enabled():
         return None
     rec = cache.lookup(make_key(m, n, k, strategy=strategy,
                                 in_dtype=in_dtype, encode=encode,
+                                threshold_mode=threshold_mode,
                                 injection_enabled=injection_enabled))
     _count_lookup(rec is not None)
     if rec is None:
@@ -155,6 +159,7 @@ def tune(
     strategy: Optional[str] = "weighted",
     encode: str = "vpu",
     in_dtype: str = "float32",
+    threshold_mode: str = "static",
     inject=False,
     method: Optional[str] = None,
     budget: Optional[int] = 8,
@@ -173,17 +178,27 @@ def tune(
     True (a reference-like schedule), or an explicit ``InjectionSpec``.
     ``budget`` caps how many candidates are timed (best-guess-first order);
     None times them all. ``encode`` is a searched dimension since schema
-    2: the same problem tunes (and caches) separately per encode mode.
+    2: the same problem tunes (and caches) separately per encode mode —
+    as are ``threshold_mode`` ("static"/"adaptive": adaptive kernels
+    carry in-kernel moment/derivation work) and the low-precision dtypes
+    since schema 3. Illegal (strategy, encode, dtype) combinations (e.g.
+    int8 x mxu) are rejected up front with the kernel factory's error.
     """
+    from ft_sgemm_tpu.configs import check_kernel_legality
     from ft_sgemm_tpu.injection import InjectionSpec
 
     n = m if n is None else n
     k = m if k is None else k
+    if strategy is not None:
+        in_dtype = check_kernel_legality(
+            strategy=strategy, encode=encode, in_dtype=in_dtype,
+            threshold_mode=threshold_mode)
     method = default_method() if method is None else method
     feasible, pruned = enumerate_space(m, n, k, strategy=strategy,
-                                       encode=encode, in_dtype=in_dtype)
+                                       encode=encode, in_dtype=in_dtype,
+                                       threshold_mode=threshold_mode)
     key = make_key(m, n, k, strategy=strategy, in_dtype=in_dtype,
-                   encode=encode,
+                   encode=encode, threshold_mode=threshold_mode,
                    injection_enabled=bool(
                        inject.enabled if isinstance(inject, InjectionSpec)
                        else inject))
@@ -192,6 +207,7 @@ def tune(
         "strategy": "plain" if strategy is None else strategy,
         "encode": "vpu" if strategy is None else encode,
         "in_dtype": str(in_dtype),
+        "threshold_mode": "static" if strategy is None else threshold_mode,
         "method": method,
         "key": key,
         "feasible": [list(s.block) for s in feasible],
@@ -222,7 +238,8 @@ def tune(
     with override_disabled():
         results = measure_space(
             candidates, m, n, k, strategy=strategy, encode=encode,
-            in_dtype=in_dtype, inject=spec, method=method, budget=budget_n,
+            in_dtype=in_dtype, threshold_mode=threshold_mode,
+            inject=spec, method=method, budget=budget_n,
             alpha=alpha, beta=beta, reps=reps, samples=samples,
             progress=progress)
     best = best_result(results)

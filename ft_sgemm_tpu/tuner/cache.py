@@ -40,7 +40,15 @@ from typing import Optional
 # files carry keys that would silently collide the two encode modes'
 # winners, so they are ignored (with the standard warning) rather than
 # migrated.
-SCHEMA_VERSION = 2
+# Schema 3: the threshold mode joined the key (``thr=static`` /
+# ``thr=adaptive``) when ``threshold="adaptive"`` became a searched
+# dimension — adaptive kernels carry the in-kernel moment/derivation work,
+# so their winning tiles genuinely differ; a schema-2 file would collide
+# the two modes' winners under one key. Like the 1->2 bump, old files are
+# ignored-with-warning (a clean MISS -> re-tune), never migrated and never
+# an exception: the dtype axis widened at the same time (int8 / fp8 keys)
+# and stale entries must not mis-serve the new spellings.
+SCHEMA_VERSION = 3
 ENV_CACHE_PATH = "FT_SGEMM_TUNER_CACHE"
 _DEFAULT_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "ft_sgemm_tpu", "tuner_cache.json")
@@ -87,6 +95,7 @@ def mnk_bucket(m: int, n: int, k: int) -> tuple:
 
 def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
              in_dtype, injection_enabled: bool, encode: str = "vpu",
+             threshold_mode: str = "static",
              device: Optional[str] = None) -> str:
     """The canonical cache key for one dispatch site.
 
@@ -95,15 +104,27 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
     between encodes (MXU encode trades VPU reductions for augmented tile
     rows, shifting the VMEM/efficiency balance). The plain (non-FT)
     kernel has no encode axis and always keys as ``vpu``.
+
+    ``threshold_mode`` keys the detection-threshold axis (schema 3):
+    ``adaptive`` kernels run the in-kernel moment accumulation +
+    per-check derivation (and, for weighted, the in-kernel encode body
+    instead of the lighter precomp one), so their winners differ;
+    ``auto`` shares the ``static`` key — same program, the threshold is
+    a runtime scalar. The dtype axis needs no spelling change here:
+    ``jnp.dtype(...).name`` already keys int8 / float8_e4m3fn distinctly
+    (``configs.canonical_in_dtype`` normalizes aliases upstream).
     """
-    import jax.numpy as jnp
+    from ft_sgemm_tpu.configs import canonical_in_dtype
 
     bm, bn, bk = mnk_bucket(m, n, k)
     dev = device_kind() if device is None else device
     strat = "plain" if strategy is None else strategy
     enc = "vpu" if strategy is None else encode
-    return (f"{dev}|{bm}x{bn}x{bk}|{jnp.dtype(in_dtype).name}"
-            f"|{strat}|enc={enc}|inj={int(bool(injection_enabled))}")
+    thr = "static" if strategy is None or threshold_mode != "adaptive" \
+        else "adaptive"
+    return (f"{dev}|{bm}x{bn}x{bk}|{canonical_in_dtype(in_dtype)}"
+            f"|{strat}|enc={enc}|thr={thr}"
+            f"|inj={int(bool(injection_enabled))}")
 
 
 def _valid_block(block) -> bool:

@@ -65,7 +65,7 @@ def default_method() -> str:
 
 def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
               inject, alpha: float, beta: float, interpret: Optional[bool],
-              encode: str = "vpu"):
+              encode: str = "vpu", threshold_mode: str = "static"):
     """fn(a, b, c) -> array for one candidate, clean or injected."""
     from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
     from ft_sgemm_tpu.ops.sgemm import make_sgemm
@@ -73,24 +73,35 @@ def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
     if strategy is None:
         return make_sgemm(shape, alpha=alpha, beta=beta, in_dtype=in_dtype,
                           interpret=interpret)
+    threshold = ("adaptive" if threshold_mode == "adaptive"
+                 else "auto" if threshold_mode == "auto" else "static")
     ft = make_ft_sgemm(shape, alpha=alpha, beta=beta, strategy=strategy,
-                       encode=encode, in_dtype=in_dtype, interpret=interpret)
+                       encode=encode, threshold=threshold,
+                       in_dtype=in_dtype, interpret=interpret)
     return lambda a, b, c: ft(a, b, c, inject).c
 
 
 def make_inputs(m: int, n: int, k: int, in_dtype: str = "float32"):
     """Device-resident (a, b, c) operands for measurement (one set for the
-    whole search; the reference driver's quantized distribution)."""
+    whole search; the reference driver's quantized distribution — scaled
+    to integer values for int8, whose cast truncates fractions)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from ft_sgemm_tpu.configs import canonical_in_dtype
     from ft_sgemm_tpu.utils.matrices import generate_random_matrix
 
+    in_dtype = canonical_in_dtype(in_dtype)
     rng = np.random.default_rng(10)
     a = generate_random_matrix(m, k, rng=rng)
     b = generate_random_matrix(n, k, rng=rng)
     c = generate_random_matrix(m, n, rng=rng)
+    if in_dtype == "int8":
+        # The quantized ±{0,.1,...,.9} distribution at integer scale:
+        # ±{0..9} — the int8 kernels' natural operand class.
+        a = np.round(a * 10.0).astype(np.float32)
+        b = np.round(b * 10.0).astype(np.float32)
     if jnp.dtype(in_dtype) != jnp.float32:
         # Pre-cast so the wrappers' casts trace to no-ops (timing.py).
         a = jnp.asarray(a, in_dtype)
@@ -103,6 +114,7 @@ def measure_candidate(
     strategy: Optional[str] = "weighted",
     encode: str = "vpu",
     in_dtype: str = "float32",
+    threshold_mode: str = "static",
     inject=None,
     method: Optional[str] = None,
     alpha: float = 1.0, beta: float = -1.5,
@@ -126,6 +138,7 @@ def measure_candidate(
     interpret = True if method == "interpret" else None
     try:
         fn = _build_fn(shape, strategy=strategy, encode=encode,
+                       threshold_mode=threshold_mode,
                        in_dtype=in_dtype, inject=inject, alpha=alpha,
                        beta=beta, interpret=interpret)
         if method == "compile":
@@ -155,6 +168,7 @@ def measure_space(
     strategy: Optional[str] = "weighted",
     encode: str = "vpu",
     in_dtype: str = "float32",
+    threshold_mode: str = "static",
     inject=None,
     method: Optional[str] = None,
     budget: Optional[int] = None,
@@ -179,6 +193,7 @@ def measure_space(
             a, b, c = _inputs_memo(m, n, k, in_dtype)
             res = measure_candidate(
                 shape, a, b, c, strategy=strategy, encode=encode,
+                threshold_mode=threshold_mode,
                 in_dtype=in_dtype, inject=inject, method=method,
                 alpha=alpha, beta=beta, reps=reps, samples=samples)
             results.append(res)

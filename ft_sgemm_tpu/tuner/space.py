@@ -36,7 +36,7 @@ BK_MENU = (128, 256, 512, 1024, 2048)
 
 
 def variant_for(strategy: Optional[str], *, single_check: bool = True,
-                encode: str = "vpu") -> str:
+                encode: str = "vpu", threshold_mode: str = "static") -> str:
     """The :data:`~ft_sgemm_tpu.ops.vmem.TEMP_TILE_FACTORS` key a strategy's
     dispatch will actually run at the tuner's measurement settings.
 
@@ -44,14 +44,17 @@ def variant_for(strategy: Optional[str], *, single_check: bool = True,
     ``resolve_kernel_strategy`` (the MXU-encode bodies have their own
     footprints — augmented tiles cost VMEM), and the weighted strategy at
     its default single-final-check VPU cadence runs the lighter
-    precomputed-expectations body. ``None`` is the plain (non-FT) kernel.
+    precomputed-expectations body — EXCEPT under ``threshold_mode=
+    "adaptive"``, whose moment statistics need the in-kernel encode.
+    ``None`` is the plain (non-FT) kernel.
     """
     from ft_sgemm_tpu.ops.ft_sgemm import resolve_kernel_strategy
 
     if strategy is None:
         return "plain"
     kernel_strategy = resolve_kernel_strategy(strategy, encode)
-    if kernel_strategy == "weighted" and single_check:
+    if (kernel_strategy == "weighted" and single_check
+            and threshold_mode != "adaptive"):
         return "weighted_precomp"
     return kernel_strategy
 
@@ -90,6 +93,7 @@ def enumerate_space(
     strategy: Optional[str] = "weighted",
     encode: str = "vpu",
     in_dtype: str = "float32",
+    threshold_mode: str = "static",
     limit: Optional[int] = None,
     bm_menu: Sequence[int] = BM_MENU,
     bn_menu: Sequence[int] = BN_MENU,
@@ -111,14 +115,17 @@ def enumerate_space(
          compile-time Mosaic OOM on hardware and must never reach
          measurement.
     """
-    from ft_sgemm_tpu.configs import vmem_limit_bytes
+    from ft_sgemm_tpu.configs import canonical_in_dtype, vmem_limit_bytes
 
     if limit is None:
         limit = vmem_limit_bytes()
     import jax.numpy as jnp
 
-    itemsize = jnp.dtype(in_dtype).itemsize
-    variant = variant_for(strategy, encode=encode)
+    itemsize = jnp.dtype(canonical_in_dtype(in_dtype)).itemsize
+    adaptive = threshold_mode == "adaptive"
+    exact = canonical_in_dtype(in_dtype) == "int8" and strategy is not None
+    variant = variant_for(strategy, encode=encode,
+                          threshold_mode=threshold_mode)
     max_bm = _round_up(m, 128)
     max_bn = _round_up(n, 128)
     max_bk = _round_up(k, 128)
@@ -135,7 +142,8 @@ def enumerate_space(
                         f" ({max_bm}x{max_bn}x{max_bk})"))
                     continue
                 est = estimate_vmem_bytes(shape, variant,
-                                          in_itemsize=itemsize)
+                                          in_itemsize=itemsize,
+                                          adaptive=adaptive, exact=exact)
                 if est > limit:
                     pruned.append(PrunedCandidate(
                         shape,

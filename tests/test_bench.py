@@ -495,6 +495,19 @@ def test_smoke_mode_runs_both_encodes_on_cpu(tmp_path):
         assert rec["corrected_ok"], (mode, rec)
         assert rec["detections"] > 0 and rec["uncorrectable"] == 0, (
             mode, rec)
+    # Low-precision stages (ISSUE 7): one bf16-adaptive row and one int8
+    # row — both new axes (threshold mode x dtype) exercised in CI.
+    lp = payload["context"]["low_precision"]
+    assert set(lp) == {"ft_rowcol[bf16-adaptive]", "ft_rowcol[int8]"}
+    for name, rec in lp.items():
+        assert rec["corrected_ok"], (name, rec)
+        assert rec["detections"] > 0 and rec["uncorrectable"] == 0, (
+            name, rec)
+    # Their roofline rows judge against the STAGE dtype's ceiling.
+    stage_dtypes = {s["name"]: s["dtype"]
+                    for s in payload["context"]["run_report"]["stages"]}
+    assert stage_dtypes["ft_rowcol[bf16-adaptive]"] == "bfloat16"
+    assert stage_dtypes["ft_rowcol[int8]"] == "int8"
 
 
 def test_encode_comparison_context_from_partial_records(tmp_path):

@@ -1,0 +1,588 @@
+"""Adaptive variance-bound thresholds + the fp8/int8 kernel family.
+
+Pins the ISSUE-7 contract points:
+
+1. **Default is untouched** — ``threshold="static"`` (the named spelling)
+   lowers to BYTE-IDENTICAL HLO vs the numeric default per strategy;
+   ``threshold="adaptive"`` genuinely changes the program (the
+   tests/test_telemetry.py pinning technique).
+2. **Variance-bound math** — the host twin
+   (``analysis.adaptive_threshold_estimate``) equals a brute-force
+   moment evaluation of the shared formula
+   (``ops.common.variance_bound_threshold``), scales ~quadratically with
+   input scale, and caps finite.
+3. **Adaptive cadence/strategy sweeps** (mirroring test_encode_mxu):
+   dense injection corrected at ``check_every in {1, 2, nk}`` across
+   strategies and dtypes, clean runs detect ZERO at every input scale.
+4. **Low-precision variants** — fp8_e4m3 (f32 accumulation) and int8
+   (int32-exact accumulation) verify against the dtype-matched XLA
+   oracle; int8 clean residuals are exactly zero and unit faults are
+   detectable.
+5. **Legality** — the per-dtype constraints raise loud ValueErrors;
+   the vmem model carries the adaptive/exact footprint terms.
+6. **Tuner** — ``thr=`` and the dtype join the cache key; schema-2
+   caches MISS cleanly after the bump (re-tune, never raise/mis-key).
+7. **ROC** — adaptive Pareto-dominates the calibrated static threshold,
+   with zero clean-run false positives.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import InjectionSpec, make_ft_sgemm, sgemm_reference
+from ft_sgemm_tpu.configs import (
+    IN_DTYPES,
+    THRESHOLD_MODES,
+    KernelShape,
+    aug_rows,
+    canonical_in_dtype,
+    check_kernel_legality,
+)
+from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+
+ALPHA, BETA = 1.0, -1.5
+TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
+STRATEGIES = ("rowcol", "global", "weighted", "fused")
+
+
+def _inputs(m, n, k, seed=10):
+    rng = np.random.default_rng(seed)
+    return (
+        generate_random_matrix(m, k, rng=rng),
+        generate_random_matrix(n, k, rng=rng),
+        generate_random_matrix(m, n, rng=rng),
+    )
+
+
+def _int_inputs(m, n, k, seed=10, scale=9):
+    rng = np.random.default_rng(seed)
+    a = np.clip(np.round(rng.standard_normal((m, k)) * scale / 2), -127,
+                127).astype(np.float32)
+    b = np.clip(np.round(rng.standard_normal((n, k)) * scale / 2), -127,
+                127).astype(np.float32)
+    c = generate_random_matrix(m, n, rng=rng)
+    return a, b, c
+
+
+def _lower(fn, a, b, c):
+    return jax.jit(lambda a, b, c: fn(a, b, c).c).lower(a, b, c).as_text()
+
+
+# -- 1. default-path pin: threshold="static" is byte-for-byte the default ----
+
+
+@pytest.mark.parametrize("strategy", ["rowcol", "global", "weighted"])
+def test_static_threshold_spelling_hlo_byte_identical(strategy):
+    a, b, c = _inputs(256, 128, 512)
+    default = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy)
+    named = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                          threshold="static")
+    assert _lower(default, a, b, c) == _lower(named, a, b, c), (
+        f"{strategy}: threshold='static' changed the default HLO")
+    adaptive = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                             threshold="adaptive")
+    assert _lower(adaptive, a, b, c) != _lower(default, a, b, c), (
+        f"{strategy}: threshold='adaptive' lowered to the static program —"
+        " the axis did nothing")
+
+
+def test_unknown_threshold_mode_rejected():
+    with pytest.raises(ValueError, match="threshold"):
+        make_ft_sgemm(TILE, threshold="dynamic")
+    assert THRESHOLD_MODES == ("static", "auto", "adaptive")
+
+
+def test_threshold_mode_attribute_and_op_name():
+    ft = make_ft_sgemm(TILE, strategy="rowcol", threshold="adaptive")
+    assert ft.threshold_mode == "adaptive"
+    assert "adaptive" in ft.__name__
+    assert make_ft_sgemm(TILE, strategy="rowcol").threshold_mode == "static"
+    assert make_ft_sgemm(
+        TILE, strategy="rowcol", threshold="auto").threshold_mode == "auto"
+
+
+# -- 2. variance-bound math vs brute-force per-tile moments ------------------
+
+
+def test_variance_bound_matches_brute_force_moments(rng):
+    from ft_sgemm_tpu.analysis import adaptive_threshold_estimate
+    from ft_sgemm_tpu.ops.common import (
+        NOISE_C_BIAS, NOISE_C_RAND, variance_bound_threshold)
+
+    bm = bn = 128
+    k = 256
+    a = rng.standard_normal((bm, k)).astype(np.float32) * 3.0
+    b = rng.standard_normal((bn, k)).astype(np.float32) * 0.5
+    thr, variance = adaptive_threshold_estimate(a, b, bm=bm, bn=bn,
+                                                margin=8.0)
+    # Brute force: the same formula from directly computed moments.
+    s_a1 = float(np.sum(a, dtype=np.float64))
+    s_a2 = float(np.sum(a.astype(np.float64) ** 2))
+    s_b1 = float(np.sum(b, dtype=np.float64))
+    s_b2 = float(np.sum(b.astype(np.float64) ** 2))
+    n_a = n_b = float(bm * k)
+    t_ab = float(k * max(bm, bn))
+    eps = float(np.finfo(np.float32).eps)
+    sigma = np.sqrt((s_a2 / n_a) * (s_b2 / n_b))
+    mu = (s_a1 / n_a) * (s_b1 / n_b)
+    expect = 8.0 * eps * (
+        NOISE_C_RAND * np.sqrt(t_ab) * sigma
+        + NOISE_C_BIAS * np.log2(t_ab) * t_ab * abs(mu))
+    assert thr == pytest.approx(expect, rel=1e-6)
+    assert variance == pytest.approx((s_a2 / n_a) * (s_b2 / n_b), rel=1e-6)
+    # The shared implementation is the one the kernels call.
+    direct = variance_bound_threshold(
+        s_a1, s_a2, s_b1, s_b2, n_a=n_a, n_b=n_b, t_ab=t_ab,
+        log2_t=float(np.log2(t_ab)), margin=8.0, xp=np)
+    assert float(direct) == pytest.approx(expect, rel=1e-6)
+
+
+def test_variance_bound_scales_with_operand_variance(rng):
+    from ft_sgemm_tpu.analysis import adaptive_threshold_estimate
+
+    a = rng.standard_normal((128, 256)).astype(np.float32)
+    b = rng.standard_normal((128, 256)).astype(np.float32)
+    thr1, _ = adaptive_threshold_estimate(a, b, bm=128, bn=128)
+    thr10, _ = adaptive_threshold_estimate(a * 10, b * 10, bm=128, bn=128)
+    # Both operands scaled by s -> sigma scales by s^2 (mu term rides
+    # along at the same rate): the bound tracks operand variance.
+    assert thr10 == pytest.approx(100.0 * thr1, rel=0.05)
+
+
+def test_variance_bound_saturates_finite():
+    from ft_sgemm_tpu.ops.common import variance_bound_threshold
+
+    huge = float(np.finfo(np.float32).max)
+    thr = variance_bound_threshold(0.0, huge, 0.0, huge, n_a=1.0, n_b=1.0,
+                                   t_ab=1e30, log2_t=100.0, margin=8.0,
+                                   xp=np)
+    assert np.isfinite(thr)
+
+
+# -- 3. adaptive cadence/strategy sweeps (mirroring test_encode_mxu) ---------
+
+
+@pytest.mark.parametrize("check_every", [1, 2, 4])  # 4 == nk at k=512
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_adaptive_cadence_sweep_multi_fault(strategy, check_every):
+    """Dense injection under threshold="adaptive": correcting strategies
+    restore the oracle exactly and report zero uncorrectable; the
+    detect-only global strategy counts every fault event."""
+    m = n = 128
+    k = 512  # nk = 4 at bk=128
+    a, b, c = _inputs(m, n, k, seed=7)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                       threshold="adaptive", check_every=check_every)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+    if strategy == "global":
+        assert int(res.num_detected) == -(-4 // check_every)
+        assert int(res.num_uncorrectable) == int(res.num_detected)
+        return
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, (f"{strategy}/adaptive/ce={check_every}: {nbad}"
+                " corrupted elements survived")
+    assert int(res.num_detected) == 4
+    assert int(res.num_uncorrectable) == 0
+
+
+@pytest.mark.parametrize("encode", ["vpu", "mxu"])
+@pytest.mark.parametrize("strategy", ["rowcol", "global"])
+def test_adaptive_tiny_faults_both_encodes(strategy, encode):
+    """Adaptive thresholds catch magnitude-5 faults (5 orders under the
+    reference 9500) under BOTH encodes — the moment statistics ride the
+    VPU whichever unit builds the expected checksums."""
+    a, b, c = _inputs(128, 128, 512, seed=17)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=5.0)
+    res = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                        encode=encode, threshold="adaptive")(
+        a, b, c, inject=inj)
+    assert int(res.num_detected) == 4
+    if strategy != "global":
+        want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA))
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"{nbad} tiny faults survived adaptive/{encode}"
+        assert int(res.num_uncorrectable) == 0
+
+
+@pytest.mark.parametrize("scale", [0.1, 1.0, 16.0])
+def test_adaptive_clean_runs_zero_fp_across_scales(scale, rng):
+    """The per-tile threshold tracks operand variance: clean runs detect
+    ZERO at every input scale — including the hot scale where a static
+    threshold calibrated at scale 1 floods (the ROC sweep's headline)."""
+    a = rng.standard_normal((128, 256)).astype(np.float32) * scale
+    b = rng.standard_normal((128, 256)).astype(np.float32) * scale
+    c = np.zeros((128, 128), np.float32)
+    for strategy in ("rowcol", "weighted"):
+        res = make_ft_sgemm(TILE, alpha=1.0, beta=0.0, strategy=strategy,
+                            threshold="adaptive")(a, b, c)
+        assert int(res.num_detected) == 0, (strategy, scale)
+        assert int(res.num_uncorrectable) == 0, (strategy, scale)
+
+
+@pytest.mark.parametrize("in_dtype", ["bfloat16", "float8_e4m3fn"])
+def test_adaptive_low_precision_float_corrects(in_dtype):
+    a, b, c = _inputs(128, 128, 256, seed=3)
+    inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="rowcol",
+                       threshold="adaptive", in_dtype=in_dtype)
+    res = ft(a, b, c, inject=inj)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA,
+                                      in_dtype=in_dtype))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"{in_dtype}: {nbad} corrupted elements survived"
+    assert int(res.num_detected) == 2
+    assert int(res.num_uncorrectable) == 0
+
+
+# -- 4. low-precision variants vs the dtype-matched oracle -------------------
+
+
+def test_fp8_verifies_against_reference():
+    """fp8 inputs, f32 accumulation/checksums: the corrected output equals
+    the XLA oracle over the same fp8-rounded inputs within the reference
+    tolerance (dtype-scaled by construction: both sides consume the
+    rounded values, so only f32 accumulation noise remains)."""
+    a, b, c = _inputs(256, 128, 512, seed=5)
+    inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
+    for strategy in ("rowcol", "weighted"):
+        ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                           in_dtype="fp8_e4m3")  # alias spelling
+        assert ft.in_dtype == jax.numpy.float8_e4m3fn
+        res = ft(a, b, c, inj)
+        want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA,
+                                          in_dtype="float8_e4m3fn"))
+        ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+        assert ok, f"fp8/{strategy}: {nbad} bad elements"
+        assert int(res.num_detected) > 0
+        assert int(res.num_uncorrectable) == 0
+
+
+def test_int8_exact_accumulation_matches_oracle():
+    """int8 inputs, int32 accumulation: clean residuals are identically
+    zero (integer arithmetic), the output matches the exact int32 oracle,
+    and injected integer faults are corrected exactly."""
+    a, b, c = _int_inputs(256, 128, 512, seed=11)
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="int8"))
+    for strategy in ("rowcol", "global"):
+        ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy=strategy,
+                           in_dtype="int8", threshold="adaptive")
+        clean = ft(a, b, c)
+        assert int(clean.num_detected) == 0, strategy
+        okc, nbadc, _ = verify_matrix(want, np.asarray(clean.c),
+                                      verbose=False)
+        assert okc, f"int8/{strategy} clean: {nbadc} bad"
+        inj = InjectionSpec(enabled=True, every=1, magnitude=1.0)
+        res = ft(a, b, c, inj)
+        # 2 output tiles (m=256 over bm=128) x nk=4 unit faults each.
+        assert int(res.num_detected) == 8, strategy
+        if strategy == "rowcol":
+            ok, nbad, _ = verify_matrix(want, np.asarray(res.c),
+                                        verbose=False)
+            assert ok, f"int8 unit faults survived: {nbad}"
+            assert int(res.num_uncorrectable) == 0
+
+
+def test_int8_static_threshold_works_too():
+    a, b, c = _int_inputs(128, 128, 256, seed=2)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="rowcol",
+                       in_dtype="int8", threshold=0.5)
+    res = ft(a, b, c, InjectionSpec(enabled=True, every=1, magnitude=5.0))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="int8"))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok and int(res.num_detected) == 2
+    assert int(res.num_uncorrectable) == 0
+
+
+def test_int8_rectangular_with_padding():
+    a, b, c = _int_inputs(200, 150, 300, seed=13)
+    ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA, strategy="rowcol",
+                       in_dtype="int8", threshold="adaptive")
+    res = ft(a, b, c, InjectionSpec(enabled=True, every=1, magnitude=3.0))
+    want = np.asarray(sgemm_reference(a, b, c, ALPHA, BETA, in_dtype="int8"))
+    ok, nbad, _ = verify_matrix(want, np.asarray(res.c), verbose=False)
+    assert ok, f"int8/rect: {nbad} bad"
+    assert int(res.num_detected) > 0
+    assert int(res.num_uncorrectable) == 0
+
+
+# -- 5. legality + vmem model ------------------------------------------------
+
+
+def test_dtype_legality_errors():
+    with pytest.raises(ValueError, match="int8"):
+        make_ft_sgemm(TILE, strategy="weighted", in_dtype="int8")
+    with pytest.raises(ValueError, match="1-byte"):
+        make_ft_sgemm(TILE, strategy="rowcol", encode="mxu",
+                      in_dtype="int8")
+    with pytest.raises(ValueError, match="1-byte"):
+        make_ft_sgemm(TILE, strategy="fused", in_dtype="float8_e4m3")
+    with pytest.raises(ValueError, match="multifault"):
+        make_ft_sgemm(TILE, strategy="rowcol", in_dtype="int8",
+                      multifault=True)
+    with pytest.raises(ValueError, match="in_dtype"):
+        make_ft_sgemm(TILE, in_dtype="float64")
+    # Aliases resolve; the canonical family is fixed.
+    assert canonical_in_dtype("fp8") == "float8_e4m3fn"
+    assert canonical_in_dtype("fp8_e4m3") == "float8_e4m3fn"
+    assert set(IN_DTYPES) == {"float32", "bfloat16", "float8_e4m3fn",
+                              "int8"}
+    # Legal combos pass through and return the canonical name.
+    assert check_kernel_legality(strategy="rowcol", encode="vpu",
+                                 in_dtype="int8") == "int8"
+    assert aug_rows(1) == 32  # 1-byte sublane granule
+
+
+def test_vmem_model_covers_adaptive_and_exact():
+    from ft_sgemm_tpu.ops.vmem import estimate_vmem_bytes
+
+    base = estimate_vmem_bytes(TILE, "rowcol")
+    adapt = estimate_vmem_bytes(TILE, "rowcol", adaptive=True)
+    assert adapt == base + 16, "adaptive moment scratch must be modeled"
+    exact = estimate_vmem_bytes(TILE, "rowcol", in_itemsize=1, exact=True)
+    base1 = estimate_vmem_bytes(TILE, "rowcol", in_itemsize=1)
+    assert exact == base1 + TILE.bm * TILE.bn * 4, (
+        "int8 accumulator block must be modeled")
+
+
+def test_tuner_space_threads_threshold_mode():
+    from ft_sgemm_tpu.tuner.space import enumerate_space, variant_for
+
+    assert variant_for("weighted", threshold_mode="adaptive") == "weighted"
+    assert variant_for("weighted", threshold_mode="static") == (
+        "weighted_precomp")
+    feasible, _ = enumerate_space(128, 128, 128, strategy="rowcol",
+                                  in_dtype="int8",
+                                  threshold_mode="adaptive")
+    assert feasible, "int8 adaptive space must be searchable"
+
+
+# -- 6. tuner: thr= / dtype keys + schema migration --------------------------
+
+
+def test_tuner_key_separates_threshold_modes_and_dtypes():
+    from ft_sgemm_tpu import tuner
+
+    kws = dict(strategy="rowcol", in_dtype="float32",
+               injection_enabled=False)
+    k_static = tuner.make_key(256, 256, 256, **kws)
+    k_adapt = tuner.make_key(256, 256, 256, threshold_mode="adaptive",
+                             **kws)
+    assert "thr=static" in k_static and "thr=adaptive" in k_adapt
+    assert k_static != k_adapt
+    # auto shares static's program: same key.
+    assert tuner.make_key(256, 256, 256, threshold_mode="auto",
+                          **kws) == k_static
+    # dtype axis: int8 and fp8 key distinctly, aliases normalize.
+    k_int8 = tuner.make_key(256, 256, 256, strategy="rowcol",
+                            in_dtype="int8", injection_enabled=False)
+    k_fp8 = tuner.make_key(256, 256, 256, strategy="rowcol",
+                           in_dtype="fp8_e4m3", injection_enabled=False)
+    assert "|int8|" in k_int8 and "|float8_e4m3fn|" in k_fp8
+
+
+def test_schema2_cache_misses_cleanly_after_bump(tmp_path, monkeypatch):
+    """Satellite fix: a schema-2 cache file (pre-thr=/dtype-axis) must be
+    ignored WITH A WARNING and treated as a miss — dispatch falls back to
+    heuristics, a re-tune writes schema 3, and at no point does a stale
+    key raise or mis-serve a tile."""
+    from ft_sgemm_tpu import tuner
+    from ft_sgemm_tpu.tuner import cache as tcache
+
+    path = tmp_path / "schema2.json"
+    path.write_text(json.dumps(
+        {"schema": 2, "entries": {
+            "cpu|128x128x128|float32|rowcol|enc=vpu|inj=0": {
+                "block": [128, 128, 128]}}}))
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(path))
+    tcache.clear_memo()
+    try:
+        with pytest.warns(UserWarning, match="schema"):
+            assert tcache.load_entries() == {}
+        # Dispatch lookup: a clean miss, never an exception.
+        assert tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 in_dtype="float32",
+                                 injection_enabled=False) is None
+        # Re-tune overwrites with a schema-3 document and serves it.
+        report = tuner.tune(128, strategy="rowcol", budget=1, reps=1,
+                            samples=1, method="interpret")
+        assert report["best"] is not None
+        doc = json.loads(path.read_text())
+        assert doc["schema"] == tcache.SCHEMA_VERSION == 3
+        tcache.clear_memo()
+        assert tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 in_dtype="float32",
+                                 injection_enabled=False) is not None
+    finally:
+        tcache.clear_memo()
+
+
+def test_tune_adaptive_int8_persists_and_dispatches(tmp_path, monkeypatch):
+    from ft_sgemm_tpu import tuner
+    from ft_sgemm_tpu.tuner import cache as tcache
+
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH,
+                       str(tmp_path / "tuner_cache.json"))
+    tcache.clear_memo()
+    try:
+        report = tuner.tune(128, strategy="rowcol", in_dtype="int8",
+                            threshold_mode="adaptive", budget=1,
+                            reps=1, samples=1, method="interpret")
+        assert report["best"] is not None
+        assert "thr=adaptive" in report["key"]
+        assert "|int8|" in report["key"]
+        tile = tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 in_dtype="int8", injection_enabled=False,
+                                 threshold_mode="adaptive")
+        assert tile is not None
+        # The static-mode key stays a miss: no cross-mode bleed.
+        assert tuner.lookup_tile(128, 128, 128, strategy="rowcol",
+                                 in_dtype="int8", injection_enabled=False,
+                                 threshold_mode="static") is None
+    finally:
+        tcache.clear_memo()
+
+
+def test_tune_rejects_illegal_combo():
+    from ft_sgemm_tpu import tuner
+
+    with pytest.raises(ValueError, match="1-byte"):
+        tuner.tune(128, strategy="rowcol", encode="mxu", in_dtype="int8",
+                   dry_run=True)
+
+
+# -- 7. telemetry: threshold-mode labels + variance extras -------------------
+
+
+def test_telemetry_threshold_mode_labels_and_variance(tmp_path):
+    from ft_sgemm_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.configure(tmp_path / "thr.jsonl")
+    try:
+        a, b, c = _inputs(128, 128, 256, seed=4)
+        inj = InjectionSpec(enabled=True, every=1)
+        for thr in ("static", "adaptive"):
+            ft = make_ft_sgemm(TILE, alpha=ALPHA, beta=BETA,
+                               strategy="rowcol", threshold=thr)
+            ft(a, b, c, inject=inj)
+        reg = telemetry.get_registry()
+        assert reg.total("ft_calls", threshold_mode="static") == 1
+        assert reg.total("ft_calls", threshold_mode="adaptive") == 1
+        telemetry.disable()
+        events = list(telemetry.read_events(tmp_path / "thr.jsonl"))
+        modes = {e.extra["threshold_mode"] for e in events}
+        assert modes == {"static", "adaptive"}
+        adaptive_ev = [e for e in events
+                       if e.extra["threshold_mode"] == "adaptive"][0]
+        # Recorded threshold value + variance estimate (ISSUE 7).
+        assert adaptive_ev.threshold is not None
+        assert adaptive_ev.extra.get("variance") is not None
+        assert adaptive_ev.extra["variance"] > 0
+    finally:
+        telemetry.reset()
+
+
+# -- 8. ROC sweep: adaptive dominates static ---------------------------------
+
+
+def test_roc_sweep_adaptive_dominates(rng):
+    """The acceptance artifact, at unit-test size: one noisy-dtype combo
+    swept over input scales. Adaptive: zero clean false positives, full
+    detection. Static (calibrated at scale 1): misses the cold scale's
+    faults AND floods on the hot scale's clean noise."""
+    from ft_sgemm_tpu.injection import roc_sweep
+
+    art = roc_sweep(dtypes=("bfloat16",), strategies=("rowcol",),
+                    encodes=("vpu",))
+    s = art["summary"]
+    combo = s["combos"]["bfloat16|rowcol|vpu"]
+    assert combo["dominates"] and combo["strict"]
+    assert combo["adaptive"]["fp_rate"] == 0.0
+    assert combo["adaptive"]["detection_rate"] == 1.0
+    assert combo["static"]["detection_rate"] < 1.0  # cold-scale misses
+    assert combo["static"]["fp_rate"] > 0.0         # hot-scale flood
+    assert s["all_dominate"] and s["adaptive_false_positives"] == 0
+
+
+def test_summarize_roc_verdict_logic():
+    from ft_sgemm_tpu.injection import RocPoint, summarize_roc
+
+    def pt(mode, clean, det, expected=4):
+        return RocPoint(dtype="bfloat16", strategy="rowcol", encode="vpu",
+                        mode=mode, scale=1.0, threshold=None, magnitude=1.0,
+                        clean_detections=clean, checks=4,
+                        expected_faults=expected, detected=det)
+
+    # Tie: dominates weakly, not strictly.
+    s = summarize_roc([pt("static", 0, 4), pt("adaptive", 0, 4)])
+    combo = s["combos"]["bfloat16|rowcol|vpu"]
+    assert combo["dominates"] and not combo["strict"]
+    # Static floods: strict domination.
+    s = summarize_roc([pt("static", 7, 4), pt("adaptive", 0, 4)])
+    assert s["combos"]["bfloat16|rowcol|vpu"]["strict"]
+    # Adaptive misses where static detects: dominated.
+    s = summarize_roc([pt("static", 0, 4), pt("adaptive", 0, 2)])
+    assert not s["combos"]["bfloat16|rowcol|vpu"]["dominates"]
+    assert not s["all_dominate"]
+    # Over-detection (noise) caps at the expected count.
+    s = summarize_roc([pt("static", 0, 9), pt("adaptive", 0, 4)])
+    assert s["combos"]["bfloat16|rowcol|vpu"]["static"][
+        "detection_rate"] == 1.0
+
+
+# -- 9. roofline: peaks picked by stage dtype --------------------------------
+
+
+def test_roofline_peaks_by_dtype():
+    from ft_sgemm_tpu.perf import roofline
+
+    v5e = roofline.find_spec("TPU v5 lite")
+    assert v5e.peak_for("int8") == pytest.approx(394e12)
+    assert v5e.peak_for("bfloat16") == pytest.approx(197e12)
+    # fp8 on a part with no native rate: the bf16 ceiling (documented in
+    # the spec source string), via the alias spelling.
+    assert v5e.peak_for("fp8_e4m3") == pytest.approx(197e12)
+    v6e = roofline.find_spec("TPU v6e")
+    assert v6e.peak_for("float8_e4m3fn") == pytest.approx(1836e12)
+    assert v6e.peak_for("int8") == pytest.approx(1836e12)
+    cpu = roofline.find_spec(None)
+    assert cpu.peak_for("int8") is not None
+    assert cpu.peak_for("not_a_dtype") is None
+    # The summary row carries the dtype-matched ceiling.
+    row = roofline.roofline_summary(
+        flops=1e12, bytes_accessed=1e9, seconds=0.01,
+        device_kind="TPU v5 lite", dtype="int8")
+    assert row["peak_gflops"] == pytest.approx(394e3)
+    assert row["pct_peak_compute"] == pytest.approx(
+        (1e12 / 0.01) / 394e12)
+
+
+def test_int8_dispatch_respects_tuned_tile(tmp_path, monkeypatch):
+    """End-to-end: a persisted int8-adaptive winner overrides the named-
+    shape heuristic tile on the next dispatch (the cache key round-trip
+    across the two new axes)."""
+    from ft_sgemm_tpu import tuner
+    from ft_sgemm_tpu.tuner import cache as tcache
+
+    monkeypatch.setenv(tcache.ENV_CACHE_PATH, str(tmp_path / "c.json"))
+    tcache.clear_memo()
+    try:
+        key = tuner.make_key(128, 128, 128, strategy="rowcol",
+                             in_dtype="int8", injection_enabled=False,
+                             threshold_mode="adaptive")
+        tcache.store(key, {"block": [128, 128, 128]})
+        a, b, c = _int_inputs(128, 128, 128, seed=1)
+        ft = make_ft_sgemm("small", strategy="rowcol", in_dtype="int8",
+                           threshold="adaptive")
+        res = ft(a, b, c)
+        assert int(res.num_detected) == 0
+        stats = tuner.lookup_stats()
+        assert stats["hits"] >= 1
+    finally:
+        tcache.clear_memo()

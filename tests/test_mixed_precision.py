@@ -106,8 +106,11 @@ def test_bf16_ft_global_detects():
 def test_in_dtype_validation():
     with pytest.raises(ValueError, match="in_dtype"):
         make_sgemm("test", in_dtype="float16")
-    with pytest.raises(ValueError, match="in_dtype"):
+    # int8 joined the family (PR 7) but only with the exact strategies;
+    # the default weighted spelling is rejected naming the constraint.
+    with pytest.raises(ValueError, match="rowcol"):
         make_ft_sgemm("test", in_dtype="int8")
+    make_ft_sgemm("test", strategy="rowcol", in_dtype="int8")
 
 
 def test_kernel_names_carry_dtype():

@@ -334,7 +334,7 @@ def test_cli_tune_roundtrips_via_tune_show(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "1 entries" in out or "2 entries" in out
-    assert "weighted|enc=vpu|inj=0" in out
+    assert "weighted|enc=vpu|thr=static|inj=0" in out
 
 
 def test_cli_tune_dry_run(capsys):
