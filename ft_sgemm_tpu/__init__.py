@@ -23,7 +23,7 @@ become tile-axis reductions, shared-memory double buffering becomes Mosaic's
 automatically pipelined VMEM blocks.
 """
 
-from ft_sgemm_tpu import perf, telemetry, tuner, utils
+from ft_sgemm_tpu import perf, serve, telemetry, tuner, utils
 from ft_sgemm_tpu.configs import (
     KernelShape,
     SHAPES,
@@ -80,6 +80,7 @@ __all__ = [
     "ft_matmul",
     "make_ft_matmul",
     "perf",
+    "serve",
     "telemetry",
     "tuner",
 ]

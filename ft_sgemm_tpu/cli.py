@@ -48,6 +48,10 @@ Usage:
     python -m ft_sgemm_tpu.cli report ARTIFACT.json [--format=md|json]
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
+    python -m ft_sgemm_tpu.cli serve [--buckets=256,512] [--dtype=...] \
+        [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] [--dry-run]
+    python -m ft_sgemm_tpu.cli serve-bench [--smoke] [--buckets=...] \
+        [--requests=N] [--inject-rate=R] [--rate=RPS] [--out=ARTIFACT.json]
 
 ``report`` renders the RunReport a bench artifact embeds
 (``ft_sgemm_tpu.perf``): the environment manifest (device, jax/jaxlib,
@@ -149,6 +153,22 @@ keys.
 ``--trace=DIR`` wraps the perf pass in a ``jax.profiler`` trace (the TPU
 analog of nsight/NVTX instrumentation the reference lacks — SURVEY.md §5
 "Tracing"); open DIR with TensorBoard or Perfetto.
+
+``serve`` runs the fault-tolerant serving layer (``ft_sgemm_tpu.serve``,
+DESIGN.md §11): shape-bucketed continuous batching over an AOT-prewarmed
+bucket set, SLO-aware retry (corrected SDCs are free; an uncorrectable
+one retries only the affected bucket's batch), per-request fault
+attribution. Without ``--dry-run`` it prewarms the bucket set and drives
+a short synthetic load, printing the serving stats; ``--dry-run`` prints
+the bucket plan, per-bucket injection variants, and the resolved
+compile-cache location without touching the backend (the CI smoke).
+``--telemetry=LOG.jsonl`` records one ``serve_gemm`` event per request
+(request id, bucket, tile blame, latency) — summarize or export with the
+``telemetry`` subcommand (``--format=prom`` includes the
+``serve_latency_seconds`` histogram rebuilt from the events).
+``serve-bench`` runs the load-generator goodput bench and prints the
+same JSON artifact line as ``python bench.py --serve``: p50/p99 latency,
+throughput, and goodput-under-injection (correct results per second).
 """
 
 from __future__ import annotations
@@ -981,6 +1001,158 @@ def run_prewarm(args, flags, out=None) -> int:
     return 0 if failures == 0 else 1
 
 
+def _parse_serve_flags(flags):
+    """Shared ``serve`` / ``serve-bench`` flag parsing. Returns the
+    kwargs dict or an error string."""
+    kw = {}
+    for f in flags:
+        try:
+            if f.startswith("--buckets="):
+                kw["bucket_sizes"] = tuple(
+                    int(v) for v in f.split("=", 1)[1].split(",") if v)
+            elif f.startswith("--requests="):
+                kw["num_requests"] = int(f.split("=", 1)[1])
+            elif f.startswith("--inject-rate="):
+                kw["inject_rate"] = float(f.split("=", 1)[1])
+            elif f.startswith("--adversarial-rate="):
+                kw["adversarial_rate"] = float(f.split("=", 1)[1])
+            elif f.startswith("--rate="):
+                kw["rate"] = float(f.split("=", 1)[1])
+            elif f.startswith("--dtype="):
+                kw["in_dtype"] = canonical_in_dtype(f.split("=", 1)[1])
+        except ValueError as e:
+            return None, f"{f}: {e}"
+    return kw, None
+
+
+def run_serve(flags, out=None) -> int:
+    """``serve`` subcommand: the serving layer, driven locally.
+
+    ``--dry-run`` prints the serving PLAN — bucket set (dims, dtype,
+    strategy, tuner-cache key each bucket dispatches under), the
+    injection variants that would be prewarmed, and the resolved
+    compile-cache location — without initializing a backend or compiling
+    anything (CPU/CI-safe). Without it, the engine prewarms the bucket
+    set (AOT compile, persisted when ``FT_SGEMM_COMPILE_CACHE`` is live)
+    and serves a short synthetic load, printing the stats table. Exit 0
+    iff every completed request resolved correct.
+    """
+    from ft_sgemm_tpu.serve import default_bucket_set
+    from ft_sgemm_tpu.serve.engine import VARIANTS
+
+    out = sys.stdout if out is None else out
+    kw, err = _parse_serve_flags(flags)
+    if err:
+        print(f"ft_sgemm: serve: {err}", file=sys.stderr)
+        return 2
+    in_dtype = kw.pop("in_dtype", "float32")
+    sizes = kw.pop("bucket_sizes", None) or (256, 512)
+    try:
+        buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+    except ValueError as e:
+        print(f"ft_sgemm: serve: {e}", file=sys.stderr)
+        return 2
+    if "--dry-run" in flags:
+        from ft_sgemm_tpu import tuner
+        from ft_sgemm_tpu.perf import compile_cache
+
+        path, reason = compile_cache.resolve_dir()
+        print(f"serve (dry run): {len(buckets)} buckets, compile cache "
+              + (f"at {path}" if path else f"OFF ({reason})"), file=out)
+        for b in buckets:
+            # device placeholder: the dry run must never pay (or hang
+            # on) backend init just to render the plan.
+            key = tuner.make_key(b.m, b.n, b.k, strategy=b.strategy,
+                                 in_dtype=b.in_dtype,
+                                 injection_enabled=False,
+                                 device="<device>")
+            print(f"  bucket {b.key:<36s} variants={','.join(VARIANTS)}"
+                  f"  tuner-key {key}", file=out)
+        print("dry run: nothing compiled, nothing served", file=out)
+        return 0
+
+    telemetry_log = None
+    for f in flags:
+        if f.startswith("--telemetry="):
+            telemetry_log = f.split("=", 1)[1]
+    if telemetry_log:
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.configure(telemetry_log, log_clean=True)
+    print_device_info()
+    from ft_sgemm_tpu.serve import run_serve_bench
+
+    try:
+        stats = run_serve_bench(smoke=True, in_dtype=in_dtype,
+                                bucket_sizes=sizes, verify=True,
+                                progress_out=sys.stderr, **kw)
+    finally:
+        if telemetry_log:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.disable()
+            print(f"serve events written to {telemetry_log}",
+                  file=sys.stderr)
+    print(f"served {stats['completed']}/{stats['requests_submitted']} "
+          f"requests over {stats['wall_seconds']}s "
+          f"({stats['requests_rejected']} rejected)", file=out)
+    print(f"  goodput {stats['goodput_rps']} correct req/s  "
+          f"(throughput {stats['throughput_rps']} req/s)", file=out)
+    print(f"  latency p50<={stats['p50_latency_seconds']}s "
+          f"p99<={stats['p99_latency_seconds']}s", file=out)
+    print(f"  corrected free: {stats['corrected_free']}   bucket retries: "
+          f"{stats['bucket_retries']}   whole-queue retries: "
+          f"{stats['whole_queue_retries']}   uncorrectable after retries: "
+          f"{stats['uncorrectable_final']}", file=out)
+    for key, row in sorted(stats["per_bucket"].items()):
+        print(f"    {key:<36s} requests={row['requests']:<4d} "
+              f"batches={row['batches']:<3d} retries={row['retries']}",
+              file=out)
+    ok = (stats["completed"] > 0
+          and stats["correct"] == stats["completed"])
+    return 0 if ok else 1
+
+
+def run_serve_bench_cmd(flags, out=None) -> int:
+    """``serve-bench`` subcommand: the goodput bench as a JSON artifact
+    line (the same assembly ``python bench.py --serve`` emits — this is
+    the in-package spelling for hosts where the bench driver isn't
+    checked out). Exit 0 iff goodput > 0 and every completed request
+    resolved correct."""
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    kw, err = _parse_serve_flags(flags)
+    if err:
+        print(f"ft_sgemm: serve-bench: {err}", file=sys.stderr)
+        return 2
+    out_path = None
+    for f in flags:
+        if f.startswith("--out="):
+            out_path = f.split("=", 1)[1]
+    print_device_info(out=sys.stderr)
+    from ft_sgemm_tpu.serve import run_serve_bench
+
+    stats = run_serve_bench(smoke="--smoke" in flags,
+                            progress_out=sys.stderr, **kw)
+    artifact = {
+        "metric": "serve_goodput_rps",
+        "value": stats.get("goodput_rps"),
+        "unit": "requests/s",
+        "vs_baseline": None,
+        "context": stats,
+    }
+    line = _json.dumps(artifact)
+    print(line, file=out, flush=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    ok = (stats.get("completed", 0) > 0
+          and stats.get("correct") == stats.get("completed")
+          and (stats.get("goodput_rps") or 0) > 0)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
@@ -993,6 +1165,10 @@ def main(argv=None) -> int:
         return run_roc(flags)
     if args and args[0] == "prewarm":
         return run_prewarm(args[1:], flags)
+    if args and args[0] == "serve":
+        return run_serve(flags)
+    if args and args[0] == "serve-bench":
+        return run_serve_bench_cmd(flags)
     if args and args[0] == "telemetry":
         if len(args) < 2:
             print(__doc__)

@@ -50,6 +50,14 @@ _EVENT_MAP = {
 }
 
 _LOCK = threading.Lock()
+# Serializes the whole enable()/disable() sequence (config updates +
+# jax's internal cache-latch reset + directory snapshot): under the
+# serving layer's concurrent dispatch two racing enables could otherwise
+# interleave `jax.config.update` with `reset_cache()` and leave the
+# process latched against a half-configured directory. Reentrant so a
+# future enable-from-enable refactor cannot deadlock; _LOCK stays the
+# cheap guard for the counters the event listener bumps per compile.
+_ENABLE_LOCK = threading.RLock()
 _STATE = {
     "enabled": False,
     "path": None,
@@ -134,7 +142,19 @@ def enable(default: Optional[str] = None, *,
     windows are not — every executable is worth banking (the bench's old
     block used 0.5 s, which skips exactly the small-kernel compiles a
     warm CI run needs to prove hits on).
+
+    Thread-safe: the config-update + latch-reset + snapshot sequence
+    runs under one lock, so concurrent enables (the serving layer's
+    dispatch threads, a bench worker's setup racing a prewarm) serialize
+    instead of interleaving jax's process-global cache state.
     """
+    with _ENABLE_LOCK:
+        return _enable_locked(default,
+                              min_compile_time_secs=min_compile_time_secs)
+
+
+def _enable_locked(default: Optional[str], *,
+                   min_compile_time_secs: float) -> dict:
     path, reason = resolve_dir(default)
     if path is None:
         with _LOCK:
@@ -186,20 +206,21 @@ def enable(default: Optional[str] = None, *,
 def disable() -> dict:
     """Turn the persistent cache back off (tests; the config is process
     global, so a suite that enabled it must restore the default)."""
-    try:
-        import jax
+    with _ENABLE_LOCK:
+        try:
+            import jax
 
-        jax.config.update("jax_compilation_cache_dir", None)
-        # Drop the initialized cache object + used-latch too: without
-        # this, compiles after disable() keep writing to the old dir.
-        from jax._src import compilation_cache as _cc
+            jax.config.update("jax_compilation_cache_dir", None)
+            # Drop the initialized cache object + used-latch too: without
+            # this, compiles after disable() keep writing to the old dir.
+            from jax._src import compilation_cache as _cc
 
-        _cc.reset_cache()
-    except Exception:  # noqa: BLE001
-        pass
-    with _LOCK:
-        _STATE.update(enabled=False, reason="disabled by disable()")
-    return status()
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001
+            pass
+        with _LOCK:
+            _STATE.update(enabled=False, reason="disabled by disable()")
+        return status()
 
 
 def status() -> dict:

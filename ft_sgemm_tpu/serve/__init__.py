@@ -1,0 +1,66 @@
+"""Fault-tolerant serving layer: shape-bucketed continuous batching with
+SLO-aware ABFT retry (ROADMAP item 3).
+
+Everything below this package turns ONE GEMM call fault-tolerant; this
+package turns a STREAM of ragged requests into sustained, high-goodput
+traffic that exploits the online-ABFT economics (arXiv 2305.01024 — the
+overhead is low enough to leave on in production, which only pays off if
+a detected-and-corrected SDC costs the serving path nothing):
+
+- :mod:`.buckets` — shape bucketing: ragged (M, N, K, dtype) requests
+  fold onto a small padded bucket set aligned with the autotuner's cache
+  buckets, so every bucket hits a tuner-cached tile and one prewarmed
+  executable. Oversized requests get the named
+  :class:`~ft_sgemm_tpu.serve.buckets.BucketOverflowError`.
+- :mod:`.engine` — the async continuous-batching dispatch queue: per-
+  bucket accumulation, flush on batch-full or max-wait, AOT-prewarmed
+  executables (zero compile spans in steady state — timeline-pinned),
+  per-request fault attribution from each request's own counter grids,
+  and the SLO-aware retry ladder: corrected SDCs are FREE, an
+  uncorrectable one retries only the affected bucket's batch — never the
+  whole queue — bounded, backed off, and recorded as telemetry ladder
+  events.
+- :mod:`.loadgen` — the load-generator bench (``bench.py --serve``,
+  ``cli serve-bench``): configurable arrival process with SDC injection,
+  reporting p50/p99 latency (from the telemetry histogram machinery),
+  throughput, and goodput-under-injection.
+
+CLI: ``python -m ft_sgemm_tpu.cli serve [--dry-run]`` and
+``python -m ft_sgemm_tpu.cli serve-bench [--smoke]``.
+"""
+
+from __future__ import annotations
+
+from ft_sgemm_tpu.serve.buckets import (
+    Bucket,
+    BucketOverflowError,
+    default_bucket_set,
+    select_bucket,
+)
+from ft_sgemm_tpu.serve.engine import (
+    VARIANTS,
+    ServeEngine,
+    ServeRequest,
+    ServeResult,
+)
+from ft_sgemm_tpu.serve.loadgen import (
+    LoadSpec,
+    run_load,
+    run_serve_bench,
+    smoke_spec,
+)
+
+__all__ = [
+    "Bucket",
+    "BucketOverflowError",
+    "LoadSpec",
+    "ServeEngine",
+    "ServeRequest",
+    "ServeResult",
+    "VARIANTS",
+    "default_bucket_set",
+    "run_load",
+    "run_serve_bench",
+    "select_bucket",
+    "smoke_spec",
+]

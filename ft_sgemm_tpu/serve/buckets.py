@@ -1,0 +1,152 @@
+"""Shape bucketing: map ragged request streams onto a small padded set.
+
+A serving stream is ragged — every request brings its own (M, N, K) — but
+one compiled executable serves exactly one operand shape. Recompiling per
+request would put XLA compile on the hot path (the exact wall sink the
+PR-6 phase attribution measured dominating the bench rounds), so the
+serving layer folds the stream onto a SMALL, FIXED set of padded buckets:
+
+- Each :class:`Bucket` is a padded ``(M, N, K, dtype, strategy)`` target.
+  A request is routed to the smallest bucket that fits (exact-boundary
+  shapes route to their own bucket — no unnecessary padding step), its
+  operands are zero-padded to the bucket dims, and the result is sliced
+  back to the request's true shape. Zero padding is exact for GEMM: the
+  padded rows/columns contribute nothing.
+- Bucket dims are powers of two floored at the 128 MXU granule — the SAME
+  bucketing the autotuner cache keys on (``tuner.mnk_bucket``), so every
+  bucket's dispatch hits at most ONE tuner-cache entry, and prewarming the
+  bucket set AOT-compiles exactly the executables steady-state requests
+  will run.
+- A request larger than the largest bucket is REJECTED with the named
+  :class:`BucketOverflowError` (silent unbounded padding or per-request
+  recompiles are both worse than a clear refusal the caller can route to
+  a bigger deployment).
+
+Per-dtype strategy legality is enforced at bucket construction through
+``configs.check_kernel_legality`` — an int8 bucket can only carry the
+exact strategies (``rowcol``/``global``), so int8 requests are routed to
+``rowcol`` kernels by construction (the PR-7 constraint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence, Tuple
+
+from ft_sgemm_tpu.configs import canonical_in_dtype, check_kernel_legality
+
+
+class BucketOverflowError(ValueError):
+    """A request exceeds every configured bucket — named so servers can
+    map it to a clean client-facing rejection instead of a 500."""
+
+
+def _pow2_dim(v: int) -> int:
+    """Next power of two >= v, floored at 128 (tuner.mnk_bucket's rule)."""
+    b = 128
+    while b < v:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One padded serving target: requests routed here run one compiled
+    kernel family at exactly ``(m, n, k)`` in ``in_dtype`` under
+    ``strategy``.
+
+    Dims must be positive multiples of 128 (the MXU granule every
+    ``KernelShape`` is built from); the (strategy, dtype) pair must pass
+    the kernel family's legality gate — constructing an int8 bucket with
+    a ratio-localizing strategy raises the factory's own error.
+    """
+
+    m: int
+    n: int
+    k: int
+    in_dtype: str = "float32"
+    strategy: str = "weighted"
+
+    def __post_init__(self):
+        for field in ("m", "n", "k"):
+            v = getattr(self, field)
+            if not isinstance(v, int) or v <= 0 or v % 128 != 0:
+                raise ValueError(
+                    f"Bucket.{field}={v!r} must be a positive multiple of"
+                    " 128 (MXU granule; tuner-cache bucket alignment)")
+        # Canonicalize the dtype AND validate the (strategy, dtype) pair
+        # with the kernel factory's single legality source — the int8 ->
+        # rowcol/global routing constraint lives there, not here.
+        canon = check_kernel_legality(
+            strategy=self.strategy, encode="vpu", in_dtype=self.in_dtype)
+        object.__setattr__(self, "in_dtype", canon)
+
+    @property
+    def key(self) -> str:
+        """Stable bucket identity: dims, dtype, strategy."""
+        return f"{self.m}x{self.n}x{self.k}|{self.in_dtype}|{self.strategy}"
+
+    @property
+    def volume(self) -> int:
+        return self.m * self.n * self.k
+
+    def fits(self, m: int, n: int, k: int) -> bool:
+        return m <= self.m and n <= self.n and k <= self.k
+
+
+def default_bucket_set(sizes: Sequence[int] = (256, 512, 1024),
+                       in_dtype: str = "float32",
+                       strategy: Optional[str] = None) -> Tuple[Bucket, ...]:
+    """A ladder of square buckets — the deliberately SMALL default set.
+
+    Square powers of two keep the set prewarmable in seconds and make
+    every bucket's dims equal its own tuner-cache bucket
+    (``mnk_bucket(m, n, k) == (m, n, k)`` for power-of-two dims), so one
+    ``cli tune SIZE`` per rung covers the whole serving path. ``strategy``
+    defaults per dtype: ``weighted`` (the family flagship — deferred
+    localization, lowest overhead) for the float dtypes, ``rowcol`` for
+    int8, whose exact path ships only the non-ratio-localizing
+    strategies (``configs.check_kernel_legality``, the PR-7 routing
+    constraint).
+    """
+    dtype = canonical_in_dtype(in_dtype)
+    if strategy is None:
+        strategy = "rowcol" if dtype == "int8" else "weighted"
+    out = []
+    for s in sorted(set(int(v) for v in sizes)):
+        if s != _pow2_dim(s):
+            raise ValueError(
+                f"default_bucket_set sizes must be powers of two >= 128"
+                f" (tuner-cache bucket alignment), got {s}")
+        out.append(Bucket(s, s, s, in_dtype=dtype, strategy=strategy))
+    if not out:
+        raise ValueError("default_bucket_set needs at least one size")
+    return tuple(out)
+
+
+def select_bucket(buckets: Iterable[Bucket], m: int, n: int, k: int,
+                  in_dtype: str = "float32") -> Bucket:
+    """The smallest configured bucket that fits an ``(m, n, k, dtype)``
+    request — smallest by padded volume, so boundary-exact shapes pay
+    zero padding and ragged ones pay the least available.
+
+    Raises :class:`BucketOverflowError` (with the request shape and the
+    largest available bucket named) when nothing fits — the caller's cue
+    to reject the request, never to silently compile a fresh shape.
+    """
+    dtype = canonical_in_dtype(in_dtype)
+    fitting = [b for b in buckets
+               if b.in_dtype == dtype and b.fits(m, n, k)]
+    if not fitting:
+        same_dtype = [b for b in buckets if b.in_dtype == dtype]
+        largest = (max(same_dtype, key=lambda b: b.volume).key
+                   if same_dtype else "none configured for this dtype")
+        raise BucketOverflowError(
+            f"request {m}x{n}x{k} ({dtype}) exceeds every configured"
+            f" bucket (largest: {largest}); reject or deploy a larger"
+            " bucket set")
+    return min(fitting, key=lambda b: (b.volume, b.key))
+
+
+__all__ = ["Bucket", "BucketOverflowError", "default_bucket_set",
+           "select_bucket"]

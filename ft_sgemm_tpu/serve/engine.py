@@ -1,0 +1,623 @@
+"""Async continuous-batching dispatch with SLO-aware ABFT retry.
+
+The serving core (ROADMAP item 3): requests accumulate per shape bucket
+(:mod:`.buckets`), a background dispatcher flushes a bucket when it is
+batch-full or its oldest request has waited ``max_wait``, and every
+request runs one of a small set of AOT-compiled executables — compiled
+once (at :meth:`ServeEngine.prewarm` or, lazily, as a RECORDED compile
+span on first use), then reused for every request the bucket ever serves.
+Steady-state dispatch on a prewarmed bucket set therefore records ZERO
+compile spans in the run timeline — the warm-path contract
+``perf/wallclock.py`` phase attribution pins in ``tests/test_serve.py``.
+
+The retry policy is where the paper's economics land (arXiv 2305.01024:
+online ABFT is cheap enough to leave on — IF the serving path exploits
+it):
+
+- **Corrected SDC = free.** A result with ``detections > 0`` and
+  ``uncorrectable == 0`` was repaired in-kernel; the request completes
+  with ZERO retries (``serve_corrected_free`` counts them — the goodput
+  the fused kernel buys).
+- **Uncorrectable = bucket-scoped retry.** Only the affected requests of
+  the affected bucket's batch re-execute — never the whole queue
+  (``serve_whole_queue_retries`` exists solely to be pinned at zero).
+  Retries are bounded (``max_retries``) with exponential backoff, and
+  every transition lands as a telemetry ladder event
+  (``retry`` / ``exhausted``, the ``train.resilient_step`` vocabulary).
+  Retries re-execute without injection: the injected fault models a
+  TRANSIENT hardware SDC, which does not replay on the same data.
+
+Per-request fault attribution: each request's own ``FtSgemmResult``
+counter grids (the PR-5 per-device/per-tile attribution machinery) are
+materialized per request, so a fault is blamed on a REQUEST — tile
+coordinates, bucket, request id — not just on a call. When telemetry is
+enabled each request emits one ``serve_gemm`` event carrying
+``request_id`` / ``bucket`` / ``variant`` / ``latency_seconds`` /
+``retries`` in ``extra``; latencies additionally feed the registry's
+``serve_latency_seconds`` histogram (``registry.LATENCY_BUCKETS``), whose
+:func:`~ft_sgemm_tpu.telemetry.registry.histogram_percentiles` estimates
+are the ONLY p50/p99 implementation the serving layer has.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ft_sgemm_tpu.serve.buckets import Bucket, select_bucket
+from ft_sgemm_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    histogram_percentiles,
+)
+
+# Injection variants the engine prewarms per bucket. A request names a
+# VARIANT, not an arbitrary InjectionSpec: one executable per
+# (bucket, variant) is the whole point of bucketing, and a free-form
+# per-request schedule would force a fresh trace+compile onto the hot
+# path. "clean" runs no injection; "inject" is the reference-like
+# correctable schedule (rotating columns — every fault corrected
+# in-kernel); "adversarial" pins every fault to ONE column under a
+# single final check, the schedule known to defeat column-localized
+# correction (the uncorrectable-SDC simulator driving the retry path).
+VARIANTS = ("clean", "inject", "adversarial")
+
+_REQ_IDS = itertools.count(1)
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One GEMM request: ``alpha * a @ b.T + beta * c`` at the request's
+    own ragged shape — ``a`` is (m, k), ``b`` is (n, k) (the family's
+    operand convention), ``c`` (m, n) or None for zeros. ``variant``
+    selects one of the engine's prewarmed injection variants
+    (:data:`VARIANTS`) — load generators use it to model SDC arrival."""
+
+    a: np.ndarray
+    b: np.ndarray
+    c: Optional[np.ndarray] = None
+    in_dtype: str = "float32"
+    variant: str = "clean"
+    request_id: int = dataclasses.field(
+        default_factory=lambda: next(_REQ_IDS))
+
+    def __post_init__(self):
+        if self.variant not in VARIANTS:
+            raise ValueError(
+                f"ServeRequest.variant={self.variant!r} must be one of"
+                f" {VARIANTS} (per-request free-form injection would"
+                " defeat the one-executable-per-bucket contract)")
+        self.a = np.asarray(self.a)
+        self.b = np.asarray(self.b)
+        if self.a.ndim != 2 or self.b.ndim != 2:
+            raise ValueError("ServeRequest operands must be 2-D: a is"
+                             " (m, k), b is (n, k)")
+        if self.a.shape[1] != self.b.shape[1]:
+            raise ValueError(
+                f"ServeRequest contraction mismatch: a is {self.a.shape}"
+                f" (m, k), b is {self.b.shape} (n, k)")
+
+    @property
+    def mnk(self) -> Tuple[int, int, int]:
+        return (self.a.shape[0], self.b.shape[0], self.a.shape[1])
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a request's future resolves to."""
+
+    request_id: int
+    bucket_key: str
+    c: np.ndarray
+    detections: int
+    uncorrectable: int
+    retries: int
+    ok: bool                      # verified-or-corrected; False = exhausted
+    corrected: bool               # detections > 0 and repaired in-kernel
+    latency_seconds: float
+    blame_tiles: Optional[list]   # nonzero per-tile coords, request-scoped
+
+
+class _Future:
+    """Minimal thread-safe future (stdlib concurrent.futures would work
+    too; this keeps the wait/notify under the engine's own discipline)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result: Optional[ServeResult] = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, result: ServeResult) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class _Entry:
+    request: ServeRequest
+    future: _Future
+    t_enqueue: float
+
+
+class _NullRecorder:
+    """Timeline stand-in when the engine runs without one."""
+
+    path = None
+
+    def point(self, *a, **k):
+        pass
+
+    @contextlib.contextmanager
+    def span(self, *a, **k):
+        yield {}
+
+
+def _as_recorder(timeline):
+    if timeline is None:
+        return _NullRecorder()
+    if isinstance(timeline, str):
+        from ft_sgemm_tpu.telemetry.timeline import TimelineRecorder
+
+        return TimelineRecorder(timeline)
+    return timeline
+
+
+class ServeEngine:
+    """Shape-bucketed continuous-batching GEMM server.
+
+    Lifecycle::
+
+        engine = ServeEngine(default_bucket_set((256, 512)))
+        engine.start()
+        engine.prewarm()              # AOT-compile every (bucket, variant)
+        fut = engine.submit(ServeRequest(a, b))
+        res = fut.result(timeout=30)  # ServeResult
+        engine.drain(); engine.close()
+
+    or ``with ServeEngine(...) as engine: ...`` (start on enter,
+    drain+close on exit). Thread-safe: ``submit`` may be called from any
+    number of producer threads; execution runs on the engine's single
+    dispatcher thread (one device, one dispatch stream — batching, not
+    device contention, is the concurrency model).
+    """
+
+    def __init__(self, buckets: Sequence[Bucket], *,
+                 alpha: float = 1.0, beta: float = 0.0,
+                 threshold="static",
+                 max_batch: int = 4, max_wait: float = 0.05,
+                 max_retries: int = 2, retry_backoff: float = 0.01,
+                 timeline=None, registry=None):
+        if not buckets:
+            raise ValueError("ServeEngine needs at least one bucket")
+        if max_batch < 1:
+            raise ValueError(f"max_batch={max_batch} must be >= 1")
+        self.buckets: Tuple[Bucket, ...] = tuple(buckets)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.threshold = threshold
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait)
+        self.max_retries = int(max_retries)
+        self.retry_backoff = float(retry_backoff)
+        self._tl = _as_recorder(timeline)
+        from ft_sgemm_tpu import telemetry
+
+        self.registry = registry if registry is not None \
+            else telemetry.get_registry()
+
+        self._cond = threading.Condition()
+        self._pending: Dict[str, collections.deque] = {
+            b.key: collections.deque() for b in self.buckets}
+        self._by_key = {b.key: b for b in self.buckets}
+        self._outstanding = 0
+        self._draining = False
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+        self._compile_lock = threading.Lock()
+        self._compiled: Dict[Tuple[str, str], object] = {}
+        self._kernels: Dict[Tuple[str, str], object] = {}
+        self._prewarmed = False
+
+        self._stats_lock = threading.Lock()
+        self._counts = {
+            "requests": 0, "completed": 0, "batches": 0,
+            "corrected_free": 0, "retries": 0, "whole_queue_retries": 0,
+            "uncorrectable_exhausted": 0, "rejected": 0,
+        }
+        self._per_bucket: Dict[str, dict] = {
+            b.key: {"requests": 0, "batches": 0, "retries": 0}
+            for b in self.buckets}
+
+    # -- kernel family per (bucket, variant) --------------------------------
+
+    def _bucket_tile(self, bucket: Bucket):
+        """The bucket's explicit base tile (the tuner cache, consulted at
+        trace time via ``tunable=True``, overrides it with a measured
+        winner when one exists). ``bk`` stays at one 128-granule for
+        k <= 512 so the K grid is >= 2 steps on the 256+ buckets — the
+        depth the adversarial variant's same-column schedule needs to
+        produce a genuine uncorrectable interval."""
+        from ft_sgemm_tpu.configs import KernelShape
+
+        bm = min(bucket.m, 512)
+        bn = min(bucket.n, 512)
+        bk = 128 if bucket.k <= 512 else 512
+        return KernelShape(f"serve{bm}x{bn}x{bk}", bm, bn, bk, (0,) * 7)
+
+    def _variant_spec(self, bucket: Bucket, variant: str):
+        from ft_sgemm_tpu.injection import InjectionSpec
+
+        if variant == "clean":
+            return InjectionSpec.none()
+        if variant == "inject":
+            # Reference-like correctable SDCs: rotating columns (the
+            # coprime stride), one fault per K step — every one is
+            # detected and corrected in-kernel.
+            return InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+        # Adversarial: every fault in ONE column — under weighted's
+        # deferred single final check, two-plus same-column faults in the
+        # interval defeat per-column localization and report
+        # uncorrectable: the transient-SDC failure the retry ladder
+        # exists for. (Needs a bucket with nk >= 2, i.e. k >= 256 at the
+        # serve tile; int8/rowcol buckets correct even this schedule —
+        # their intersection disambiguates by row.)
+        return InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                             col_stride=0)
+
+    def _kernel(self, bucket: Bucket, variant: str):
+        key = (bucket.key, variant)
+        kern = self._kernels.get(key)
+        if kern is not None:
+            return kern
+        from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+        tile = self._bucket_tile(bucket)
+        # The adversarial variant runs with the tuner OFF: a tuned tile
+        # deepening bk would collapse the K grid to one step, and the
+        # same-column schedule needs >= 2 faults in one check interval
+        # to actually defeat weighted localization (nk = 1 degenerates
+        # to a corrected single fault). Clean/inject dispatch stays
+        # tuner-backed — the serving hot path is the one the cache is
+        # for.
+        kern = make_ft_sgemm(
+            tile, alpha=self.alpha, beta=self.beta,
+            strategy=bucket.strategy, in_dtype=bucket.in_dtype,
+            threshold=self.threshold,
+            tunable=variant != "adversarial")
+        self._kernels[key] = kern
+        return kern
+
+    def _get_compiled(self, bucket: Bucket, variant: str):
+        """The AOT-compiled executable for one (bucket, variant) — the
+        object steady-state dispatch calls directly, so serving never
+        re-enters jit tracing. A compile that happens here (i.e. the
+        bucket was NOT prewarmed) is recorded as a ``compile`` span: the
+        timeline never lies about warm-path purity."""
+        key = (bucket.key, variant)
+        compiled = self._compiled.get(key)
+        if compiled is not None:
+            return compiled
+        with self._compile_lock:
+            compiled = self._compiled.get(key)
+            if compiled is not None:
+                return compiled
+            import jax
+            import jax.numpy as jnp
+
+            kern = self._kernel(bucket, variant)
+            spec = self._variant_spec(bucket, variant)
+            fn = jax.jit(lambda a, b, c: kern(a, b, c, spec))
+            a_av = jax.ShapeDtypeStruct((bucket.m, bucket.k), jnp.float32)
+            b_av = jax.ShapeDtypeStruct((bucket.n, bucket.k), jnp.float32)
+            c_av = jax.ShapeDtypeStruct((bucket.m, bucket.n), jnp.float32)
+            with self._tl.span(f"compile[{bucket.key}:{variant}]",
+                               kind="compile"):
+                compiled = fn.lower(a_av, b_av, c_av).compile()
+            self._compiled[key] = compiled
+            return compiled
+
+    def prewarm(self, variants: Iterable[str] = VARIANTS) -> dict:
+        """AOT-compile every (bucket, variant) executable up front —
+        ``cli prewarm``'s machinery applied to the bucket set, with the
+        persistent compile cache (``FT_SGEMM_COMPILE_CACHE``) banking
+        each one when enabled, so even a server RESTART resumes warm.
+        Emits a ``prewarm_done`` timeline point: everything after it is
+        the steady state the zero-compile-span pin measures."""
+        t0 = time.monotonic()
+        compiled = 0
+        for bucket in self.buckets:
+            for variant in variants:
+                self._get_compiled(bucket, variant)
+                compiled += 1
+        self._prewarmed = True
+        seconds = round(time.monotonic() - t0, 3)
+        self._tl.point("serve", "prewarm_done", compiled=compiled,
+                       seconds=seconds)
+        return {"compiled": compiled, "buckets": len(self.buckets),
+                "seconds": seconds}
+
+    # -- queue --------------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="serve-dispatch")
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc):
+        if not any(exc):
+            self.drain()
+        self.close()
+        return False
+
+    def submit(self, request: ServeRequest) -> _Future:
+        """Route one request to its bucket and enqueue it. Raises
+        :class:`~ft_sgemm_tpu.serve.buckets.BucketOverflowError`
+        synchronously for shapes nothing fits (counted as rejected)."""
+        m, n, k = request.mnk
+        try:
+            bucket = select_bucket(self.buckets, m, n, k,
+                                   in_dtype=request.in_dtype)
+        except Exception:
+            with self._stats_lock:
+                self._counts["rejected"] += 1
+            self.registry.counter("serve_rejected").inc()
+            raise
+        fut = _Future()
+        entry = _Entry(request, fut, time.monotonic())
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("ServeEngine is closed")
+            self._pending[bucket.key].append(entry)
+            self._outstanding += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._counts["requests"] += 1
+            self._per_bucket[bucket.key]["requests"] += 1
+        self.registry.counter("serve_requests", bucket=bucket.key).inc()
+        return fut
+
+    def _ready_keys(self, now: float) -> list:
+        out = []
+        for key, q in self._pending.items():
+            if not q:
+                continue
+            if (len(q) >= self.max_batch or self._draining or self._stop
+                    or now - q[0].t_enqueue >= self.max_wait):
+                out.append(key)
+        return out
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        waits = [self.max_wait - (now - q[0].t_enqueue)
+                 for q in self._pending.values() if q]
+        return max(0.0, min(waits)) if waits else None
+
+    def _dispatch_loop(self):
+        while True:
+            batches = []
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    ready = self._ready_keys(now)
+                    if ready:
+                        break
+                    if self._stop:
+                        return
+                    timeout = self._next_deadline(now)
+                    self._cond.wait(0.1 if timeout is None else timeout)
+                for key in ready:
+                    q = self._pending[key]
+                    take = [q.popleft()
+                            for _ in range(min(len(q), self.max_batch))]
+                    batches.append((self._by_key[key], take))
+            for bucket, entries in batches:
+                self._execute_batch(bucket, entries)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted request has resolved. Flushes
+        partial batches immediately (max_wait is waived while draining).
+        A drain of an empty queue returns at once."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            try:
+                while self._outstanding > 0:
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"drain timed out with {self._outstanding}"
+                            " requests outstanding")
+                    self._cond.wait(0.05)
+            finally:
+                self._draining = False
+
+    def close(self) -> None:
+        """Stop the dispatcher. Unresolved futures are rejected (a closed
+        engine must never strand a waiter)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        leftovers = []
+        with self._cond:
+            for q in self._pending.values():
+                leftovers.extend(q)
+                q.clear()
+            self._outstanding -= len(leftovers)
+        for entry in leftovers:
+            entry.future._reject(RuntimeError("ServeEngine closed with"
+                                              " request still queued"))
+
+    # -- execution ----------------------------------------------------------
+
+    def _pad_operands(self, bucket: Bucket, request: ServeRequest):
+        m, n, k = request.mnk
+        a = np.zeros((bucket.m, bucket.k), np.float32)
+        b = np.zeros((bucket.n, bucket.k), np.float32)
+        c = np.zeros((bucket.m, bucket.n), np.float32)
+        a[:m, :k] = request.a
+        b[:n, :k] = request.b
+        if request.c is not None:
+            c[:m, :n] = request.c
+        return a, b, c
+
+    def _execute_batch(self, bucket: Bucket, entries: Sequence[_Entry]):
+        with self._stats_lock:
+            self._counts["batches"] += 1
+            self._per_bucket[bucket.key]["batches"] += 1
+        self.registry.counter("serve_batches", bucket=bucket.key).inc()
+        with self._tl.span(f"serve[{bucket.key}]", kind="stage") as info:
+            det_total = unc_total = 0
+            for entry in entries:
+                det, unc = self._execute_one(bucket, entry)
+                det_total += det
+                unc_total += unc
+            info["value"] = {"batch": len(entries),
+                             "detections": det_total,
+                             "uncorrectable_final": unc_total}
+
+    def _execute_one(self, bucket: Bucket, entry: _Entry) -> Tuple[int, int]:
+        """Run one request (with the bucket-scoped retry ladder); resolve
+        its future. Returns the final (detections, uncorrectable)."""
+        from ft_sgemm_tpu import telemetry
+
+        request = entry.request
+        m, n, _ = request.mnk
+        a, b, c = self._pad_operands(bucket, request)
+        variant = request.variant
+        retries = 0
+        res = det = unc = None
+        while True:
+            compiled = self._get_compiled(bucket, variant)
+            res = compiled(a, b, c)
+            det = int(np.sum(np.asarray(res.detections)))
+            unc = int(np.sum(np.asarray(res.uncorrectable)))
+            if unc == 0 or retries >= self.max_retries:
+                break
+            # Bucket-scoped retry: ONLY this bucket's affected request
+            # re-executes; every other bucket's queue — and even this
+            # bucket's clean batchmates — are untouched. Bounded, backed
+            # off, and recorded as a ladder event. The retry runs the
+            # clean variant: the injected fault models a transient SDC,
+            # which does not replay on identical data.
+            retries += 1
+            backoff = self.retry_backoff * (2 ** (retries - 1))
+            with self._stats_lock:
+                self._counts["retries"] += 1
+                self._per_bucket[bucket.key]["retries"] += 1
+            self.registry.counter("serve_retries",
+                                  bucket=bucket.key).inc()
+            telemetry.record_step_event(
+                "retry", op="serve",
+                uncorrectable=unc,
+                extra={"bucket": bucket.key,
+                       "request_id": request.request_id,
+                       "attempt": retries,
+                       "backoff_seconds": round(backoff, 6)})
+            if backoff > 0:
+                time.sleep(backoff)
+            variant = "clean"
+        ok = unc == 0
+        corrected = ok and det > 0
+        if corrected:
+            with self._stats_lock:
+                self._counts["corrected_free"] += 1
+            self.registry.counter("serve_corrected_free",
+                                  bucket=bucket.key).inc()
+        if not ok:
+            with self._stats_lock:
+                self._counts["uncorrectable_exhausted"] += 1
+            self.registry.counter("serve_uncorrectable_exhausted",
+                                  bucket=bucket.key).inc()
+            telemetry.record_step_event(
+                "exhausted", op="serve", uncorrectable=unc,
+                extra={"bucket": bucket.key,
+                       "request_id": request.request_id,
+                       "attempts": retries})
+        latency = time.monotonic() - entry.t_enqueue
+        det_grid = np.asarray(res.detections)
+        blame = np.argwhere(det_grid != 0)
+        blame_tiles = ([[int(i), int(j)] for i, j in blame]
+                       if blame.size else None)
+        for labels in ({}, {"bucket": bucket.key}):
+            self.registry.histogram("serve_latency_seconds",
+                                    buckets=LATENCY_BUCKETS,
+                                    **labels).observe(latency)
+        if telemetry.enabled():
+            # Per-request fault attribution: the request's OWN counter
+            # grids (not the batch's, not the process's) feed the event,
+            # so `cli telemetry` blames faults on requests.
+            telemetry.record_gemm(
+                "serve_gemm", res, strategy=bucket.strategy,
+                layer=bucket.key, extra={
+                    "request_id": request.request_id,
+                    "bucket": bucket.key,
+                    "variant": request.variant,
+                    "retries": retries,
+                    "latency_seconds": round(latency, 6)})
+        out = np.asarray(res.c)[:m, :n]
+        result = ServeResult(
+            request_id=request.request_id, bucket_key=bucket.key,
+            c=out, detections=det, uncorrectable=unc, retries=retries,
+            ok=ok, corrected=corrected, latency_seconds=latency,
+            blame_tiles=blame_tiles)
+        with self._stats_lock:
+            self._counts["completed"] += 1
+        entry.future._resolve(result)
+        with self._cond:
+            self._outstanding -= 1
+            self._cond.notify_all()
+        return det, unc
+
+    # -- stats --------------------------------------------------------------
+
+    def latency_percentiles(self, quantiles=(0.5, 0.99)) -> dict:
+        """p50/p99/max latency estimates straight from the registry's
+        ``serve_latency_seconds`` histogram — the telemetry machinery IS
+        the stats implementation (there is deliberately no second one)."""
+        hist = self.registry.histogram("serve_latency_seconds",
+                                       buckets=LATENCY_BUCKETS)
+        return histogram_percentiles(hist.value, quantiles=quantiles)
+
+    def stats(self) -> dict:
+        """Snapshot: engine counters, per-bucket rows, latency
+        percentiles."""
+        with self._stats_lock:
+            counts = dict(self._counts)
+            per_bucket = {k: dict(v) for k, v in self._per_bucket.items()}
+        out = dict(counts)
+        out["per_bucket"] = per_bucket
+        out["prewarmed"] = self._prewarmed
+        out["latency"] = self.latency_percentiles()
+        return out
+
+
+__all__ = ["ServeEngine", "ServeRequest", "ServeResult", "VARIANTS"]

@@ -1,0 +1,263 @@
+"""Load generator + goodput bench for the serving layer.
+
+Drives a :class:`~ft_sgemm_tpu.serve.engine.ServeEngine` with a
+configurable arrival process — ragged shapes, a request rate (Poisson
+inter-arrivals; 0 = open loop), and per-request SDC injection at a
+configurable rate — and reports the serving numbers that matter:
+
+- **p50 / p99 latency** — straight from the engine's
+  ``serve_latency_seconds`` registry histogram
+  (``telemetry.registry.histogram_percentiles``), no second stats path.
+- **throughput** — completed requests per second of drive wall.
+- **goodput-under-injection** — CORRECT results per second: the paper's
+  claim made measurable. A detected-and-corrected SDC costs zero retries,
+  so goodput under a nonzero injection rate should track clean throughput;
+  every uncorrectable costs exactly one bucket-scoped retry.
+
+``verify=True`` checks every result against the XLA oracle
+(``sgemm_reference`` at the request's true shape), so "correct" means
+numerically verified, not merely "no fault reported".
+
+The bench core (:func:`run_serve_bench`) is shared by ``bench.py
+--serve`` and ``cli serve-bench``; progress streams as timeline points
+(``serve_progress``) so a deadline-killed run leaves partial stats on
+disk for the supervisor/reader — the PR-5 kill-safety discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ft_sgemm_tpu.serve.buckets import (
+    BucketOverflowError,
+    default_bucket_set,
+    select_bucket,
+)
+from ft_sgemm_tpu.serve.engine import ServeEngine, ServeRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """One load-generation scenario.
+
+    ``shapes`` is the ragged (m, n, k) menu requests sample from —
+    deliberately NOT bucket-aligned, so padding is exercised.
+    ``inject_rate`` / ``adversarial_rate`` are per-request probabilities
+    of the correctable / uncorrectable injection variants (adversarial
+    requests are routed to buckets deep enough to express the failure —
+    see the engine's variant notes — and downgrade to "inject"
+    otherwise). ``rate`` is mean request arrivals per second (Poisson);
+    0 submits as fast as the queue accepts.
+    """
+
+    num_requests: int = 64
+    rate: float = 0.0
+    shapes: Tuple[Tuple[int, int, int], ...] = (
+        (96, 120, 100), (128, 128, 128), (200, 180, 160),
+        (250, 140, 250), (256, 256, 256))
+    in_dtype: str = "float32"
+    inject_rate: float = 0.0
+    adversarial_rate: float = 0.0
+    seed: int = 10
+    verify: bool = False
+    result_timeout: float = 300.0
+
+
+def smoke_spec() -> LoadSpec:
+    """The CPU-runnable CI scenario: a couple dozen ragged requests, a
+    quarter of them carrying correctable SDCs, a handful adversarial —
+    enough traffic to pin goodput > 0, zero whole-queue retries, and a
+    populated latency histogram in about a minute of interpret mode."""
+    return LoadSpec(num_requests=18, inject_rate=0.25,
+                    adversarial_rate=0.12, verify=True)
+
+
+def _gen_request(rng, spec: LoadSpec, buckets) -> ServeRequest:
+    m, n, k = spec.shapes[int(rng.integers(len(spec.shapes)))]
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    if spec.in_dtype == "int8":
+        # The integer lattice the exact path expects (the CLI's
+        # quantization convention).
+        a = np.round(a * 3.0)
+        b = np.round(b * 3.0)
+    u = float(rng.random())
+    variant = "clean"
+    if u < spec.adversarial_rate:
+        variant = "adversarial"
+        try:
+            bucket = select_bucket(buckets, m, n, k, in_dtype=spec.in_dtype)
+            if bucket.k < 256:
+                # Too shallow for a same-column multi-fault interval:
+                # the schedule would be corrected, not uncorrectable.
+                variant = "inject"
+        except BucketOverflowError:
+            pass  # submit() will reject it either way
+    elif u < spec.adversarial_rate + spec.inject_rate:
+        variant = "inject"
+    return ServeRequest(a=a, b=b, in_dtype=spec.in_dtype, variant=variant)
+
+
+def run_load(engine: ServeEngine, spec: LoadSpec, *,
+             should_stop: Optional[Callable[[], bool]] = None,
+             progress: Optional[Callable[[dict], None]] = None) -> dict:
+    """Drive one load scenario to completion (or early stop) and return
+    the serving stats dict.
+
+    ``should_stop`` (checked between arrivals) ends submission early —
+    already-submitted requests still drain and the stats are marked
+    ``partial`` — the hook ``bench.py --serve`` wires to SIGTERM so a
+    deadline-killed run emits what it measured instead of nothing.
+    """
+    rng = np.random.default_rng(spec.seed)
+    t0 = time.monotonic()
+    submitted = []
+    rejected = 0
+    partial = False
+    for i in range(spec.num_requests):
+        if should_stop is not None and should_stop():
+            partial = True
+            break
+        req = _gen_request(rng, spec, engine.buckets)
+        try:
+            fut = engine.submit(req)
+        except BucketOverflowError:
+            rejected += 1
+            continue
+        submitted.append((req, fut))
+        if progress is not None and (i + 1) % 8 == 0:
+            progress({"submitted": i + 1})
+        if spec.rate > 0:
+            time.sleep(float(rng.exponential(1.0 / spec.rate)))
+    engine.drain(timeout=spec.result_timeout)
+    wall = time.monotonic() - t0
+
+    completed = correct = corrected = uncorrectable_final = 0
+    retries = 0
+    verify_failures = 0
+    variant_counts: dict = {}
+    for req, fut in submitted:
+        res = fut.result(timeout=spec.result_timeout)
+        completed += 1
+        retries += res.retries
+        variant_counts[req.variant] = variant_counts.get(req.variant, 0) + 1
+        if res.corrected:
+            corrected += 1
+        if not res.ok:
+            uncorrectable_final += 1
+            continue
+        if spec.verify:
+            from ft_sgemm_tpu.ops.reference import sgemm_reference
+            from ft_sgemm_tpu.utils.matrices import verify_matrix
+
+            m, n, _ = req.mnk
+            want = np.asarray(sgemm_reference(
+                req.a, req.b, np.zeros((m, n), np.float32),
+                engine.alpha, engine.beta, in_dtype=req.in_dtype))
+            ok, _, _ = verify_matrix(want, res.c, verbose=False)
+            if not ok:
+                verify_failures += 1
+                continue
+        correct += 1
+
+    eng = engine.stats()
+    lat = eng["latency"]
+    stats = {
+        "requests_submitted": len(submitted),
+        "requests_rejected": rejected,
+        "completed": completed,
+        "correct": correct,
+        "corrected_free": corrected,
+        "uncorrectable_final": uncorrectable_final,
+        "verify_failures": verify_failures,
+        "verified": bool(spec.verify),
+        "retries": retries,
+        "bucket_retries": eng["retries"],
+        "whole_queue_retries": eng["whole_queue_retries"],
+        "batches": eng["batches"],
+        "variants": variant_counts,
+        "inject_rate": spec.inject_rate,
+        "adversarial_rate": spec.adversarial_rate,
+        "wall_seconds": round(wall, 3),
+        "throughput_rps": round(completed / wall, 3) if wall > 0 else None,
+        "goodput_rps": round(correct / wall, 3) if wall > 0 else None,
+        "p50_latency_seconds": lat.get("p50"),
+        "p99_latency_seconds": lat.get("p99"),
+        "max_latency_seconds": lat.get("max"),
+        "per_bucket": eng["per_bucket"],
+    }
+    if partial:
+        stats["partial"] = True
+    return stats
+
+
+def run_serve_bench(*, smoke: bool = False,
+                    bucket_sizes: Optional[Sequence[int]] = None,
+                    in_dtype: str = "float32",
+                    num_requests: Optional[int] = None,
+                    inject_rate: Optional[float] = None,
+                    adversarial_rate: Optional[float] = None,
+                    rate: Optional[float] = None,
+                    max_batch: int = 4, max_wait: float = 0.05,
+                    verify: Optional[bool] = None,
+                    timeline=None,
+                    should_stop: Optional[Callable[[], bool]] = None,
+                    progress_out=None) -> dict:
+    """The serve-bench core shared by ``bench.py --serve`` and
+    ``cli serve-bench``: build the bucket set, prewarm it (AOT compile,
+    recorded as compile spans), drive the load, and return the artifact
+    context dict — p50/p99 latency, throughput, goodput-under-injection,
+    retry/fault counters, bucket set, prewarm cost.
+
+    ``smoke`` selects the CI scenario (tiny buckets + :func:`smoke_spec`,
+    verification on). Explicit keyword args override either profile's
+    defaults.
+    """
+    sizes = tuple(bucket_sizes) if bucket_sizes else (
+        (128, 256) if smoke else (256, 512, 1024))
+    buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+    base = smoke_spec() if smoke else LoadSpec(
+        inject_rate=0.2, adversarial_rate=0.05, verify=False)
+    spec = dataclasses.replace(
+        base,
+        in_dtype=in_dtype,
+        num_requests=base.num_requests if num_requests is None
+        else int(num_requests),
+        inject_rate=base.inject_rate if inject_rate is None
+        else float(inject_rate),
+        adversarial_rate=base.adversarial_rate if adversarial_rate is None
+        else float(adversarial_rate),
+        rate=base.rate if rate is None else float(rate),
+        verify=base.verify if verify is None else bool(verify),
+    )
+    # Keep every shape routable inside the configured set.
+    largest = max(s for s in sizes)
+    shapes = tuple(s for s in spec.shapes if max(s) <= largest)
+    spec = dataclasses.replace(spec, shapes=shapes or ((largest // 2,) * 3,))
+
+    def progress(p):
+        if timeline is not None:
+            timeline.point("serve_progress", "load", **p)
+        if progress_out is not None:
+            print(f"serve-bench: {p}", file=progress_out, flush=True)
+
+    with ServeEngine(buckets, max_batch=max_batch, max_wait=max_wait,
+                     timeline=timeline) as engine:
+        t0 = time.monotonic()
+        prewarm = engine.prewarm()
+        progress({"prewarmed": prewarm["compiled"],
+                  "seconds": prewarm["seconds"]})
+        stats = run_load(engine, spec, should_stop=should_stop,
+                         progress=progress)
+        stats["prewarm"] = prewarm
+        stats["buckets"] = [b.key for b in buckets]
+        stats["smoke"] = bool(smoke)
+        stats["seconds_total"] = round(time.monotonic() - t0, 3)
+    return stats
+
+
+__all__ = ["LoadSpec", "run_load", "run_serve_bench", "smoke_spec"]

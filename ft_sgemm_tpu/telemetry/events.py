@@ -208,9 +208,14 @@ def registry_from_events(events: Iterable[FaultEvent]):
     registry exporter (``cli telemetry LOG --format=prom``). The series
     mirror what live recording would have produced: ``ft_calls`` /
     ``ft_detections`` / ``ft_corrected`` / ``ft_uncorrectable`` counters
-    labeled by op/strategy/layer, ``ft_step_events`` per outcome, and the
-    ``ft_residual`` histogram."""
-    from ft_sgemm_tpu.telemetry.registry import MetricsRegistry
+    labeled by op/strategy/layer, ``ft_step_events`` per outcome, the
+    ``ft_residual`` histogram, and — for serving-layer events whose
+    ``extra`` carries a ``latency_seconds`` observation — the
+    ``serve_latency_seconds`` histogram the engine records live, so one
+    request log exports the same p50/p99-bearing series the in-process
+    registry held (no parallel stats path)."""
+    from ft_sgemm_tpu.telemetry.registry import (
+        LATENCY_BUCKETS, MetricsRegistry)
 
     reg = MetricsRegistry()
     call_outcomes = ("clean", "corrected", "uncorrectable")
@@ -219,6 +224,16 @@ def registry_from_events(events: Iterable[FaultEvent]):
             reg.counter("ft_step_events", op=ev.op,
                         outcome=ev.outcome).inc()
             continue
+        lat = (ev.extra.get("latency_seconds")
+               if isinstance(ev.extra, dict) else None)
+        if isinstance(lat, (int, float)):
+            reg.histogram("serve_latency_seconds",
+                          buckets=LATENCY_BUCKETS).observe(lat)
+            bucket = ev.extra.get("bucket")
+            if bucket:
+                reg.histogram("serve_latency_seconds",
+                              buckets=LATENCY_BUCKETS,
+                              bucket=bucket).observe(lat)
         labels = {"op": ev.op}
         if ev.strategy:
             labels["strategy"] = ev.strategy

@@ -76,6 +76,15 @@ class Gauge:
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
     10.0 ** e for e in range(-6, 7)) + (float("inf"),)
 
+# Latency histogram buckets (seconds) for the serving layer: half-decades
+# from 10 µs to 100 s. Serve latencies span interpret-mode CPU smoke
+# (hundreds of ms) down to prewarmed TPU dispatch (sub-ms); half-decade
+# resolution keeps the Prometheus-style percentile estimates
+# (:func:`histogram_percentiles`) within ~3x of the true value — good
+# enough to gate an SLO on — while the series stays 16 buckets wide.
+LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    10.0 ** (e / 2.0) for e in range(-10, 5)) + (float("inf"),)
+
 
 class Histogram:
     """Fixed-bucket histogram (cumulative-style buckets, like Prometheus).
@@ -284,4 +293,5 @@ class MetricsRegistry:
 
 
 __all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
-           "MetricsRegistry", "histogram_percentiles", "to_prometheus"]
+           "LATENCY_BUCKETS", "MetricsRegistry", "histogram_percentiles",
+           "to_prometheus"]

@@ -58,6 +58,21 @@ _LOCK = threading.Lock()
 # key -> record mapping; an unreadable/invalid file memoizes as {} so the
 # load warning fires once per file state, not once per dispatch.
 _MEMO: dict = {}
+# path -> Lock serializing parse/store IO per cache file (single-flight:
+# under the serving layer's concurrent dispatch, N threads missing the
+# memo at once must produce ONE parse and ONE warning, not N — and a
+# store's read-merge-replace must never interleave with a concurrent
+# parse of the half-written state). _LOCK guards only the dicts, so a
+# slow parse on one path never blocks lookups on another.
+_PATH_LOCKS: dict = {}
+
+
+def _path_lock(path: str) -> threading.Lock:
+    with _LOCK:
+        lk = _PATH_LOCKS.get(path)
+        if lk is None:
+            lk = _PATH_LOCKS[path] = threading.Lock()
+        return lk
 
 
 def cache_path() -> str:
@@ -173,21 +188,41 @@ def _load_validated(path: str) -> dict:
 
 
 def load_entries(path: Optional[str] = None) -> dict:
-    """The validated entries of the cache file, memoized by stat signature."""
+    """The validated entries of the cache file, memoized by stat signature.
+
+    Thread-safe AND single-flight per path: the steady-state hit path is
+    one ``os.stat`` plus a memo probe under the cheap dict lock (no file
+    lock — serving-layer dispatch threads must never convoy on it), while
+    memo MISSES serialize on the per-path lock so N threads arriving at a
+    changed file produce ONE parse and ONE warning, not N racing parses.
+    """
     path = cache_path() if path is None else path
-    try:
-        st = os.stat(path)
-        sig = (st.st_mtime_ns, st.st_size)
-    except OSError:
-        sig = None  # absent: memoize the miss too (stat already said so)
+
+    def _sig():
+        try:
+            st = os.stat(path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None  # absent: memoize the miss (stat already said so)
+
+    sig = _sig()
     with _LOCK:
         hit = _MEMO.get(path)
         if hit is not None and hit[0] == sig:
             return hit[1]
-    entries = _load_validated(path) if sig is not None else {}
-    with _LOCK:
-        _MEMO[path] = (sig, entries)
-    return entries
+    with _path_lock(path):
+        # Re-stat and re-probe under the parse lock: the thread that won
+        # the race already memoized the state this thread was about to
+        # parse.
+        sig = _sig()
+        with _LOCK:
+            hit = _MEMO.get(path)
+            if hit is not None and hit[0] == sig:
+                return hit[1]
+        entries = _load_validated(path) if sig is not None else {}
+        with _LOCK:
+            _MEMO[path] = (sig, entries)
+        return entries
 
 
 def lookup(key: str, path: Optional[str] = None) -> Optional[dict]:
@@ -204,7 +239,11 @@ def store(key: str, record: dict, path: Optional[str] = None) -> str:
             f" multiples of 128), got {record.get('block')!r}")
     path = cache_path() if path is None else path
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with _LOCK:
+    # The per-path lock (shared with load_entries) makes read-merge-
+    # replace atomic against concurrent lookups AND concurrent stores;
+    # the global _LOCK only ever guards the memo dict now, so one path's
+    # file IO cannot stall every other path's dispatch lookups.
+    with _path_lock(path):
         entries = dict(_load_validated(path))
         entries[key] = record
         doc = {"schema": SCHEMA_VERSION, "entries": entries}
@@ -220,7 +259,8 @@ def store(key: str, record: dict, path: Optional[str] = None) -> str:
                 os.unlink(tmp)
             except OSError:
                 pass
-        _MEMO.pop(path, None)
+        with _LOCK:
+            _MEMO.pop(path, None)
     return path
 
 

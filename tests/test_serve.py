@@ -1,0 +1,490 @@
+"""Serving-layer tests: bucketing edge cases, continuous-batching
+dispatch, the SLO-aware retry contract (corrected SDC = zero retries;
+uncorrectable = bucket-scoped retry only), warm-path purity (zero compile
+spans in steady state, pinned through perf/wallclock attribution), the
+telemetry-histogram latency percentiles, and the concurrency-safety of
+the tuner/compile caches under threaded dispatch (ISSUE 8)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu.serve import (
+    Bucket,
+    BucketOverflowError,
+    ServeEngine,
+    ServeRequest,
+    default_bucket_set,
+    select_bucket,
+)
+from ft_sgemm_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    histogram_percentiles,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Bucketing edge cases (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_boundary_exact_routes_to_own_bucket():
+    buckets = default_bucket_set((256, 512))
+    b = select_bucket(buckets, 256, 256, 256)
+    assert (b.m, b.n, b.k) == (256, 256, 256)
+
+
+def test_bucket_smallest_fit_wins():
+    buckets = default_bucket_set((256, 512, 1024))
+    assert select_bucket(buckets, 200, 180, 257).k == 512
+    assert select_bucket(buckets, 100, 100, 100).m == 256
+
+
+def test_bucket_overflow_is_named_error():
+    buckets = default_bucket_set((256,))
+    with pytest.raises(BucketOverflowError) as ei:
+        select_bucket(buckets, 257, 100, 100)
+    msg = str(ei.value)
+    assert "257x100x100" in msg and "256x256x256" in msg
+    # It is also a ValueError, so generic callers degrade sanely.
+    assert isinstance(ei.value, ValueError)
+
+
+def test_bucket_dims_must_be_mxu_granules():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        Bucket(100, 128, 128)
+    with pytest.raises(ValueError, match="powers of two"):
+        default_bucket_set((384,))
+
+
+def test_int8_buckets_route_to_rowcol():
+    """PR-7 legality: int8 ships only the exact strategies, so the
+    default int8 bucket set is rowcol and a ratio-localizing int8 bucket
+    is rejected with the kernel factory's own error."""
+    buckets = default_bucket_set((256,), in_dtype="int8")
+    assert all(b.strategy == "rowcol" for b in buckets)
+    with pytest.raises(ValueError, match="int8"):
+        Bucket(256, 256, 256, in_dtype="int8", strategy="weighted")
+    b = select_bucket(buckets, 100, 100, 100, in_dtype="int8")
+    assert b.in_dtype == "int8" and b.strategy == "rowcol"
+
+
+def test_dtype_mismatch_has_no_bucket():
+    buckets = default_bucket_set((256,), in_dtype="float32")
+    with pytest.raises(BucketOverflowError, match="none configured"):
+        select_bucket(buckets, 128, 128, 128, in_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# Engine: continuous batching + retry contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    """One prewarmed two-bucket engine shared by the dispatch tests —
+    prewarm compiles 3 variants x 2 buckets once for the module, and its
+    streamed timeline is what the warm-path test reads afterwards."""
+    tl_path = str(tmp_path_factory.mktemp("serve") / "serve.timeline.jsonl")
+    eng = ServeEngine(default_bucket_set((128, 256)),
+                      max_batch=3, max_wait=0.05, retry_backoff=0.001,
+                      timeline=tl_path)
+    eng.start()
+    eng.prewarm()
+    yield eng
+    eng.close()
+
+
+def _request(rng, m, n, k, variant="clean"):
+    return ServeRequest(
+        a=rng.standard_normal((m, k)).astype(np.float32),
+        b=rng.standard_normal((n, k)).astype(np.float32),
+        variant=variant)
+
+
+def test_empty_queue_drain_returns_immediately(engine):
+    t0 = time.monotonic()
+    engine.drain(timeout=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_max_wait_flush_fires_before_batch_full(engine, rng):
+    """A single request (batch of 1 of max 3) must flush on the max-wait
+    deadline, not wait for batchmates that never come."""
+    fut = engine.submit(_request(rng, 100, 110, 90))
+    res = fut.result(timeout=60.0)
+    assert res.ok and res.retries == 0
+    assert res.c.shape == (100, 110)
+
+
+def test_batch_full_flushes_before_max_wait(rng):
+    """max_batch requests flush immediately even under an enormous
+    max-wait — continuous batching, not fixed-window batching."""
+    eng = ServeEngine(default_bucket_set((128,)), max_batch=2,
+                      max_wait=60.0)
+    eng.start()
+    try:
+        t0 = time.monotonic()
+        futs = [eng.submit(_request(rng, 64, 64, 64)) for _ in range(2)]
+        for f in futs:
+            assert f.result(timeout=120.0).ok
+        assert time.monotonic() - t0 < 50.0  # nowhere near max_wait
+    finally:
+        eng.close()
+
+
+def test_result_is_correct_and_sliced(engine, rng):
+    req = _request(rng, 120, 70, 130)
+    res = engine.submit(req).result(timeout=60.0)
+    want = req.a @ req.b.T
+    assert res.c.shape == want.shape
+    np.testing.assert_allclose(res.c, want, rtol=1e-4, atol=1e-3)
+
+
+def test_corrected_sdc_is_free(engine, rng):
+    """THE acceptance pin: a detected-and-corrected SDC completes with
+    ZERO retries and a numerically correct result."""
+    before = engine.stats()
+    req = _request(rng, 200, 180, 160, variant="inject")
+    res = engine.submit(req).result(timeout=60.0)
+    assert res.detections > 0
+    assert res.uncorrectable == 0
+    assert res.corrected and res.ok
+    assert res.retries == 0
+    want = req.a @ req.b.T
+    np.testing.assert_allclose(res.c, want, rtol=1e-4, atol=1e-3)
+    after = engine.stats()
+    assert after["corrected_free"] == before["corrected_free"] + 1
+    assert after["retries"] == before["retries"]
+    assert after["whole_queue_retries"] == 0
+
+
+def test_uncorrectable_retries_only_affected_bucket(engine, rng):
+    """THE other acceptance pin: an uncorrectable fault retries only the
+    affected bucket's request — the other bucket's traffic (and the
+    queue as a whole) never re-executes."""
+    before = engine.stats()
+    bad = engine.submit(_request(rng, 200, 200, 200,
+                                 variant="adversarial"))
+    clean = [engine.submit(_request(rng, 64, 64, 64)) for _ in range(3)]
+    res = bad.result(timeout=120.0)
+    assert res.retries >= 1          # the fault cost a bucket retry
+    assert res.ok                    # ...and the retry (clean) succeeded
+    for f in clean:
+        r = f.result(timeout=60.0)
+        assert r.ok and r.retries == 0
+    after = engine.stats()
+    big, small = "256x256x256|float32|weighted", "128x128x128|float32|weighted"
+    assert (after["per_bucket"][big]["retries"]
+            > before["per_bucket"][big]["retries"])
+    assert (after["per_bucket"][small]["retries"]
+            == before["per_bucket"][small]["retries"])
+    assert after["whole_queue_retries"] == 0
+
+
+def test_per_request_attribution_and_prom_export(engine, rng, tmp_path):
+    """Each request's own counter grids feed its fault event (request id,
+    bucket, tile blame), and the event log exports the latency histogram
+    through `cli telemetry --format=prom` — the registry machinery is
+    the only percentile implementation."""
+    import io
+
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.cli import run_telemetry_summary
+    from ft_sgemm_tpu.telemetry import read_events, registry_from_events
+    from ft_sgemm_tpu.telemetry.registry import to_prometheus
+
+    log = tmp_path / "serve_events.jsonl"
+    telemetry.configure(log, log_clean=True)
+    try:
+        reqs = [_request(rng, 150, 150, 150, variant="inject"),
+                _request(rng, 64, 64, 64, variant="clean")]
+        for res in [engine.submit(r).result(timeout=60.0) for r in reqs]:
+            assert res.ok
+    finally:
+        telemetry.disable()
+    events = [e for e in read_events(log) if e.op == "serve_gemm"]
+    assert len(events) == 2
+    by_id = {e.extra["request_id"]: e for e in events}
+    inj_ev = by_id[reqs[0].request_id]
+    assert inj_ev.outcome == "corrected"
+    assert inj_ev.tiles, "per-request tile blame missing"
+    assert inj_ev.extra["bucket"] == "256x256x256|float32|weighted"
+    assert inj_ev.layer == inj_ev.extra["bucket"]
+    assert inj_ev.extra["latency_seconds"] > 0
+    assert by_id[reqs[1].request_id].outcome == "clean"
+    # Rebuilt registry carries the serve latency histogram...
+    reg = registry_from_events(read_events(log))
+    prom = to_prometheus(reg.collect())
+    assert "serve_latency_seconds_bucket" in prom
+    assert 'op="serve_gemm"' in prom
+    # ...and the CLI's prom exporter is the same path.
+    buf = io.StringIO()
+    assert run_telemetry_summary(str(log), out=buf, fmt="prom") == 0
+    assert "serve_latency_seconds_bucket" in buf.getvalue()
+
+
+def test_int8_requests_run_exact(rng):
+    """int8 requests route to the rowcol bucket and come back EXACT
+    (int32 accumulation): the serving path for production quant dtypes."""
+    eng = ServeEngine(default_bucket_set((128,), in_dtype="int8"),
+                      max_batch=2, max_wait=0.02)
+    eng.start()
+    try:
+        a = np.round(rng.standard_normal((100, 90)) * 3).astype(np.float32)
+        b = np.round(rng.standard_normal((80, 90)) * 3).astype(np.float32)
+        res = eng.submit(ServeRequest(a=a, b=b, in_dtype="int8")
+                         ).result(timeout=120.0)
+        assert res.ok
+        np.testing.assert_array_equal(res.c, a @ b.T)
+    finally:
+        eng.close()
+
+
+def test_overflow_submit_counts_rejection(engine, rng):
+    before = engine.stats()["rejected"]
+    with pytest.raises(BucketOverflowError):
+        engine.submit(_request(rng, 300, 100, 100))
+    assert engine.stats()["rejected"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Warm-path purity: zero compile spans in steady state
+# ---------------------------------------------------------------------------
+
+
+def test_prewarmed_steady_state_records_zero_compile_spans(engine):
+    """Acceptance pin: every compile span in the engine's timeline
+    precedes the prewarm_done point; the steady-state window attributes
+    ZERO wall to the compile phase (perf/wallclock)."""
+    from ft_sgemm_tpu.perf import wallclock
+    from ft_sgemm_tpu.telemetry import timeline as tl_mod
+
+    engine.drain(timeout=30.0)
+    records = tl_mod.read_timeline(engine._tl.path)
+    done = [r for r in records if r.get("name") == "prewarm_done"]
+    assert done, "prewarm_done point missing from timeline"
+    t_done = done[0]["t"]
+    pre = [r for r in records if r["t"] <= t_done]
+    post = [r for r in records if r["t"] > t_done]
+    assert any(r.get("kind") == "compile" for r in pre), \
+        "prewarm compiles must be recorded"
+    assert not any(r.get("kind") == "compile" for r in post), \
+        "steady-state serve dispatched a compile"
+    # Served batches exist after prewarm, and the phase attribution of
+    # the steady-state window books zero compile wall.
+    summary = tl_mod.summarize_timeline(post)
+    assert any(s["kind"] == "stage" and s["name"].startswith("serve[")
+               for s in summary["spans"])
+    wall = wallclock.attribute_wall(summary)
+    assert wall["seconds"]["compile"] == 0.0
+    assert wall["fractions"]["compile"] == 0.0
+
+
+def test_unprewarmed_compile_is_recorded_honestly(rng, tmp_path):
+    """Without prewarm, the first dispatch's compile lands as a compile
+    span — the timeline never claims a warm path it didn't have."""
+    from ft_sgemm_tpu.telemetry import timeline as tl_mod
+
+    tl_path = str(tmp_path / "cold.timeline.jsonl")
+    eng = ServeEngine(default_bucket_set((128,)), max_batch=1,
+                      max_wait=0.01, timeline=tl_path)
+    eng.start()
+    try:
+        assert eng.submit(_request(rng, 64, 64, 64)).result(120.0).ok
+    finally:
+        eng.close()
+    records = tl_mod.read_timeline(tl_path)
+    assert any(r.get("kind") == "compile"
+               and r["name"].startswith("compile[") for r in records)
+
+
+# ---------------------------------------------------------------------------
+# Latency percentiles: the telemetry histogram machinery IS the stats
+# ---------------------------------------------------------------------------
+
+
+def test_latency_percentiles_pinned_on_synthetic_distribution():
+    """p50/p99 against a known distribution: 10 obs in the ~2ms
+    half-decade, 10 in the ~20ms one, 1 at 50s. Estimates resolve to
+    bucket upper bounds (the documented Prometheus-style contract)."""
+    reg = MetricsRegistry()
+    hist = reg.histogram("serve_latency_seconds", buckets=LATENCY_BUCKETS)
+    for _ in range(10):
+        hist.observe(0.002)
+    for _ in range(10):
+        hist.observe(0.02)
+    hist.observe(50.0)
+    pct = histogram_percentiles(hist.value, quantiles=(0.5, 0.99))
+    # 21 obs: p50 needs 10.5 -> second populated bucket (ub 10^-1.5);
+    # p99 needs 20.79 -> the 50s outlier's bucket (ub 100).
+    assert pct["p50"] == pytest.approx(10.0 ** -1.5)
+    assert pct["p99"] == pytest.approx(100.0)
+    assert pct["max"] == pytest.approx(100.0)
+
+
+def test_engine_latency_percentiles_live(engine):
+    pct = engine.latency_percentiles()
+    assert pct["p50"] is not None and pct["p99"] is not None
+    assert pct["p50"] <= pct["p99"]
+
+
+# ---------------------------------------------------------------------------
+# Cache thread-safety under concurrent dispatch (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_tuner_cache_threaded_lookups_and_stores(tmp_path, monkeypatch):
+    """8 reader threads hammer lookup_tile while a writer stores fresh
+    winners: no exceptions, every read is either a miss or a valid
+    cached tile, and the final state serves the last store."""
+    from ft_sgemm_tpu import tuner
+    from ft_sgemm_tpu.tuner import cache
+
+    path = str(tmp_path / "tuner_cache.json")
+    monkeypatch.setenv("FT_SGEMM_TUNER_CACHE", path)
+    cache.clear_memo()
+    key = tuner.make_key(512, 512, 512, strategy="weighted",
+                         in_dtype="float32", injection_enabled=False)
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(300):
+                tile = tuner.lookup_tile(512, 512, 512,
+                                         strategy="weighted",
+                                         in_dtype="float32",
+                                         injection_enabled=False)
+                assert tile is None or tile.block[0] % 128 == 0
+        except Exception as e:  # noqa: BLE001 — the test's whole point
+            errors.append(e)
+
+    def writer():
+        try:
+            for i in range(10):
+                cache.store(key, {"block": [128 * (1 + i % 4), 128, 128]},
+                            path)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=reader) for _ in range(8)]
+               + [threading.Thread(target=writer)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    tile = tuner.lookup_tile(512, 512, 512, strategy="weighted",
+                             in_dtype="float32", injection_enabled=False)
+    assert tile is not None and tile.block == (128 * (1 + 9 % 4), 128, 128)
+    cache.clear_memo()
+
+
+def test_compile_cache_enable_threaded(tmp_path, monkeypatch):
+    """Concurrent enable() calls (the serving layer's dispatch vs a
+    prewarm) serialize on the enable lock: every caller sees a
+    consistent enabled status pointing at the same directory."""
+    from ft_sgemm_tpu.perf import compile_cache
+
+    cache_dir = str(tmp_path / "jaxcache")
+    monkeypatch.setenv("FT_SGEMM_COMPILE_CACHE", cache_dir)
+    results = []
+    errors = []
+
+    def worker():
+        try:
+            results.append(compile_cache.enable())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors, errors
+        assert len(results) == 8
+        assert all(r["enabled"] for r in results), results
+        assert all(r["path"] == cache_dir for r in results)
+    finally:
+        compile_cache.disable()
+        compile_cache._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# bench.py --serve --smoke + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cli_serve_dry_run(capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "serve", "--dry-run", "--buckets=256,512"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "256x256x256|float32|weighted" in out
+    assert "512x512x512|float32|weighted" in out
+    assert "tuner-key" in out
+    assert "dry run: nothing compiled" in out
+
+
+def test_headline_prewarm_plan_matches_ladder():
+    """ISSUE 8 satellite: the worker's automatic prewarm compiles the
+    headline ladder's exact recipe set, in ladder order."""
+    import bench
+
+    plan = bench._headline_prewarm_plan(4096, 512)
+    labels = [label for label, _ in plan]
+    assert labels == ["weighted", "weighted_inkernel", "rowcol"]
+    assert plan[1][1] == {"strategy": "weighted", "check_every": 4}
+    # Shallow K: the in-kernel rung drops, ladder order survives.
+    assert [l for l, _ in bench._headline_prewarm_plan(512, 512)] == [
+        "weighted", "rowcol"]
+
+
+def test_bench_serve_smoke_emits_goodput_artifact(tmp_path):
+    """Acceptance: `bench.py --serve --smoke` on CPU emits ONE non-null
+    JSON line with p50/p99 latency, throughput, and goodput-under-
+    injection; zero whole-queue retries; every completed request correct
+    (corrected SDCs free, uncorrectable ones recovered by bucket-scoped
+    retry); zero steady-state compile spans."""
+    tl_path = str(tmp_path / "serve.timeline.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               FT_SGEMM_BENCH_TIMELINE=tl_path,
+               FT_SGEMM_TUNER_CACHE=str(tmp_path / "tuner_cache.json"),
+               FT_SGEMM_COMPILE_CACHE="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    art = json.loads(line)
+    assert art["metric"] == "serve_goodput_rps"
+    assert art["value"] is not None and art["value"] > 0
+    ctx = art["context"]
+    assert ctx["p50_latency_seconds"] is not None
+    assert ctx["p99_latency_seconds"] is not None
+    assert ctx["throughput_rps"] > 0
+    assert ctx["goodput_rps"] > 0
+    assert ctx["whole_queue_retries"] == 0
+    assert ctx["uncorrectable_final"] == 0
+    assert ctx["correct"] == ctx["completed"] > 0
+    assert ctx["verified"] is True
+    assert ctx["steady_state_compile_spans"] == 0
+    assert ctx["smoke"] is True and ctx["serve"] is True
+    # The injection actually happened (goodput-UNDER-INJECTION).
+    assert ctx["variants"].get("inject", 0) + ctx["variants"].get(
+        "adversarial", 0) > 0
+    assert os.path.exists(tl_path)
