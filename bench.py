@@ -2133,7 +2133,12 @@ def serve_main(argv):
     per-batch spans and running ``serve_progress`` points for anything
     harder-killed than that. Flags: ``--smoke`` (the CPU/CI scenario),
     ``--requests=N``, ``--inject-rate=R``, ``--adversarial-rate=R``,
-    ``--rate=RPS``, ``--buckets=256,512``.
+    ``--rate=RPS``, ``--buckets=256,512``, ``--monitor-port=N`` (start
+    the live /metrics-/healthz-/events exporter for the run — 0 binds an
+    ephemeral port, URL streamed to stderr; ``cli top URL`` renders it).
+    The artifact context embeds the final SLO/error-budget and
+    device-health snapshot (``context.slo`` / ``context.device_health``)
+    plus a RunReport whose SLO section ``cli report`` renders.
     """
     smoke = "--smoke" in argv
     kw = {}
@@ -2151,6 +2156,8 @@ def serve_main(argv):
             elif f.startswith("--buckets="):
                 kw["bucket_sizes"] = tuple(
                     int(v) for v in f.split("=", 1)[1].split(",") if v)
+            elif f.startswith("--monitor-port="):
+                kw["monitor_port"] = int(f.split("=", 1)[1])
         except ValueError as e:
             bad = f"{f}: {e}"
     if bad:
@@ -2220,6 +2227,17 @@ def serve_main(argv):
     cc_stats = _compile_cache_stats()
     if cc_stats is not None:
         context["compile_cache"] = cc_stats
+    try:
+        # The serve artifact carries a RunReport too, so `cli report`
+        # renders the run's environment + the final SLO/health section
+        # (ISSUE 9: the artifact embeds the SLO/budget snapshot).
+        from ft_sgemm_tpu.perf.report import RunReport, build_manifest
+
+        context["run_report"] = RunReport(
+            manifest=build_manifest(extra={"serve": True}),
+            stages=[], slo=context.get("slo")).to_dict()
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        context["errors"]["run_report"] = f"{type(e).__name__}: {e}"
     print(json.dumps({"metric": "serve_goodput_rps",
                       "value": value,
                       "unit": "requests/s", "vs_baseline": None,

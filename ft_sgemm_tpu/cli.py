@@ -33,7 +33,10 @@ Usage:
     python -m ft_sgemm_tpu.cli roc [--smoke] [--out=ROC.json] \
         [--margin=8.0]
     python -m ft_sgemm_tpu.cli telemetry LOG.jsonl \
-        [--format=text|prom] [--by-device]
+        [--format=text|prom] [--by-device] \
+        [--watch] [--watch-seconds=S] [--interval=S]
+    python -m ft_sgemm_tpu.cli top URL[:PORT] \
+        [--interval=S] [--iterations=N] [--once]
     python -m ft_sgemm_tpu.cli attribute LOG.jsonl [LOG2.jsonl ...]
     python -m ft_sgemm_tpu.cli timeline RUN.timeline.jsonl \
         [--format=text|json] [--phases]
@@ -49,9 +52,11 @@ Usage:
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
     python -m ft_sgemm_tpu.cli serve [--buckets=256,512] [--dtype=...] \
-        [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] [--dry-run]
+        [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] \
+        [--monitor-port=N] [--dry-run]
     python -m ft_sgemm_tpu.cli serve-bench [--smoke] [--buckets=...] \
-        [--requests=N] [--inject-rate=R] [--rate=RPS] [--out=ARTIFACT.json]
+        [--requests=N] [--inject-rate=R] [--rate=RPS] \
+        [--monitor-port=N] [--out=ARTIFACT.json]
 
 ``report`` renders the RunReport a bench artifact embeds
 (``ft_sgemm_tpu.perf``): the environment manifest (device, jax/jaxlib,
@@ -169,6 +174,19 @@ compile-cache location without touching the backend (the CI smoke).
 ``serve-bench`` runs the load-generator goodput bench and prints the
 same JSON artifact line as ``python bench.py --serve``: p50/p99 latency,
 throughput, and goodput-under-injection (correct results per second).
+
+Live monitoring (``ft_sgemm_tpu.telemetry.monitor``, DESIGN.md §12):
+``--monitor-port=N`` on ``serve`` / ``serve-bench`` starts the stdlib
+HTTP exporter for the run's duration — ``/metrics`` (Prometheus text:
+serve histograms, ``slo_budget_remaining`` / ``slo_burn_rate``,
+``device_health{device=...}``), ``/healthz`` (OK / DEGRADED / FAILING
+with named reasons), ``/events?since=`` (recent fault events with
+request trace IDs). Port 0 binds an ephemeral port (the resolved URL
+streams to stderr). ``top URL`` is the live terminal view over those
+endpoints: SLO budget, per-bucket latency/goodput, the device-health
+column, and the recent-event tail, refreshed until Ctrl-C.
+``telemetry LOG --watch`` follows a GROWING shard instead (incremental
+tail + re-summarize) when only the JSONL plane is available.
 """
 
 from __future__ import annotations
@@ -1020,6 +1038,8 @@ def _parse_serve_flags(flags):
                 kw["rate"] = float(f.split("=", 1)[1])
             elif f.startswith("--dtype="):
                 kw["in_dtype"] = canonical_in_dtype(f.split("=", 1)[1])
+            elif f.startswith("--monitor-port="):
+                kw["monitor_port"] = int(f.split("=", 1)[1])
         except ValueError as e:
             return None, f"{f}: {e}"
     return kw, None
@@ -1104,6 +1124,11 @@ def run_serve(flags, out=None) -> int:
           f"{stats['bucket_retries']}   whole-queue retries: "
           f"{stats['whole_queue_retries']}   uncorrectable after retries: "
           f"{stats['uncorrectable_final']}", file=out)
+    slo = stats.get("slo")
+    if slo:
+        print(f"  slo: {slo['status']}  budget remaining "
+              f"{slo['budget_remaining']}  burn {slo['burn_rate']}x  "
+              f"device health min {slo['device_health_min']}", file=out)
     for key, row in sorted(stats["per_bucket"].items()):
         print(f"    {key:<36s} requests={row['requests']:<4d} "
               f"batches={row['batches']:<3d} retries={row['retries']}",
@@ -1153,6 +1178,186 @@ def run_serve_bench_cmd(flags, out=None) -> int:
     return 0 if ok else 1
 
 
+def run_telemetry_watch(log_path: str, out=None, interval: float = 0.5,
+                        max_seconds=None) -> int:
+    """``telemetry --watch``: follow a GROWING fault-event shard.
+
+    Tails the JSONL file byte-incrementally (only appended bytes are
+    read and parsed — the shard may grow without bound), re-summarizes
+    on every batch of new events, and reprints the summary, so an
+    in-flight run is inspectable without the HTTP monitoring plane.
+    Torn tails are left unconsumed until the writer completes the line
+    (the JsonlSink flushes per event, so a torn line is always the one
+    in flight). The file not existing yet is fine — the watch waits for
+    it. Stdlib-only by the timeline discipline: following a log must
+    never need a backend. Stops on Ctrl-C (exit 0) or after
+    ``max_seconds`` (the bounded form tests and scripts use)."""
+    from ft_sgemm_tpu.telemetry import format_summary, summarize_events
+    from ft_sgemm_tpu.telemetry.events import parse_event_line
+
+    out = sys.stdout if out is None else out
+    events = []
+    offset = 0
+    rendered_count = -1
+    t0 = time.monotonic()
+    try:
+        while True:
+            if os.path.exists(log_path):
+                try:
+                    with open(log_path, "rb") as fh:
+                        fh.seek(offset)
+                        chunk = fh.read()
+                except OSError as e:
+                    print(f"ft_sgemm: cannot read telemetry log: {e}",
+                          file=sys.stderr)
+                    return 2
+                # Only consume through the last complete line; a torn
+                # tail stays unread until its newline lands.
+                end = chunk.rfind(b"\n")
+                if end >= 0:
+                    for raw in chunk[:end + 1].splitlines():
+                        ev = parse_event_line(
+                            raw.decode("utf-8", errors="replace"))
+                        if ev is not None:
+                            events.append(ev)
+                    offset += end + 1
+            if len(events) != rendered_count:
+                rendered_count = len(events)
+                print(f"--- telemetry watch of {log_path} "
+                      f"({rendered_count} events) ---", file=out)
+                print(format_summary(summarize_events(events)), file=out,
+                      flush=True)
+            if max_seconds is not None and \
+                    time.monotonic() - t0 >= max_seconds:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print(f"watch stopped ({len(events)} events seen)", file=out)
+        return 0
+
+
+def _http_get(url: str, timeout: float = 5.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", errors="replace")
+
+
+def _render_top(url: str, out, since: int, poll: int) -> int:
+    """One ``cli top`` frame: scrape /healthz + /metrics + /events and
+    render the live serving view. Returns the advanced event cursor."""
+    import json as _json
+
+    from ft_sgemm_tpu.telemetry.registry import (
+        histogram_percentiles, parse_prometheus)
+
+    health = _json.loads(_http_get(url + "/healthz"))
+    series = parse_prometheus(_http_get(url + "/metrics"))
+    ev = _json.loads(_http_get(f"{url}/events?since={since}&limit=8"))
+
+    def find(name, **labels):
+        for s in series:
+            if s["name"] == name and all(
+                    s["labels"].get(k) == v for k, v in labels.items()):
+                yield s
+
+    def value(name, default=None, **labels):
+        for s in find(name, **labels):
+            return s["value"]
+        return default
+
+    print(f"ft-sgemm top — {url}  (poll #{poll}, Ctrl-C to stop)",
+          file=out)
+    print(f"health: {health['status']}"
+          + ("  [" + "; ".join(health["reasons"]) + "]"
+             if health.get("reasons") else ""), file=out)
+    print(f"slo: budget remaining {value('slo_budget_remaining', '-')}"
+          f"  burn {value('slo_burn_rate', '-')}x"
+          f"  window requests {value('slo_window_requests', '-')}"
+          f"  goodput {value('slo_goodput_ratio', '-')}", file=out)
+    buckets = sorted({s["labels"]["bucket"]
+                      for s in find("serve_requests")
+                      if "bucket" in s["labels"]})
+    if buckets:
+        print(f"  {'bucket':<36s} {'reqs':>6s} {'retries':>7s} "
+              f"{'p50':>10s} {'p99':>10s}", file=out)
+        for b in buckets:
+            hist = value("serve_latency_seconds", bucket=b)
+            pct = (histogram_percentiles(hist, quantiles=(0.5, 0.99))
+                   if isinstance(hist, dict) else {})
+
+            def fmt(v):
+                return f"{v:.4g}s" if isinstance(v, (int, float)) else "-"
+
+            print(f"  {b:<36s} {value('serve_requests', 0, bucket=b):>6} "
+                  f"{value('serve_retries', 0, bucket=b):>7} "
+                  f"{fmt(pct.get('p50')):>10s} {fmt(pct.get('p99')):>10s}",
+                  file=out)
+    dh = sorted(find("device_health"),
+                key=lambda s: s["value"])
+    if dh:
+        print("device health:", file=out)
+        for s in dh:
+            drift = value("device_health_drift", 0.0,
+                          **{k: v for k, v in s["labels"].items()})
+            flag = ("  !!" if s["value"] < 0.9 else "")
+            print(f"  {s['labels'].get('device', '?'):<28s} "
+                  f"{s['value']:.3f}"
+                  + (f"  drift z={drift:.1f}" if drift else "") + flag,
+                  file=out)
+    if ev.get("events"):
+        print("recent events:", file=out)
+        for e in ev["events"]:
+            extra = e.get("extra") or {}
+            bits = [e.get("outcome", "?"), e.get("op", "?")]
+            if extra.get("trace_id"):
+                bits.append(f"trace={extra['trace_id']}")
+            if extra.get("bucket"):
+                bits.append(f"bucket={extra['bucket']}")
+            if e.get("tiles"):
+                bits.append(f"tiles={e['tiles']}")
+            if extra.get("kind"):
+                bits.append(f"kind={extra['kind']}")
+            print("  " + "  ".join(str(b) for b in bits), file=out)
+    return ev.get("next", since)
+
+
+def run_top(url: str, out=None, interval: float = 2.0,
+            iterations=None) -> int:
+    """``top`` subcommand: the live terminal view of a serving process.
+
+    Polls a monitor exporter's ``/metrics`` + ``/healthz`` + ``/events``
+    (started with ``serve --monitor-port=N`` / ``bench.py --serve
+    --monitor-port=N``) and renders per-bucket request/latency rows, the
+    SLO budget, the device-health column, and the recent-event tail.
+    ``--once`` (or ``--iterations=N``) bounds the loop for scripts/CI;
+    unbounded mode refreshes every ``--interval`` seconds until Ctrl-C
+    (rendered as a clean kill point, exit 0). Exit 2 when the exporter
+    is unreachable."""
+    out = sys.stdout if out is None else out
+    url = url.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    since = 0
+    poll = 0
+    try:
+        while True:
+            poll += 1
+            try:
+                since = _render_top(url, out, since, poll)
+            except (OSError, ValueError) as e:
+                print(f"ft_sgemm: top: cannot scrape {url}: {e}",
+                      file=sys.stderr)
+                return 2
+            if iterations is not None and poll >= iterations:
+                return 0
+            print("", file=out, flush=True)
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print("top: stopped", file=out)
+        return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
@@ -1169,11 +1374,37 @@ def main(argv=None) -> int:
         return run_serve(flags)
     if args and args[0] == "serve-bench":
         return run_serve_bench_cmd(flags)
+    if args and args[0] == "top":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        interval = 2.0
+        iterations = None
+        for f in flags:
+            if f.startswith("--interval="):
+                try:
+                    interval = float(f.split("=", 1)[1])
+                except ValueError:
+                    print(f"--interval must be a float, got {f!r}",
+                          file=sys.stderr)
+                    return 2
+            elif f.startswith("--iterations="):
+                try:
+                    iterations = int(f.split("=", 1)[1])
+                except ValueError:
+                    print(f"--iterations must be an int, got {f!r}",
+                          file=sys.stderr)
+                    return 2
+        if "--once" in flags:
+            iterations = 1
+        return run_top(args[1], interval=interval, iterations=iterations)
     if args and args[0] == "telemetry":
         if len(args) < 2:
             print(__doc__)
             return 2
         fmt = "text"
+        watch_seconds = None
+        interval = 0.5
         for f in flags:
             if f.startswith("--format="):
                 fmt = f.split("=", 1)[1]
@@ -1181,6 +1412,23 @@ def main(argv=None) -> int:
                     print(f"--format must be text or prom, got {fmt!r}",
                           file=sys.stderr)
                     return 2
+            elif f.startswith("--watch-seconds="):
+                try:
+                    watch_seconds = float(f.split("=", 1)[1])
+                except ValueError:
+                    print(f"--watch-seconds must be a float, got {f!r}",
+                          file=sys.stderr)
+                    return 2
+            elif f.startswith("--interval="):
+                try:
+                    interval = float(f.split("=", 1)[1])
+                except ValueError:
+                    print(f"--interval must be a float, got {f!r}",
+                          file=sys.stderr)
+                    return 2
+        if "--watch" in flags or watch_seconds is not None:
+            return run_telemetry_watch(args[1], interval=interval,
+                                       max_seconds=watch_seconds)
         return run_telemetry_summary(args[1], fmt=fmt,
                                      by_device="--by-device" in flags)
     if args and args[0] == "attribute":

@@ -183,7 +183,10 @@ class RunReport:
     the phase rollup of that same timeline
     (:func:`ft_sgemm_tpu.perf.wallclock.attribute_wall`): the
     import/backend_init/compile/tune/transfer/execute/other fractions
-    the "Wall attribution" section renders.
+    the "Wall attribution" section renders. ``slo`` is a serving run's
+    final SLO/error-budget + device-health snapshot
+    (:meth:`ft_sgemm_tpu.telemetry.monitor.Monitor.snapshot`) — the
+    "SLO" markdown section.
     """
 
     manifest: dict
@@ -191,6 +194,7 @@ class RunReport:
     schema: int = SCHEMA_VERSION
     timeline: Optional[dict] = None
     wall: Optional[dict] = None
+    slo: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = {"schema": self.schema, "manifest": self.manifest,
@@ -199,6 +203,8 @@ class RunReport:
             d["timeline"] = self.timeline
         if self.wall is not None:
             d["wall"] = self.wall
+        if self.slo is not None:
+            d["slo"] = self.slo
         return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -212,7 +218,8 @@ class RunReport:
                          stages=list(d.get("stages") or []),
                          schema=int(d.get("schema", SCHEMA_VERSION)),
                          timeline=d.get("timeline"),
-                         wall=d.get("wall"))
+                         wall=d.get("wall"),
+                         slo=d.get("slo"))
 
     @staticmethod
     def from_json(text: str) -> "RunReport":
@@ -274,6 +281,32 @@ class RunReport:
                           "`AI` is arithmetic intensity, `ABFT overhead` "
                           "the checksum encode+check share of the "
                           "stage's FLOPs.")
+        slo = self.slo
+        if slo:
+            md += ["", "## SLO", ""]
+            md.append(f"- **status**: {slo.get('status', '—')}"
+                      + (" (" + "; ".join(slo["reasons"]) + ")"
+                         if slo.get("reasons") else ""))
+            for key, label in (
+                    ("budget_remaining", "error budget remaining"),
+                    ("burn_rate", "burn rate"),
+                    ("goodput_ratio", "goodput ratio"),
+                    ("observed_p99_seconds", "observed p99 (s)"),
+                    ("window_requests", "window requests"),
+                    ("violations", "violations"),
+                    ("device_health_min", "device health min")):
+                v = slo.get(key)
+                if v is not None:
+                    md.append(f"- **{label}**: {v}")
+            obj = slo.get("objectives") or {}
+            if obj:
+                md.append("- **objectives**: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(obj.items())))
+            dh = slo.get("device_health") or {}
+            if dh:
+                md += ["", "| device | health |", "|---|---|"]
+                for dev in sorted(dh, key=lambda d: dh[d]):
+                    md.append(f"| {dev} | {dh[dev]:.3f} |")
         wa = self.wall
         if wa and wa.get("fractions"):
             md += ["", "## Wall attribution", ""]

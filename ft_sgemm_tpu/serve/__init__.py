@@ -24,9 +24,15 @@ a detected-and-corrected SDC costs the serving path nothing):
   ``cli serve-bench``): configurable arrival process with SDC injection,
   reporting p50/p99 latency (from the telemetry histogram machinery),
   throughput, and goodput-under-injection.
+- :mod:`.tracing` — request-scoped trace IDs, minted per
+  :class:`~ft_sgemm_tpu.serve.engine.ServeRequest` and propagated
+  through enqueue -> flush -> execute -> detection -> retry, so one
+  grep joins a user request to the tile/device that corrupted it. The
+  live plane (``--monitor-port=``, ``cli top``) is
+  :mod:`ft_sgemm_tpu.telemetry.monitor`.
 
-CLI: ``python -m ft_sgemm_tpu.cli serve [--dry-run]`` and
-``python -m ft_sgemm_tpu.cli serve-bench [--smoke]``.
+CLI: ``python -m ft_sgemm_tpu.cli serve [--dry-run] [--monitor-port=N]``
+and ``python -m ft_sgemm_tpu.cli serve-bench [--smoke]``.
 """
 
 from __future__ import annotations
@@ -49,6 +55,11 @@ from ft_sgemm_tpu.serve.loadgen import (
     run_serve_bench,
     smoke_spec,
 )
+from ft_sgemm_tpu.serve.tracing import (
+    current_trace_id,
+    new_trace_id,
+    trace_scope,
+)
 
 __all__ = [
     "Bucket",
@@ -58,9 +69,12 @@ __all__ = [
     "ServeRequest",
     "ServeResult",
     "VARIANTS",
+    "current_trace_id",
     "default_bucket_set",
+    "new_trace_id",
     "run_load",
     "run_serve_bench",
     "select_bucket",
     "smoke_spec",
+    "trace_scope",
 ]

@@ -52,6 +52,7 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from ft_sgemm_tpu.serve.buckets import Bucket, select_bucket
+from ft_sgemm_tpu.serve.tracing import new_trace_id, trace_scope
 from ft_sgemm_tpu.telemetry.registry import (
     LATENCY_BUCKETS,
     histogram_percentiles,
@@ -86,6 +87,9 @@ class ServeRequest:
     variant: str = "clean"
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQ_IDS))
+    # Minted at construction (DESIGN.md §12 rule 1): a request that only
+    # ever waits, overflows, or is rejected still has a joinable identity.
+    trace_id: str = dataclasses.field(default_factory=new_trace_id)
 
     def __post_init__(self):
         if self.variant not in VARIANTS:
@@ -122,6 +126,7 @@ class ServeResult:
     corrected: bool               # detections > 0 and repaired in-kernel
     latency_seconds: float
     blame_tiles: Optional[list]   # nonzero per-tile coords, request-scoped
+    trace_id: Optional[str] = None
 
 
 class _Future:
@@ -172,6 +177,28 @@ class _NullRecorder:
         yield {}
 
 
+def _device_label(x) -> str:
+    """The device a materialized result lives on, as a stable string —
+    version-defensive across jax's Array.device / .devices() spellings,
+    and degrading to "host" rather than raising (a monitor label is
+    never worth failing a request over)."""
+    try:
+        devs = getattr(x, "devices", None)
+        if callable(devs):
+            ds = list(devs())
+            if ds:
+                return str(ds[0])
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        d = getattr(x, "device", None)
+        if d is not None:
+            return str(d() if callable(d) else d)
+    except Exception:  # noqa: BLE001
+        pass
+    return "host"
+
+
 def _as_recorder(timeline):
     if timeline is None:
         return _NullRecorder()
@@ -206,7 +233,7 @@ class ServeEngine:
                  threshold="static",
                  max_batch: int = 4, max_wait: float = 0.05,
                  max_retries: int = 2, retry_backoff: float = 0.01,
-                 timeline=None, registry=None):
+                 timeline=None, registry=None, monitor=None):
         if not buckets:
             raise ValueError("ServeEngine needs at least one bucket")
         if max_batch < 1:
@@ -220,6 +247,14 @@ class ServeEngine:
         self.max_retries = int(max_retries)
         self.retry_backoff = float(retry_backoff)
         self._tl = _as_recorder(timeline)
+        # Live observability plane (telemetry/monitor.py): a direct
+        # per-request feed — SLO accounting, device-health scoring, and
+        # the /events ring. STRICTLY host-side, consulted only after a
+        # request's result is already materialized: monitor=None leaves
+        # the compiled executables and the steady-state hot path
+        # byte-identical (pinned in tests/test_monitor.py, the same
+        # discipline as --telemetry in PR 1).
+        self.monitor = monitor
         from ft_sgemm_tpu import telemetry
 
         self.registry = registry if registry is not None \
@@ -401,6 +436,11 @@ class ServeEngine:
             self._counts["requests"] += 1
             self._per_bucket[bucket.key]["requests"] += 1
         self.registry.counter("serve_requests", bucket=bucket.key).inc()
+        # First hop of the trace: the enqueue point names the trace the
+        # moment the queue owns it (DESIGN.md §12 — enqueue -> flush ->
+        # execute -> detect -> retry all carry the same ID).
+        self._tl.point("serve", "enqueue", trace_id=request.trace_id,
+                       request_id=request.request_id, bucket=bucket.key)
         return fut
 
     def _ready_keys(self, now: float) -> list:
@@ -494,7 +534,11 @@ class ServeEngine:
             self._counts["batches"] += 1
             self._per_bucket[bucket.key]["batches"] += 1
         self.registry.counter("serve_batches", bucket=bucket.key).inc()
-        with self._tl.span(f"serve[{bucket.key}]", kind="stage") as info:
+        # The batch span names every in-flight trace: a kill mid-flush
+        # still says WHICH requests were riding the batch.
+        trace_ids = [e.request.trace_id for e in entries]
+        with self._tl.span(f"serve[{bucket.key}]", kind="stage",
+                           trace_ids=trace_ids) as info:
             det_total = unc_total = 0
             for entry in entries:
                 det, unc = self._execute_one(bucket, entry)
@@ -502,14 +546,29 @@ class ServeEngine:
                 unc_total += unc
             info["value"] = {"batch": len(entries),
                              "detections": det_total,
-                             "uncorrectable_final": unc_total}
+                             "uncorrectable_final": unc_total,
+                             "trace_ids": trace_ids}
 
     def _execute_one(self, bucket: Bucket, entry: _Entry) -> Tuple[int, int]:
         """Run one request (with the bucket-scoped retry ladder); resolve
-        its future. Returns the final (detections, uncorrectable)."""
+        its future. Returns the final (detections, uncorrectable).
+
+        The whole execution window runs inside the request's
+        :func:`~ft_sgemm_tpu.serve.tracing.trace_scope`, and every event
+        it emits — the ``serve_gemm`` record, each ``retry``, a terminal
+        ``exhausted`` — carries ``extra["trace_id"]``, so one grep joins
+        the user request to the tile/device that corrupted it and to the
+        retry that saved (or failed) it."""
         from ft_sgemm_tpu import telemetry
 
         request = entry.request
+        with trace_scope(request.trace_id):
+            return self._execute_one_traced(bucket, entry, telemetry)
+
+    def _execute_one_traced(self, bucket: Bucket, entry: _Entry,
+                            telemetry) -> Tuple[int, int]:
+        request = entry.request
+        trace_id = request.trace_id
         m, n, _ = request.mnk
         a, b, c = self._pad_operands(bucket, request)
         variant = request.variant
@@ -535,13 +594,18 @@ class ServeEngine:
                 self._per_bucket[bucket.key]["retries"] += 1
             self.registry.counter("serve_retries",
                                   bucket=bucket.key).inc()
+            retry_extra = {"trace_id": trace_id,
+                           "bucket": bucket.key,
+                           "request_id": request.request_id,
+                           "attempt": retries,
+                           "backoff_seconds": round(backoff, 6)}
             telemetry.record_step_event(
-                "retry", op="serve",
-                uncorrectable=unc,
-                extra={"bucket": bucket.key,
-                       "request_id": request.request_id,
-                       "attempt": retries,
-                       "backoff_seconds": round(backoff, 6)})
+                "retry", op="serve", uncorrectable=unc, extra=retry_extra)
+            if self.monitor is not None:
+                self.monitor.observe_retry(
+                    {"outcome": "retry", "op": "serve",
+                     "uncorrectable": unc, "ts": time.time(),
+                     "extra": retry_extra})
             if backoff > 0:
                 time.sleep(backoff)
             variant = "clean"
@@ -557,11 +621,18 @@ class ServeEngine:
                 self._counts["uncorrectable_exhausted"] += 1
             self.registry.counter("serve_uncorrectable_exhausted",
                                   bucket=bucket.key).inc()
+            exhausted_extra = {"trace_id": trace_id,
+                               "bucket": bucket.key,
+                               "request_id": request.request_id,
+                               "attempts": retries}
             telemetry.record_step_event(
                 "exhausted", op="serve", uncorrectable=unc,
-                extra={"bucket": bucket.key,
-                       "request_id": request.request_id,
-                       "attempts": retries})
+                extra=exhausted_extra)
+            if self.monitor is not None:
+                self.monitor.observe_retry(
+                    {"outcome": "exhausted", "op": "serve",
+                     "uncorrectable": unc, "ts": time.time(),
+                     "extra": exhausted_extra})
         latency = time.monotonic() - entry.t_enqueue
         det_grid = np.asarray(res.detections)
         blame = np.argwhere(det_grid != 0)
@@ -571,24 +642,39 @@ class ServeEngine:
             self.registry.histogram("serve_latency_seconds",
                                     buckets=LATENCY_BUCKETS,
                                     **labels).observe(latency)
+        request_extra = {
+            "trace_id": trace_id,
+            "request_id": request.request_id,
+            "bucket": bucket.key,
+            "variant": request.variant,
+            "retries": retries,
+            "latency_seconds": round(latency, 6)}
         if telemetry.enabled():
             # Per-request fault attribution: the request's OWN counter
             # grids (not the batch's, not the process's) feed the event,
             # so `cli telemetry` blames faults on requests.
             telemetry.record_gemm(
                 "serve_gemm", res, strategy=bucket.strategy,
-                layer=bucket.key, extra={
-                    "request_id": request.request_id,
-                    "bucket": bucket.key,
-                    "variant": request.variant,
-                    "retries": retries,
-                    "latency_seconds": round(latency, 6)})
+                layer=bucket.key, extra=dict(request_extra))
+        if self.monitor is not None:
+            # The monitor's direct feed: the same event shape the JSONL
+            # stream carries, plus the executed device — so the health
+            # scorer attributes serve traffic without a mesh.
+            self.monitor.observe_request({
+                "outcome": ("uncorrectable" if not ok else
+                            "corrected" if corrected else "clean"),
+                "op": "serve_gemm", "detected": det,
+                "corrected": det if corrected else 0,
+                "uncorrectable": unc, "strategy": bucket.strategy,
+                "layer": bucket.key, "tiles": blame_tiles,
+                "device": _device_label(res.c), "ts": time.time(),
+                "extra": dict(request_extra, ok=ok)})
         out = np.asarray(res.c)[:m, :n]
         result = ServeResult(
             request_id=request.request_id, bucket_key=bucket.key,
             c=out, detections=det, uncorrectable=unc, retries=retries,
             ok=ok, corrected=corrected, latency_seconds=latency,
-            blame_tiles=blame_tiles)
+            blame_tiles=blame_tiles, trace_id=trace_id)
         with self._stats_lock:
             self._counts["completed"] += 1
         entry.future._resolve(result)

@@ -206,16 +206,29 @@ def run_serve_bench(*, smoke: bool = False,
                     verify: Optional[bool] = None,
                     timeline=None,
                     should_stop: Optional[Callable[[], bool]] = None,
-                    progress_out=None) -> dict:
+                    progress_out=None,
+                    monitor="auto", monitor_port: Optional[int] = None,
+                    slo=None) -> dict:
     """The serve-bench core shared by ``bench.py --serve`` and
     ``cli serve-bench``: build the bucket set, prewarm it (AOT compile,
     recorded as compile spans), drive the load, and return the artifact
     context dict — p50/p99 latency, throughput, goodput-under-injection,
-    retry/fault counters, bucket set, prewarm cost.
+    retry/fault counters, bucket set, prewarm cost, and the final
+    SLO/health snapshot (``slo`` / ``device_health`` keys).
 
     ``smoke`` selects the CI scenario (tiny buckets + :func:`smoke_spec`,
     verification on). Explicit keyword args override either profile's
     defaults.
+
+    Monitoring: ``monitor="auto"`` (default) builds a live
+    :class:`~ft_sgemm_tpu.telemetry.monitor.Monitor` (SLO error budget +
+    device-health scoring; pass ``slo=SloConfig(...)`` to tighten the
+    objectives) so every run's artifact carries the SLO section; pass an
+    existing Monitor to share one, or ``monitor=None`` to run bare.
+    ``monitor_port`` additionally starts the HTTP exporter
+    (``/metrics`` / ``/healthz`` / ``/events``; 0 = ephemeral — the
+    resolved URL streams as a ``serve_progress`` point and lands in the
+    stats as ``monitor_url``) for the duration of the bench.
     """
     sizes = tuple(bucket_sizes) if bucket_sizes else (
         (128, 256) if smoke else (256, 512, 1024))
@@ -245,18 +258,46 @@ def run_serve_bench(*, smoke: bool = False,
         if progress_out is not None:
             print(f"serve-bench: {p}", file=progress_out, flush=True)
 
-    with ServeEngine(buckets, max_batch=max_batch, max_wait=max_wait,
-                     timeline=timeline) as engine:
-        t0 = time.monotonic()
-        prewarm = engine.prewarm()
-        progress({"prewarmed": prewarm["compiled"],
-                  "seconds": prewarm["seconds"]})
-        stats = run_load(engine, spec, should_stop=should_stop,
-                         progress=progress)
-        stats["prewarm"] = prewarm
-        stats["buckets"] = [b.key for b in buckets]
-        stats["smoke"] = bool(smoke)
-        stats["seconds_total"] = round(time.monotonic() - t0, 3)
+    mon = None
+    mon_server = None
+    if monitor == "auto":
+        from ft_sgemm_tpu.telemetry.monitor import Monitor
+
+        mon = Monitor(slo=slo)
+    elif monitor is not None:
+        mon = monitor
+    if mon is not None:
+        mon.attach()
+        if monitor_port is not None:
+            from ft_sgemm_tpu.telemetry.monitor import MonitorServer
+
+            mon_server = MonitorServer(mon, port=monitor_port).start()
+            progress({"monitor_url": mon_server.url})
+    try:
+        with ServeEngine(buckets, max_batch=max_batch, max_wait=max_wait,
+                         timeline=timeline, monitor=mon) as engine:
+            t0 = time.monotonic()
+            prewarm = engine.prewarm()
+            progress({"prewarmed": prewarm["compiled"],
+                      "seconds": prewarm["seconds"]})
+            stats = run_load(engine, spec, should_stop=should_stop,
+                             progress=progress)
+            stats["prewarm"] = prewarm
+            stats["buckets"] = [b.key for b in buckets]
+            stats["smoke"] = bool(smoke)
+            stats["seconds_total"] = round(time.monotonic() - t0, 3)
+        if mon is not None:
+            # The final SLO/budget + health snapshot the artifact embeds
+            # (and RunReport's "SLO" section renders).
+            stats["slo"] = mon.snapshot()
+            stats["device_health"] = stats["slo"]["device_health"]
+            if mon_server is not None:
+                stats["monitor_url"] = mon_server.url
+    finally:
+        if mon_server is not None:
+            mon_server.close()
+        if mon is not None:
+            mon.detach()
     return stats
 
 

@@ -1,0 +1,97 @@
+"""Request-scoped trace IDs for the serving plane.
+
+A serving fault is only diagnosable if one identifier survives the whole
+request lifecycle: enqueue -> bucket flush -> AOT executable call ->
+detection/correction -> retry ladder -> response. This module is that
+identifier — a short random hex token minted per
+:class:`~ft_sgemm_tpu.serve.engine.ServeRequest` and stamped into every
+artifact the request touches:
+
+- the ``serve_gemm`` fault event (``extra["trace_id"]`` — alongside the
+  per-request tile blame, so the trace joins a USER REQUEST to the exact
+  tile/device that corrupted it),
+- every ``retry`` / ``exhausted`` ladder event the request's
+  uncorrectable path emits,
+- the serve batch's timeline span (``trace_ids`` — which requests were
+  in flight when a span was killed),
+- the live monitor's event ring (``/events`` — the endpoint the
+  ISSUE-9 trace-join acceptance asserts against).
+
+One ``grep TRACE_ID`` over any of those streams reconstructs the
+request's story; ``cli top`` renders the same join live.
+
+Propagation rules (DESIGN.md §12):
+
+1. The ID is minted at REQUEST CONSTRUCTION (not at execution), so a
+   request that waits in the queue, overflows, or is rejected still has
+   an identity.
+2. The engine enters :func:`trace_scope` for the request's execution
+   window; anything recorded inside (including nested telemetry
+   recorders that know nothing about serving) can pick the ID up via
+   :func:`current_trace_id` / :func:`stamp`.
+3. Events always carry the ID in ``extra["trace_id"]`` — never as a new
+   top-level field, so the JSONL schema and every existing reader stay
+   untouched.
+
+HARD CONSTRAINT — stdlib only, no package imports: like
+``telemetry/timeline.py`` this module must be loadable by file path in a
+jax-free process (the monitor's HTTP plane and the CLI's follow mode
+both run without a backend).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import uuid
+from typing import Optional
+
+# contextvars (not threading.local): the dispatch thread executes many
+# requests and a future async engine would interleave them — context
+# variables scope correctly under both.
+_CURRENT: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "ft_sgemm_trace_id", default=None)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char request trace ID (64 random bits — collision
+    probability is negligible at any realistic request volume, and the
+    short form stays grep- and column-friendly in JSONL/terminal views)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace ID of the enclosing :func:`trace_scope`, or None."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: Optional[str]):
+    """Make ``trace_id`` the ambient trace for the block (restored on
+    exit, nesting-safe). ``None`` scopes are allowed and simply clear
+    the ambient ID for the block."""
+    token = _CURRENT.set(trace_id)
+    try:
+        yield trace_id
+    finally:
+        _CURRENT.reset(token)
+
+
+def stamp(extra: Optional[dict] = None,
+          trace_id: Optional[str] = None) -> Optional[dict]:
+    """Return ``extra`` with ``trace_id`` merged in (explicit argument
+    first, else the ambient scope's). Never overwrites an existing
+    ``trace_id`` key and returns the input unchanged (possibly None)
+    when there is no ID to stamp — so stamping is safe to apply
+    unconditionally on every event-emission path."""
+    tid = trace_id if trace_id is not None else _CURRENT.get()
+    if tid is None:
+        return extra
+    if extra is not None and extra.get("trace_id") is not None:
+        return extra
+    merged = dict(extra or {})
+    merged["trace_id"] = tid
+    return merged
+
+
+__all__ = ["current_trace_id", "new_trace_id", "stamp", "trace_scope"]

@@ -66,6 +66,7 @@ from ft_sgemm_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
     histogram_percentiles,
+    parse_prometheus,
     to_prometheus,
 )
 
@@ -87,6 +88,12 @@ class _State:
         self.measure_residual = False
         self.log_clean = False
         self.step: Optional[int] = None
+        # Live-event observers (telemetry/monitor.py's feed): called with
+        # every recorded FaultEvent — clean calls included, independent
+        # of log_clean and of whether a JSONL sink is attached. The list
+        # is replaced wholesale on mutation so _emit can iterate it
+        # without taking the state lock.
+        self.observers: tuple = ()
 
 
 _STATE = _State()
@@ -145,6 +152,27 @@ def reset() -> None:
         _STATE.step = None
         _STATE.measure_residual = False
         _STATE.log_clean = False
+        _STATE.observers = ()
+
+
+def add_observer(fn) -> None:
+    """Register a live-event observer: ``fn(event)`` is called for EVERY
+    recorded :class:`FaultEvent` while telemetry is enabled — clean calls
+    included (a health tracker needs denominators), regardless of
+    ``log_clean`` or whether a JSONL sink exists. Observers must be fast
+    and never raise (exceptions are swallowed — observability must not
+    take down the op); the live monitor
+    (:class:`ft_sgemm_tpu.telemetry.monitor.Monitor`) is the intended
+    subscriber."""
+    with _STATE.lock:
+        if fn not in _STATE.observers:
+            _STATE.observers = _STATE.observers + (fn,)
+
+
+def remove_observer(fn) -> None:
+    """Unregister an observer added with :func:`add_observer` (idempotent)."""
+    with _STATE.lock:
+        _STATE.observers = tuple(o for o in _STATE.observers if o is not fn)
 
 
 def set_step(step: Optional[int]) -> None:
@@ -294,6 +322,11 @@ def _emit(event: FaultEvent) -> None:
     sink = _STATE.sink
     if sink is not None and (event.outcome != "clean" or _STATE.log_clean):
         sink.write(event)
+    for observer in _STATE.observers:
+        try:
+            observer(event)
+        except Exception:  # noqa: BLE001 — observers never break the op
+            pass
 
 
 def _series_labels(op, strategy, layer, device, encode=None,
@@ -595,7 +628,9 @@ __all__ = [
     "JsonlSink",
     "MetricsRegistry",
     "OUTCOMES",
+    "add_observer",
     "aggregate",
+    "remove_observer",
     "timeline",
     "configure",
     "disable",
@@ -604,6 +639,7 @@ __all__ = [
     "get_registry",
     "histogram_percentiles",
     "measure_output_residual",
+    "parse_prometheus",
     "read_events",
     "record_attention",
     "record_gemm",

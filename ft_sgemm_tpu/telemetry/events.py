@@ -32,8 +32,12 @@ from typing import IO, Iterable, Iterator, Optional
 #                  (train.resilient_step); "exhausted" is the non-raising
 #                  terminal — every recovery option spent, the last clean
 #                  state returned to the caller
+#   alert          an observability threshold crossed (SLO burn rate,
+#                  device-health drift — telemetry/monitor.py); carries
+#                  the crossing's facts in ``extra``, counts toward no
+#                  call totals (like the recovery-ladder stream)
 OUTCOMES = ("clean", "corrected", "uncorrectable", "retry", "restore",
-            "raise", "exhausted")
+            "raise", "exhausted", "alert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,24 +141,34 @@ class JsonlSink:
             self._fh = None
 
 
+def parse_event_line(line: str) -> Optional[FaultEvent]:
+    """One JSONL line -> :class:`FaultEvent`, or None for blank, torn,
+    or foreign lines (the skip rules :func:`read_events` applies — shared
+    here so the CLI's follow mode tails a growing shard with identical
+    semantics)."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if not isinstance(d, dict) or "outcome" not in d:
+        return None
+    try:
+        return FaultEvent.from_dict(d)
+    except (TypeError, ValueError):
+        return None
+
+
 def read_events(path) -> Iterator[FaultEvent]:
     """Iterate the events of a JSONL log; torn/foreign lines are skipped
     (the log is append-only across crashes, so a torn tail is expected)."""
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                d = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if not isinstance(d, dict) or "outcome" not in d:
-                continue
-            try:
-                yield FaultEvent.from_dict(d)
-            except (TypeError, ValueError):
-                continue
+            ev = parse_event_line(line)
+            if ev is not None:
+                yield ev
 
 
 def summarize_events(events: Iterable[FaultEvent]) -> dict:
@@ -306,4 +320,5 @@ def format_summary(summary: dict) -> str:
 
 
 __all__ = ["FaultEvent", "JsonlSink", "OUTCOMES", "format_summary",
-           "read_events", "registry_from_events", "summarize_events"]
+           "parse_event_line", "read_events", "registry_from_events",
+           "summarize_events"]

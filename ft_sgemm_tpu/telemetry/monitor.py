@@ -1,0 +1,849 @@
+"""Live serving observability plane: /metrics, /healthz, /events, SLO
+error budgets, and continuous device-health scoring.
+
+Every telemetry surface before this module was post-hoc file analysis —
+JSONL events, timelines, ``cli attribute``, ``--format=prom`` over a
+finished log. Production fleet screening (the deployment story of online
+ABFT, arXiv 2305.01024 / V-ABFT 2602.08043) needs the inverse: a live
+plane a scraper can poll and an operator can alert on WHILE traffic
+flows, so a degrading device is pulled before it ships corrupted
+output. Four coupled pieces:
+
+1. :class:`EventRing` — a bounded ring of recent fault events with
+   monotone sequence numbers; ``/events?since=SEQ`` streams it as JSON,
+   so the trace-ID join (request -> tile/device blame -> retry outcome)
+   is assertable against a LIVE endpoint, not just a log file.
+2. :class:`SloTracker` — rolling-window p99-latency + goodput
+   objectives with an error budget: ``slo_budget_remaining`` /
+   ``slo_burn_rate`` gauges, and a threshold-crossing ``alert`` event
+   emitted into the normal JSONL stream when the burn rate first
+   exceeds 1x (re-armed after recovery — alerts are edges, not levels).
+3. :class:`DeviceHealthTracker` — continuous per-device scoring. Fault
+   counters come from the serving engine's direct feed and (for mesh
+   runs) from the registry's ``ft_device_*`` attribution series; clean-
+   check residuals feed a streaming ``(n, sum, sumsq)`` moment
+   accumulator per device — the PR-7 adaptive-threshold moment layout,
+   host-side — plus an EWMA recent window, so residual DRIFT (creep
+   toward the detection threshold) flags a device before it throws
+   uncorrectables. The score is ``exp(-(w_det*det_rate +
+   w_unc*unc_rate + w_drift*min(drift_z, cap)))`` in (0, 1]
+   (DESIGN.md §12), exported as ``device_health{device=...}``.
+4. :class:`MonitorServer` — a threaded stdlib ``http.server`` exposing
+   ``/metrics`` (the registry's full Prometheus exposition, monitor
+   gauges refreshed per scrape), ``/healthz`` (OK / DEGRADED / FAILING
+   with named reasons; 503 on FAILING), and ``/events?since=``.
+
+HARD CONSTRAINT — stdlib only at module scope, no package imports: like
+``telemetry/timeline.py`` this file must be loadable by path in a
+jax-free process (exporting metrics must never require a backend).
+In-package collaborators (the metrics registry, ``to_prometheus``, the
+``alert`` event emitter) are resolved lazily inside methods and can be
+injected explicitly for standalone use.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Event ring buffer
+# ---------------------------------------------------------------------------
+
+
+class EventRing:
+    """Bounded ring of recent event dicts with monotone sequence numbers.
+
+    ``append`` assigns the next sequence number; ``since(seq)`` returns
+    every retained event with a HIGHER sequence, oldest first, plus the
+    cursor to pass next time — the standard resumable-poll contract.
+    Events older than the capacity are gone (the ring bounds memory; the
+    JSONL sink is the durable record)."""
+
+    def __init__(self, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError(f"EventRing capacity={capacity} must be >= 1")
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def append(self, event: dict) -> int:
+        with self._lock:
+            self._seq += 1
+            rec = dict(event)
+            rec["seq"] = self._seq
+            self._buf.append(rec)
+            return self._seq
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def since(self, seq: int = 0,
+              limit: Optional[int] = None) -> Tuple[List[dict], int]:
+        with self._lock:
+            out = [dict(r) for r in self._buf if r["seq"] > seq]
+            cursor = self._seq
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out, cursor
+
+
+# ---------------------------------------------------------------------------
+# SLO error budget
+# ---------------------------------------------------------------------------
+
+
+class SloConfig:
+    """One serving SLO: a p99-latency objective, a goodput objective,
+    and the error budget that prices violations.
+
+    ``budget`` is the fraction of a rolling window's requests allowed to
+    violate either objective (miss the latency target, or complete
+    not-OK). ``burn_rate = violation_fraction / budget``: 1.0 means the
+    budget is being consumed exactly as fast as allowed; ``
+    budget_remaining = max(0, 1 - burn_rate)``. Defaults are deliberately
+    loose (30 s p99, 1% budget) — CPU interpret-mode smoke traffic must
+    come up OK; production deployments pass their own."""
+
+    def __init__(self, *, p99_latency_seconds: float = 30.0,
+                 goodput_target: float = 0.99,
+                 window_seconds: float = 600.0,
+                 budget: float = 0.01,
+                 failing_burn_rate: float = 10.0):
+        if not (0.0 < budget <= 1.0):
+            raise ValueError(f"SloConfig.budget={budget} must be in (0, 1]")
+        self.p99_latency_seconds = float(p99_latency_seconds)
+        self.goodput_target = float(goodput_target)
+        self.window_seconds = float(window_seconds)
+        self.budget = float(budget)
+        self.failing_burn_rate = float(failing_burn_rate)
+
+    def to_dict(self) -> dict:
+        return {"p99_latency_seconds": self.p99_latency_seconds,
+                "goodput_target": self.goodput_target,
+                "window_seconds": self.window_seconds,
+                "budget": self.budget,
+                "failing_burn_rate": self.failing_burn_rate}
+
+
+class SloTracker:
+    """Rolling-window SLO accounting with edge-triggered alerts.
+
+    ``record(latency_seconds, ok)`` per completed request; a request
+    violates the SLO when it is not OK or exceeds the latency objective.
+    ``on_alert`` (set by :class:`Monitor`) fires once when the burn rate
+    crosses 1.0 upward and re-arms when it falls back under 0.5 — a
+    flapping burn emits edges, not a level per request."""
+
+    def __init__(self, config: Optional[SloConfig] = None,
+                 on_alert: Optional[Callable[[dict], None]] = None):
+        self.config = config or SloConfig()
+        self.on_alert = on_alert
+        self._lock = threading.Lock()
+        self._window: collections.deque = collections.deque()
+        self._alerted = False
+        self._total = 0
+        self._total_violations = 0
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self.config.window_seconds
+        while self._window and self._window[0][0] < horizon:
+            self._window.popleft()
+
+    def record(self, latency_seconds: float, ok: bool,
+               now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        violation = (not ok) or (
+            latency_seconds > self.config.p99_latency_seconds)
+        fire = None
+        with self._lock:
+            self._window.append((now, float(latency_seconds), bool(ok),
+                                 violation))
+            self._trim(now)
+            self._total += 1
+            self._total_violations += int(violation)
+            snap = self._snapshot_locked()
+            if snap["burn_rate"] >= 1.0 and not self._alerted:
+                self._alerted = True
+                fire = snap
+            elif snap["burn_rate"] < 0.5 and self._alerted:
+                self._alerted = False
+        if fire is not None and self.on_alert is not None:
+            try:
+                self.on_alert(fire)
+            except Exception:  # noqa: BLE001 — alerting must not break serving
+                pass
+
+    def _snapshot_locked(self) -> dict:
+        n = len(self._window)
+        violations = sum(1 for *_, v in self._window if v)
+        ok_within = sum(1 for _, lat, ok, v in self._window
+                        if ok and not v)
+        frac = violations / n if n else 0.0
+        burn = frac / self.config.budget
+        lats = sorted(lat for _, lat, _, _ in self._window)
+        p99 = lats[min(n - 1, int(math.ceil(0.99 * n)) - 1)] if n else None
+        return {
+            "requests": n,
+            "violations": violations,
+            "violation_fraction": round(frac, 6),
+            "burn_rate": round(burn, 6),
+            "budget_remaining": round(max(0.0, 1.0 - burn), 6),
+            "goodput_ratio": round(ok_within / n, 6) if n else None,
+            "observed_p99_seconds": (round(p99, 6)
+                                     if p99 is not None else None),
+            "objectives": self.config.to_dict(),
+            "total_requests": self._total,
+            "total_violations": self._total_violations,
+        }
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._trim(now)
+            return self._snapshot_locked()
+
+
+# ---------------------------------------------------------------------------
+# Device health
+# ---------------------------------------------------------------------------
+
+
+class _Moments:
+    """Streaming ``(n, sum, sumsq)`` — the PR-7 adaptive-threshold moment
+    accumulator layout (``ops/common.variance_bound_threshold`` consumes
+    exactly these three numbers), kept host-side per device."""
+
+    __slots__ = ("n", "sum", "sumsq")
+
+    def __init__(self):
+        self.n = 0
+        self.sum = 0.0
+        self.sumsq = 0.0
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.sum += v
+        self.sumsq += v * v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.n if self.n else 0.0
+
+    @property
+    def variance(self) -> float:
+        if self.n < 2:
+            return 0.0
+        return max(0.0, self.sumsq / self.n - self.mean ** 2)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(self.variance)
+
+
+class HealthConfig:
+    """Score weights and thresholds (the DESIGN.md §12 formula).
+
+    ``score = exp(-(w_det * det_rate + w_unc * unc_rate
+                    + w_drift * min(max(0, drift_z - drift_grace),
+                                    drift_cap)))``
+
+    with rates per call and ``drift_z`` the z-score of the recent
+    (EWMA) residual mean against the device's long-run baseline —
+    nonzero only after ``drift_min_n`` baseline observations, so a cold
+    tracker never cries wolf. ``drift_grace`` eats the EWMA's own
+    sampling noise (an EWMA over a stationary stream wanders ~1 sigma;
+    only drift BEYOND the grace margin is creep, not jitter).
+    ``degraded_below`` / ``failing_below`` map scores onto the /healthz
+    ladder; a device only reaches FAILING with uncorrectable faults on
+    the books (corrected detections alone can at worst degrade — they
+    were, after all, corrected)."""
+
+    def __init__(self, *, w_det: float = 1.0, w_unc: float = 4.0,
+                 w_drift: float = 0.5, drift_grace: float = 1.0,
+                 drift_cap: float = 8.0,
+                 drift_min_n: int = 20, ewma_alpha: float = 0.2,
+                 degraded_below: float = 0.9, failing_below: float = 0.2):
+        self.w_det = w_det
+        self.w_unc = w_unc
+        self.w_drift = w_drift
+        self.drift_grace = drift_grace
+        self.drift_cap = drift_cap
+        self.drift_min_n = drift_min_n
+        self.ewma_alpha = ewma_alpha
+        self.degraded_below = degraded_below
+        self.failing_below = failing_below
+
+
+class DeviceHealthTracker:
+    """Continuous per-device health from counters + residual drift.
+
+    Two count feeds, summed per device: the DIRECT feed
+    (:meth:`observe` — the serving engine's per-request attribution,
+    single device) and the SYNCED feed (:meth:`sync_counts` — absolute
+    totals read from the registry's ``ft_device_*`` series, the mesh
+    attribution path, overwritten per refresh so re-scrapes never
+    double-count). Residuals (:meth:`observe_residual`) feed the
+    baseline moments and the EWMA recent window that drift detection
+    compares."""
+
+    def __init__(self, config: Optional[HealthConfig] = None):
+        self.config = config or HealthConfig()
+        self._lock = threading.Lock()
+        self._direct: Dict[str, dict] = {}
+        self._synced: Dict[str, dict] = {}
+        self._resid: Dict[str, dict] = {}
+
+    def observe(self, device: str, *, calls: int = 1, detected: int = 0,
+                uncorrectable: int = 0,
+                residual: Optional[float] = None) -> None:
+        device = str(device)
+        with self._lock:
+            row = self._direct.setdefault(
+                device, {"calls": 0, "detected": 0, "uncorrectable": 0})
+            row["calls"] += int(calls)
+            row["detected"] += int(detected)
+            row["uncorrectable"] += int(uncorrectable)
+        if residual is not None:
+            self.observe_residual(device, residual)
+
+    def observe_residual(self, device: str, residual: float) -> None:
+        device = str(device)
+        v = float(residual)
+        if not math.isfinite(v):
+            return
+        cfg = self.config
+        with self._lock:
+            row = self._resid.setdefault(
+                device, {"baseline": _Moments(), "ewma": None})
+            row["baseline"].observe(v)
+            prev = row["ewma"]
+            row["ewma"] = (v if prev is None
+                           else (1 - cfg.ewma_alpha) * prev
+                           + cfg.ewma_alpha * v)
+
+    def sync_counts(self, device: str, *, calls: int, detected: int,
+                    uncorrectable: int) -> None:
+        """Absolute counter totals for one device (registry-derived;
+        idempotent — last write wins, so scraping twice changes nothing)."""
+        with self._lock:
+            self._synced[str(device)] = {
+                "calls": int(calls), "detected": int(detected),
+                "uncorrectable": int(uncorrectable)}
+
+    def _counts(self, device: str) -> dict:
+        d = self._direct.get(device, {})
+        s = self._synced.get(device, {})
+        return {k: d.get(k, 0) + s.get(k, 0)
+                for k in ("calls", "detected", "uncorrectable")}
+
+    def drift_z(self, device: str) -> float:
+        cfg = self.config
+        row = self._resid.get(str(device))
+        if row is None:
+            return 0.0
+        base = row["baseline"]
+        if base.n < cfg.drift_min_n or row["ewma"] is None:
+            return 0.0
+        spread = base.std + 1e-12 * (1.0 + abs(base.mean))
+        return max(0.0, (row["ewma"] - base.mean) / spread)
+
+    def score(self, device: str) -> float:
+        device = str(device)
+        cfg = self.config
+        with self._lock:
+            counts = self._counts(device)
+            drift = self.drift_z(device)
+        calls = max(1, counts["calls"])
+        det_rate = counts["detected"] / calls
+        unc_rate = counts["uncorrectable"] / calls
+        creep = min(max(0.0, drift - cfg.drift_grace), cfg.drift_cap)
+        return math.exp(-(cfg.w_det * det_rate + cfg.w_unc * unc_rate
+                          + cfg.w_drift * creep))
+
+    def devices(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self._direct) | set(self._synced)
+                          | set(self._resid))
+
+    def scores(self) -> Dict[str, float]:
+        return {dev: round(self.score(dev), 6) for dev in self.devices()}
+
+    def rows(self) -> Dict[str, dict]:
+        """Full per-device view: counts, score, drift — the /healthz
+        reason source and the artifact's ``device_health`` section."""
+        out = {}
+        for dev in self.devices():
+            with self._lock:
+                counts = self._counts(dev)
+                drift = self.drift_z(dev)
+            out[dev] = {**counts, "drift_z": round(drift, 4),
+                        "score": round(self.score(dev), 6)}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Monitor: the in-process aggregator the HTTP plane serves
+# ---------------------------------------------------------------------------
+
+STATUSES = ("OK", "DEGRADED", "FAILING")
+
+# Ops whose events reach the ring DIRECTLY — the serving engine's
+# observe_request/observe_retry feed and the monitor's own alerts — so
+# the telemetry-observer path must skip them (one event, one ring entry;
+# the monitor's record_step_event("alert") would otherwise echo back
+# through the observer it itself registered).
+_SERVE_OPS = ("serve_gemm", "serve", "monitor")
+
+
+class Monitor:
+    """The live observability aggregator: ring + SLO + device health,
+    wired to the metrics registry and (optionally) the telemetry event
+    stream.
+
+    Feeds:
+
+    - :meth:`observe_request` / :meth:`observe_retry` — the serving
+      engine's direct per-request feed (works with telemetry fully
+      disabled; the serving plane must be monitorable on its own).
+    - :meth:`ingest_event` — a telemetry observer
+      (:func:`ft_sgemm_tpu.telemetry.add_observer`) receiving every
+      recorded FaultEvent; non-serve events (mesh attribution, training
+      ladders) land in the ring and feed device health from their
+      ``devices`` entries. Serve-op events are skipped here — the engine
+      already fed them directly.
+    - :meth:`refresh_gauges` — scrape-time: pulls ``ft_device_*``
+      absolute counters from the registry (the mesh path's per-device
+      attribution), recomputes scores, and (re)sets the ``slo_*`` and
+      ``device_health*`` gauges, so one exporter path serves everything.
+
+    ``registry``/``render``/``emit_alert`` default to the in-package
+    telemetry machinery (lazy import); inject them for standalone use of
+    a path-loaded module.
+    """
+
+    def __init__(self, *, registry=None, slo: Optional[SloConfig] = None,
+                 health: Optional[HealthConfig] = None,
+                 ring_capacity: int = 512,
+                 render: Optional[Callable] = None,
+                 emit_alert: Optional[Callable[[dict], None]] = None):
+        self.ring = EventRing(ring_capacity)
+        self.health = DeviceHealthTracker(health)
+        self.slo = SloTracker(slo, on_alert=self._slo_alert)
+        self._registry = registry
+        self._render = render
+        self._emit_alert = emit_alert
+        self._attached = False
+        self._health_alerted: set = set()
+        self.started_unix = time.time()
+
+    # -- collaborators (lazy, injectable) -----------------------------------
+
+    def registry(self):
+        if self._registry is None:
+            from ft_sgemm_tpu import telemetry
+
+            self._registry = telemetry.get_registry()
+        return self._registry
+
+    def _render_fn(self):
+        if self._render is None:
+            from ft_sgemm_tpu.telemetry.registry import to_prometheus
+
+            self._render = to_prometheus
+        return self._render
+
+    def _alert(self, kind: str, extra: dict) -> None:
+        """One ``alert`` event: into the ring always, into the normal
+        JSONL/telemetry stream when available."""
+        rec = {"outcome": "alert", "op": "monitor", "ts": time.time(),
+               "extra": {"kind": kind, **extra}}
+        self.ring.append(rec)
+        emit = self._emit_alert
+        if emit is not None:
+            try:
+                emit(rec)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        try:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.record_step_event(
+                "alert", op="monitor", extra=rec["extra"])
+        except Exception:  # noqa: BLE001 — alerting never breaks serving
+            pass
+
+    def _slo_alert(self, snapshot: dict) -> None:
+        self._alert("slo_burn", {
+            "burn_rate": snapshot["burn_rate"],
+            "budget_remaining": snapshot["budget_remaining"],
+            "violation_fraction": snapshot["violation_fraction"],
+            "requests": snapshot["requests"],
+            "objectives": snapshot["objectives"]})
+
+    # -- feeds --------------------------------------------------------------
+
+    def observe_request(self, info: dict) -> None:
+        """One completed serve request (the engine's direct feed).
+
+        ``info`` is the serve_gemm event payload shape: outcome, op,
+        detected/uncorrectable, tiles, device, and an ``extra`` carrying
+        trace_id / request_id / bucket / variant / retries /
+        latency_seconds / ok."""
+        self.ring.append(info)
+        extra = info.get("extra") or {}
+        lat = extra.get("latency_seconds")
+        ok = bool(extra.get("ok", info.get("outcome") != "uncorrectable"))
+        if isinstance(lat, (int, float)):
+            self.slo.record(float(lat), ok)
+        dev = info.get("device")
+        if dev is not None:
+            self.health.observe(
+                dev, calls=1, detected=int(info.get("detected") or 0),
+                uncorrectable=int(info.get("uncorrectable") or 0),
+                residual=info.get("residual"))
+        self._check_health_alerts()
+
+    def observe_retry(self, info: dict) -> None:
+        """One retry/exhausted ladder transition (the engine's direct
+        feed) — ring only; SLO accounting happens at request completion."""
+        self.ring.append(info)
+
+    def ingest_event(self, event) -> None:
+        """Telemetry-observer entry point: every recorded FaultEvent.
+
+        Accepts a FaultEvent (dataclass with ``to_json``) or a plain
+        dict. Serve-op events are skipped (the engine feeds those
+        directly — see ``_SERVE_OPS``)."""
+        if hasattr(event, "to_json"):
+            try:
+                d = json.loads(event.to_json())
+            except (TypeError, ValueError):
+                return
+        elif isinstance(event, dict):
+            d = dict(event)
+        else:
+            return
+        if d.get("op") in _SERVE_OPS:
+            return
+        self.ring.append(d)
+        residual = d.get("residual")
+        devices = d.get("devices")
+        if devices:
+            # Mesh-attributed events: counts are NOT taken from the
+            # entries — record_mesh_gemm already bumps the registry's
+            # ft_device_* counters (for EVERY device, clean ones too),
+            # which refresh_gauges syncs in as absolute totals; adding
+            # the entries here would double-count. The entries only
+            # route the event's residual to the implicated devices'
+            # drift streams.
+            if residual is not None:
+                for entry in devices:
+                    if isinstance(entry, dict) and "device" in entry:
+                        self.health.observe_residual(entry["device"],
+                                                     residual)
+        elif d.get("device") is not None and d.get("host") is None:
+            # Single-process events label a real device. Mesh events
+            # (host is set) label the MESH ("mesh2x4"), not a chip —
+            # their per-chip truth is the ft_device_* registry series
+            # the sync pass reads, so they feed nothing here.
+            self.health.observe(
+                d["device"], calls=1,
+                detected=int(d.get("detected") or 0),
+                uncorrectable=int(d.get("uncorrectable") or 0),
+                residual=residual)
+        elif residual is not None and d.get("outcome") in (
+                "clean", "corrected"):
+            # Single-device process without a device label: track the
+            # residual stream under the process-local pseudo-device so
+            # drift detection still works.
+            self.health.observe_residual("local", residual)
+        self._check_health_alerts()
+
+    def attach(self) -> "Monitor":
+        """Subscribe to the live telemetry event stream (idempotent)."""
+        if not self._attached:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.add_observer(self.ingest_event)
+            self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if self._attached:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.remove_observer(self.ingest_event)
+            self._attached = False
+
+    # -- derived views ------------------------------------------------------
+
+    def _sync_registry_devices(self) -> None:
+        """Fold the registry's ``ft_device_*`` counters (the mesh
+        attribution series — every device of every mesh call, not just
+        faulty ones) into the health tracker as absolute totals."""
+        try:
+            series = self.registry().collect()
+        except Exception:  # noqa: BLE001 — no registry: direct feed only
+            return
+        acc: Dict[str, dict] = {}
+        name_to_key = {"ft_device_calls": "calls",
+                       "ft_device_detections": "detected",
+                       "ft_device_uncorrectable": "uncorrectable"}
+        for s in series:
+            key = name_to_key.get(s["name"])
+            if key is None or s["kind"] != "counter":
+                continue
+            dev = (s.get("labels") or {}).get("device")
+            if dev is None:
+                continue
+            row = acc.setdefault(
+                dev, {"calls": 0, "detected": 0, "uncorrectable": 0})
+            row[key] += int(s["value"])
+        for dev, row in acc.items():
+            self.health.sync_counts(dev, **row)
+
+    def _check_health_alerts(self) -> None:
+        cfg = self.health.config
+        for dev, score in self.health.scores().items():
+            if score < cfg.degraded_below and dev not in self._health_alerted:
+                self._health_alerted.add(dev)
+                self._alert("device_health", {
+                    "device": dev, "score": score,
+                    "drift_z": round(self.health.drift_z(dev), 4),
+                    "threshold": cfg.degraded_below})
+            elif score >= cfg.degraded_below:
+                self._health_alerted.discard(dev)
+
+    def refresh_gauges(self) -> None:
+        """Recompute and publish the monitor's derived gauges into the
+        registry (called per scrape — gauges are views, not state)."""
+        self._sync_registry_devices()
+        self._check_health_alerts()
+        try:
+            reg = self.registry()
+        except Exception:  # noqa: BLE001
+            return
+        s = self.slo.snapshot()
+        reg.gauge("slo_budget_remaining").set(s["budget_remaining"])
+        reg.gauge("slo_burn_rate").set(s["burn_rate"])
+        reg.gauge("slo_window_requests").set(s["requests"])
+        if s["goodput_ratio"] is not None:
+            reg.gauge("slo_goodput_ratio").set(s["goodput_ratio"])
+        for dev, row in self.health.rows().items():
+            reg.gauge("device_health", device=dev).set(row["score"])
+            reg.gauge("device_health_drift", device=dev).set(row["drift_z"])
+
+    def metrics_text(self) -> str:
+        """The full /metrics exposition: monitor gauges refreshed, then
+        the whole registry rendered through ONE prometheus path."""
+        self.refresh_gauges()
+        return self._render_fn()(self.registry().collect())
+
+    def health_status(self) -> dict:
+        """OK / DEGRADED / FAILING with named reasons (the /healthz body).
+
+        - FAILING: any uncorrectable-result signal (``exhausted`` serve
+          outcomes, a device with uncorrectable faults scoring below
+          ``failing_below``) or an SLO burn rate past the failing factor.
+        - DEGRADED: SLO budget burning faster than allowed (burn >= 1),
+          or any device health below ``degraded_below``.
+        - OK otherwise — a clean load reports OK with all-healthy scores.
+        """
+        reasons = []
+        status = "OK"
+
+        def worsen(to: str, reason: str):
+            nonlocal status
+            reasons.append(reason)
+            if STATUSES.index(to) > STATUSES.index(status):
+                status = to
+
+        s = self.slo.snapshot()
+        if s["burn_rate"] >= self.slo.config.failing_burn_rate and \
+                s["requests"] > 0:
+            worsen("FAILING",
+                   f"slo burn rate {s['burn_rate']:.2f}x >= failing "
+                   f"threshold {self.slo.config.failing_burn_rate:.1f}x")
+        elif s["burn_rate"] >= 1.0 and s["requests"] > 0:
+            worsen("DEGRADED",
+                   f"slo error budget burning at {s['burn_rate']:.2f}x "
+                   f"allowed rate ({s['violations']}/{s['requests']} "
+                   "window requests violating)")
+        cfg = self.health.config
+        rows = self.health.rows()
+        for dev, row in sorted(rows.items(), key=lambda kv: kv[1]["score"]):
+            if row["score"] >= cfg.degraded_below:
+                continue
+            if row["uncorrectable"] > 0 and row["score"] < cfg.failing_below:
+                worsen("FAILING",
+                       f"device {dev} health {row['score']:.3f} with "
+                       f"{row['uncorrectable']} uncorrectable faults")
+            else:
+                detail = (f"{row['detected']} detections/"
+                          f"{row['calls']} calls"
+                          + (f", drift z={row['drift_z']:.1f}"
+                             if row["drift_z"] > 0 else ""))
+                worsen("DEGRADED",
+                       f"device {dev} health {row['score']:.3f} "
+                       f"below {cfg.degraded_below} ({detail})")
+        return {"status": status, "reasons": reasons,
+                "slo": s, "devices": rows,
+                "uptime_seconds": round(time.time() - self.started_unix, 3)}
+
+    def snapshot(self) -> dict:
+        """The artifact-embedded final view (``bench.py --serve`` ->
+        ``context.slo`` and the RunReport SLO section)."""
+        hs = self.health_status()
+        scores = {d: r["score"] for d, r in hs["devices"].items()}
+        return {
+            "status": hs["status"],
+            "reasons": hs["reasons"],
+            "budget_remaining": hs["slo"]["budget_remaining"],
+            "burn_rate": hs["slo"]["burn_rate"],
+            "goodput_ratio": hs["slo"]["goodput_ratio"],
+            "observed_p99_seconds": hs["slo"]["observed_p99_seconds"],
+            "objectives": hs["slo"]["objectives"],
+            "window_requests": hs["slo"]["requests"],
+            "violations": hs["slo"]["violations"],
+            "device_health": scores,
+            "device_health_min": min(scores.values()) if scores else None,
+        }
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+class MonitorServer:
+    """Threaded stdlib HTTP exporter over a :class:`Monitor`.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``) —
+    the CI-friendly default. Serves:
+
+    - ``GET /metrics``  — Prometheus text exposition (version 0.0.4).
+    - ``GET /healthz``  — JSON status/reasons; 200 for OK/DEGRADED,
+      503 for FAILING (load balancers eject on 5xx, and a DEGRADED
+      server is still producing verified results).
+    - ``GET /events?since=SEQ[&limit=N]`` — recent fault events as JSON
+      ``{"events": [...], "next": cursor}``; poll with the returned
+      cursor.
+
+    Runs on daemon threads (``ThreadingHTTPServer``) so scrapes never
+    block the dispatch path and an abandoned server never blocks process
+    exit. ``close()`` shuts the listener down."""
+
+    def __init__(self, monitor: Monitor, port: int = 0,
+                 host: str = "127.0.0.1"):
+        import http.server
+
+        mon = monitor
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+            def _send(self, code: int, body: str, ctype: str):
+                payload = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                import urllib.parse
+
+                url = urllib.parse.urlparse(self.path)
+                try:
+                    if url.path == "/metrics":
+                        self._send(200, mon.metrics_text(),
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                    elif url.path == "/healthz":
+                        hs = mon.health_status()
+                        code = 503 if hs["status"] == "FAILING" else 200
+                        self._send(code, json.dumps(hs, sort_keys=True),
+                                   "application/json")
+                    elif url.path == "/events":
+                        q = urllib.parse.parse_qs(url.query)
+                        since = int(q.get("since", ["0"])[0])
+                        limit = q.get("limit")
+                        events, cursor = mon.ring.since(
+                            since, int(limit[0]) if limit else None)
+                        self._send(200, json.dumps(
+                            {"events": events, "next": cursor},
+                            sort_keys=True), "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": f"unknown path {url.path}",
+                             "paths": ["/metrics", "/healthz",
+                                       "/events"]}), "application/json")
+                except BrokenPipeError:
+                    pass  # scraper hung up mid-response
+                except Exception as e:  # noqa: BLE001 — 500, never crash
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}),
+                            "application/json")
+                    except OSError:
+                        pass
+
+        self.monitor = monitor
+        self._httpd = http.server.ThreadingHTTPServer((host, int(port)),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "MonitorServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                daemon=True, name="ft-sgemm-monitor")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def start_monitor(port: int = 0, *, registry=None,
+                  slo: Optional[SloConfig] = None,
+                  health: Optional[HealthConfig] = None,
+                  ring_capacity: int = 512,
+                  attach: bool = True) -> Tuple[Monitor, MonitorServer]:
+    """Convenience: build a Monitor (attached to the telemetry stream
+    when ``attach``) and a started server on ``port`` (0 = ephemeral)."""
+    monitor = Monitor(registry=registry, slo=slo, health=health,
+                      ring_capacity=ring_capacity)
+    if attach:
+        monitor.attach()
+    server = MonitorServer(monitor, port=port).start()
+    return monitor, server
+
+
+__all__ = ["DeviceHealthTracker", "EventRing", "HealthConfig", "Monitor",
+           "MonitorServer", "SloConfig", "SloTracker", "STATUSES",
+           "start_monitor"]

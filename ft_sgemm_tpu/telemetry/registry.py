@@ -167,7 +167,61 @@ def _prom_name(name: str) -> str:
 
 
 def _prom_escape(v) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    """Label-value escaping per the exposition format: backslash first
+    (so the other escapes aren't double-escaped), then quote and
+    newline. Un-escaped newlines were the scrape-breaking bug the ISSUE-9
+    satellite pins: one hostile label value would tear every later
+    series off the same scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_unescape(v: str) -> str:
+    out = []
+    it = iter(v)
+    for ch in it:
+        if ch != "\\":
+            out.append(ch)
+            continue
+        nxt = next(it, "")
+        out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, "\\" + nxt))
+    return "".join(out)
+
+
+# ``# HELP`` strings for the families the stack emits. Prometheus
+# scrapers (and humans reading a /metrics dump) get one line of intent
+# per family; unknown names fall back to a generic string rather than
+# omitting the line — the exposition stays uniformly self-describing.
+_METRIC_HELP = {
+    "ft_calls": "FT GEMM/attention calls recorded",
+    "ft_detections": "ABFT fault detections (summed per-call counters)",
+    "ft_corrected": "In-kernel corrected faults",
+    "ft_uncorrectable": "Residual-after-correct failures (unverified output)",
+    "ft_softmax_flags": "Attention softmax-stage invariant flags",
+    "ft_residual": "Max |checksum residual| per measured call",
+    "ft_step_events": "Recovery-ladder transitions (retry/restore/raise)",
+    "ft_device_calls": "Per-device FT calls (mesh attribution)",
+    "ft_device_detections": "Per-device fault detections (mesh attribution)",
+    "ft_device_uncorrectable": "Per-device uncorrectable faults",
+    "serve_requests": "Serve requests accepted per bucket",
+    "serve_batches": "Serve batches flushed per bucket",
+    "serve_retries": "Bucket-scoped serve retries",
+    "serve_rejected": "Requests rejected (bucket overflow)",
+    "serve_corrected_free": "Requests whose SDC was corrected in-kernel",
+    "serve_uncorrectable_exhausted": "Requests still uncorrectable "
+                                    "after bounded retries",
+    "serve_latency_seconds": "End-to-end serve request latency",
+    "slo_budget_remaining": "Fraction of the rolling-window SLO error "
+                            "budget left (0 = exhausted)",
+    "slo_burn_rate": "SLO violation rate over allowed rate (>=1 burns "
+                     "budget faster than allowed)",
+    "slo_window_requests": "Requests inside the rolling SLO window",
+    "slo_goodput_ratio": "OK-and-within-latency fraction of the window",
+    "device_health": "Continuous per-device health score in (0, 1] "
+                     "(1 = healthy; see DESIGN.md §12)",
+    "device_health_drift": "Residual-distribution drift z-score per "
+                           "device (creep toward the threshold)",
+}
 
 
 def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
@@ -196,8 +250,10 @@ def to_prometheus(series: Sequence[dict]) -> str:
     Counters and gauges map directly; histograms emit the standard
     cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
     Metric names are sanitized (``compile.seconds`` ->
-    ``compile_seconds``); a ``# TYPE`` line precedes each metric family
-    once."""
+    ``compile_seconds``); ``# HELP`` and ``# TYPE`` lines precede each
+    metric family once, and label values are fully escaped
+    (backslash/quote/newline) — :func:`parse_prometheus` round-trips the
+    output, the scrape-cleanliness contract the tests pin."""
     by_name: dict = {}
     for s in series:
         by_name.setdefault((_prom_name(s["name"]), s["kind"]), []).append(s)
@@ -205,6 +261,10 @@ def to_prometheus(series: Sequence[dict]) -> str:
     for (name, kind), group in sorted(by_name.items()):
         prom_kind = {"counter": "counter", "gauge": "gauge",
                      "histogram": "histogram"}.get(kind, "untyped")
+        help_text = _METRIC_HELP.get(
+            name, f"ft_sgemm_tpu metric {name}").replace(
+            "\\", "\\\\").replace("\n", "\\n")
+        lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {prom_kind}")
         for s in group:
             labels = s.get("labels") or {}
@@ -222,6 +282,108 @@ def to_prometheus(series: Sequence[dict]) -> str:
             lines.append(f"{name}_count{_prom_labels(labels)} "
                          f"{v['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _parse_label_block(block: str) -> dict:
+    """Parse ``{k="v",...}`` honoring escaped quotes/backslashes/newlines."""
+    import re
+
+    if not block:
+        return {}
+    labels = {}
+    for m in re.finditer(r'([a-zA-Z_:][a-zA-Z0-9_:]*)="((?:[^"\\]|\\.)*)"',
+                         block):
+        labels[m.group(1)] = _prom_unescape(m.group(2))
+    return labels
+
+
+def _parse_num(tok: str) -> float:
+    if tok == "+Inf":
+        return float("inf")
+    if tok == "-Inf":
+        return float("-inf")
+    return float(tok)
+
+
+def parse_prometheus(text: str) -> list:
+    """Parse a text-exposition document back into
+    :meth:`MetricsRegistry.collect`-shaped series dicts.
+
+    The inverse of :func:`to_prometheus` — used by the round-trip test
+    that pins the exposition scrape-clean, and by ``cli top``, which
+    scrapes a live ``/metrics`` endpoint and reconstructs the registry
+    view a remote process holds. Histogram ``_bucket``/``_sum``/
+    ``_count`` sample families reassemble into one histogram series with
+    NON-cumulative counts (the in-process representation); counters with
+    integral values come back as ints. Raises ``ValueError`` on a line
+    that is neither a comment nor a well-formed sample — a torn scrape
+    should be loud, not silently half-parsed."""
+    import re
+
+    kinds: dict = {}
+    samples = []  # (name, labels, value) in document order
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$")
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) == 4:
+                kinds[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # HELP / foreign comments
+        m = sample_re.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, block, num = m.groups()
+        samples.append((name, _parse_label_block(block or ""),
+                        _parse_num(num)))
+
+    hist_names = {n for n, k in kinds.items() if k == "histogram"}
+    out = []
+    hists: dict = {}  # (name, labelkey) -> {"buckets": {...}, ...}
+    for name, labels, value in samples:
+        base = None
+        part = None
+        for nm in hist_names:
+            if name == nm + "_bucket" and "le" in labels:
+                base, part = nm, "bucket"
+            elif name == nm + "_sum":
+                base, part = nm, "sum"
+            elif name == nm + "_count":
+                base, part = nm, "count"
+            if base:
+                break
+        if base is None:
+            kind = kinds.get(name, "gauge")
+            v = value
+            if kind == "counter" and float(v).is_integer():
+                v = int(v)
+            out.append({"kind": "counter" if kind == "counter" else "gauge",
+                        "name": name, "labels": dict(labels), "value": v})
+            continue
+        key_labels = {k: v for k, v in labels.items() if k != "le"}
+        key = (base, tuple(sorted(key_labels.items())))
+        h = hists.setdefault(key, {"labels": key_labels, "buckets": {},
+                                   "sum": 0.0, "count": 0})
+        if part == "bucket":
+            h["buckets"][_parse_num(labels["le"])] = int(value)
+        elif part == "sum":
+            h["sum"] = value
+        else:
+            h["count"] = int(value)
+    for (base, _), h in hists.items():
+        ubs = sorted(h["buckets"])
+        cum = [h["buckets"][ub] for ub in ubs]
+        counts = [c - (cum[i - 1] if i else 0) for i, c in enumerate(cum)]
+        out.append({"kind": "histogram", "name": base,
+                    "labels": dict(h["labels"]),
+                    "value": {"buckets": ubs, "counts": counts,
+                              "sum": h["sum"], "count": h["count"]}})
+    return out
 
 
 class MetricsRegistry:
@@ -294,4 +456,4 @@ class MetricsRegistry:
 
 __all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
            "LATENCY_BUCKETS", "MetricsRegistry", "histogram_percentiles",
-           "to_prometheus"]
+           "parse_prometheus", "to_prometheus"]

@@ -87,6 +87,29 @@ def summarize_artifact(path, obj):
         done = ctx.get("completed_stages")
         if done:
             print(f"   {'completed stages':34s} {', '.join(done)}")
+    slo = ctx.get("slo")
+    if isinstance(slo, dict):
+        # Serving artifacts carry the final SLO/error-budget + health
+        # snapshot (telemetry/monitor.py) — the fleet-facing numbers.
+        status = slo.get("status", "?")
+        reasons = slo.get("reasons") or []
+        print(f"   {'slo status':34s} {status}"
+              + ("  (" + "; ".join(str(r) for r in reasons) + ")"
+                 if reasons else ""))
+        budget = slo.get("budget_remaining")
+        burn = slo.get("burn_rate")
+        if budget is not None or burn is not None:
+            print(f"   {'slo error budget':34s} "
+                  f"remaining {budget if budget is not None else '?'}"
+                  f"  burn {burn if burn is not None else '?'}x")
+        hmin = slo.get("device_health_min")
+        if hmin is not None:
+            worst = ""
+            dh = slo.get("device_health") or {}
+            if dh:
+                dev = min(dh, key=dh.get)
+                worst = f"  (worst: {dev})"
+            print(f"   {'device health min':34s} {hmin}{worst}")
     for name, e in (ctx.get("errors") or {}).items():
         first = str(e).splitlines()[0] if e else ""
         print(f"   {name:34s} ERROR: {first[:90]}")

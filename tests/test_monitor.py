@@ -1,0 +1,768 @@
+"""Live observability plane tests (ISSUE 9): request-scoped trace IDs
+joined across serve/retry events via the /events endpoint, the stdlib
+HTTP exporter (/metrics, /healthz, /events), SLO error budgets with
+edge-triggered alerts, continuous device-health scoring (mesh
+``inject_coords`` localization goes LIVE), the scrape-clean Prometheus
+exposition (# HELP/# TYPE + label escaping, pinned by a parser
+round-trip), concurrent scrape-during-serve safety, `cli top`,
+`cli telemetry --watch`, and the zero-overhead-off pin."""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import telemetry
+from ft_sgemm_tpu.serve import (
+    ServeEngine,
+    ServeRequest,
+    default_bucket_set,
+)
+from ft_sgemm_tpu.serve.tracing import (
+    current_trace_id,
+    new_trace_id,
+    stamp,
+    trace_scope,
+)
+from ft_sgemm_tpu.telemetry.monitor import (
+    DeviceHealthTracker,
+    EventRing,
+    HealthConfig,
+    Monitor,
+    MonitorServer,
+    SloConfig,
+    SloTracker,
+)
+from ft_sgemm_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    parse_prometheus,
+    to_prometheus,
+)
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# NOTE on ordering: the module-scoped ``served`` fixture shares the
+# process-wide telemetry registry, so tests that RESET global telemetry
+# (the mesh-localization acceptance test) are placed after every
+# served-dependent test — file order is execution order under the
+# suite's no-randomization config.
+
+
+# ---------------------------------------------------------------------------
+# Tracing primitives
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ids_are_unique_and_scoped():
+    ids = {new_trace_id() for _ in range(256)}
+    assert len(ids) == 256
+    assert all(len(t) == 16 for t in ids)
+    assert current_trace_id() is None
+    with trace_scope("outer123"):
+        assert current_trace_id() == "outer123"
+        with trace_scope("inner456"):
+            assert current_trace_id() == "inner456"
+        assert current_trace_id() == "outer123"
+    assert current_trace_id() is None
+
+
+def test_stamp_merges_without_overwriting():
+    assert stamp(None) is None  # no ambient id: untouched
+    with trace_scope("t1"):
+        assert stamp(None) == {"trace_id": "t1"}
+        assert stamp({"k": 1}) == {"k": 1, "trace_id": "t1"}
+        # An explicit id on the event wins over the ambient scope.
+        assert stamp({"trace_id": "explicit"}) == {"trace_id": "explicit"}
+    assert stamp({"k": 1}, trace_id="t2") == {"k": 1, "trace_id": "t2"}
+
+
+# ---------------------------------------------------------------------------
+# Event ring
+# ---------------------------------------------------------------------------
+
+
+def test_event_ring_since_semantics():
+    ring = EventRing(capacity=4)
+    for i in range(6):
+        ring.append({"i": i})
+    events, cursor = ring.since(0)
+    assert cursor == 6
+    assert [e["i"] for e in events] == [2, 3, 4, 5]  # capacity-bounded
+    newer, cursor2 = ring.since(cursor)
+    assert newer == [] and cursor2 == 6
+    ring.append({"i": 6})
+    newer, _ = ring.since(cursor)
+    assert [e["i"] for e in newer] == [6]
+    limited, _ = ring.since(0, limit=2)
+    assert [e["i"] for e in limited] == [5, 6]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: scrape-clean + parser round trip (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_has_help_and_type_per_family():
+    reg = MetricsRegistry()
+    reg.counter("ft_detections", op="x").inc(3)
+    reg.gauge("device_health", device="d0").set(0.5)
+    reg.histogram("serve_latency_seconds",
+                  buckets=LATENCY_BUCKETS).observe(0.01)
+    text = to_prometheus(reg.collect())
+    for family in ("ft_detections", "device_health",
+                   "serve_latency_seconds"):
+        assert f"# HELP {family} " in text
+        assert f"# TYPE {family} " in text
+        # HELP precedes TYPE precedes samples (exposition convention).
+        assert text.index(f"# HELP {family}") < text.index(
+            f"# TYPE {family}")
+    # Known families carry real help strings, not the generic fallback.
+    assert "# HELP device_health Continuous per-device health" in text
+
+
+def test_prometheus_label_escaping_and_round_trip():
+    """The exposition is scrape-clean: hostile label values (newlines,
+    quotes, backslashes) escape correctly and the whole document parses
+    back into the exact collect() snapshot."""
+    reg = MetricsRegistry()
+    reg.counter("ft_calls", op='quo"te', layer="back\\slash").inc(2)
+    reg.counter("ft_calls", op="multi\nline").inc(5)
+    reg.gauge("device_health", device="TFRT_CPU_0").set(0.875)
+    h = reg.histogram("ft_residual", buckets=(1.0, 10.0, float("inf")),
+                      op="a b")
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(1e9)
+    text = to_prometheus(reg.collect())
+    # The hostile values came out escaped, not raw (a raw newline in a
+    # label value would tear every later series off the scrape).
+    assert 'op="multi\\nline"' in text
+    assert 'op="quo\\"te"' in text
+    assert 'layer="back\\\\slash"' in text
+    parsed = parse_prometheus(text)
+
+    def norm(series):
+        return sorted(
+            (json.dumps({"kind": s["kind"], "name": s["name"],
+                         "labels": s["labels"], "value": s["value"]},
+                        sort_keys=True))
+            for s in series)
+
+    # Names sanitize identically on both sides (no dots in these), so
+    # the round trip is exact: kinds, labels, values, histogram buckets.
+    assert norm(parsed) == norm(
+        [{"kind": s["kind"], "name": s["name"], "labels": s["labels"],
+          "value": (dict(s["value"],
+                         buckets=[float(b) for b in s["value"]["buckets"]])
+                    if s["kind"] == "histogram" else s["value"])}
+         for s in reg.collect()])
+
+
+def test_parse_prometheus_rejects_torn_lines():
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_prometheus("ft_calls{op=\"x\"} ")
+
+
+def test_monitor_and_tracing_load_without_the_package(tmp_path):
+    """The timeline discipline extended: monitor.py and tracing.py are
+    stdlib-only at module scope and work loaded by FILE PATH (the
+    jax-free exporter constraint — in-package collaborators are lazy
+    and injectable)."""
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "ft_sgemm_tpu"
+
+    def load(rel, name):
+        spec = importlib.util.spec_from_file_location(name, root / rel)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    tr = load("serve/tracing.py", "_standalone_tracing")
+    with tr.trace_scope(tr.new_trace_id()) as tid:
+        assert tr.current_trace_id() == tid
+
+    mon_mod = load("telemetry/monitor.py", "_standalone_monitor")
+    alerts = []
+    mon = mon_mod.Monitor(
+        registry=MetricsRegistry(), render=to_prometheus,
+        emit_alert=alerts.append,
+        slo=mon_mod.SloConfig(p99_latency_seconds=0.001, budget=0.01))
+    mon.observe_request({"outcome": "clean", "op": "serve_gemm",
+                         "device": "d0",
+                         "extra": {"latency_seconds": 1.0, "ok": True}})
+    assert alerts and alerts[0]["extra"]["kind"] == "slo_burn"
+    srv = mon_mod.MonitorServer(mon, port=0).start()
+    try:
+        _, text = _get(srv.url + "/metrics")
+        assert "slo_burn_rate" in text
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+
+def test_slo_budget_and_burn_math():
+    slo = SloTracker(SloConfig(p99_latency_seconds=1.0, budget=0.1,
+                               window_seconds=3600.0))
+    for _ in range(18):
+        slo.record(0.1, True)
+    s = slo.snapshot()
+    assert s["burn_rate"] == 0.0 and s["budget_remaining"] == 1.0
+    slo.record(5.0, True)   # latency violation
+    slo.record(0.1, False)  # failure violation
+    s = slo.snapshot()
+    assert s["requests"] == 20 and s["violations"] == 2
+    # 2/20 violating at a 10% budget -> burn exactly 1.0.
+    assert s["burn_rate"] == pytest.approx(1.0)
+    assert s["budget_remaining"] == pytest.approx(0.0)
+    assert s["goodput_ratio"] == pytest.approx(18 / 20)
+    assert s["observed_p99_seconds"] == pytest.approx(5.0)
+
+
+def test_slo_alert_fires_once_on_crossing_and_rearms():
+    fired = []
+    slo = SloTracker(SloConfig(p99_latency_seconds=1.0, budget=0.5,
+                               window_seconds=0.5),
+                     on_alert=fired.append)
+    t = 1000.0
+    slo.record(9.0, False, now=t)  # 1/1 violating, burn 2.0 -> alert
+    assert len(fired) == 1 and fired[0]["burn_rate"] >= 1.0
+    slo.record(9.0, False, now=t + 0.01)  # still burning: NO new edge
+    assert len(fired) == 1
+    # Window rolls past the violations -> burn drops to 0 -> re-armed.
+    for i in range(10):
+        slo.record(0.1, True, now=t + 1.0 + i * 0.01)
+    assert slo.snapshot(now=t + 1.2)["burn_rate"] == 0.0
+    slo.record(9.0, False, now=t + 2.0)
+    slo.record(9.0, False, now=t + 2.01)
+    assert len(fired) == 2
+
+
+def test_slo_alert_lands_in_jsonl_stream(tmp_path):
+    """The threshold-crossing alert is a normal JSONL event: outcome
+    "alert", op "monitor", crossing facts in extra."""
+    log = tmp_path / "ev.jsonl"
+    telemetry.reset()
+    telemetry.configure(log)
+    mon = Monitor(slo=SloConfig(p99_latency_seconds=0.001, budget=0.01,
+                                window_seconds=60.0))
+    mon.observe_request({"outcome": "clean", "op": "serve_gemm",
+                         "device": "d0",
+                         "extra": {"latency_seconds": 5.0, "ok": True}})
+    telemetry.disable()
+    alerts = [e for e in telemetry.read_events(log)
+              if e.outcome == "alert"]
+    assert len(alerts) == 1
+    assert alerts[0].op == "monitor"
+    assert alerts[0].extra["kind"] == "slo_burn"
+    assert alerts[0].extra["burn_rate"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Device health
+# ---------------------------------------------------------------------------
+
+
+def test_device_health_clean_is_one_faulty_ranks_below():
+    t = DeviceHealthTracker()
+    t.observe("clean", calls=10)
+    t.observe("noisy", calls=10, detected=10)
+    t.observe("broken", calls=10, detected=10, uncorrectable=5)
+    s = t.scores()
+    assert s["clean"] == 1.0
+    assert s["broken"] < s["noisy"] < s["clean"]
+
+
+def test_device_health_drift_flags_before_uncorrectables():
+    """Residual creep toward the threshold lowers the score with ZERO
+    fault counts on the books — the early-warning the ISSUE names."""
+    cfg = HealthConfig(drift_min_n=20)
+    t = DeviceHealthTracker(cfg)
+    rng = np.random.default_rng(0)
+    for _ in range(50):  # baseline: residuals ~1e-3
+        t.observe("d0", calls=1,
+                  residual=1e-3 * (1 + 0.05 * rng.standard_normal()))
+    healthy = t.score("d0")
+    # Stationary jitter stays inside the drift grace: score holds at 1.
+    assert healthy > 0.95
+    for _ in range(8):  # creep: two decades toward the threshold
+        t.observe("d0", calls=1, residual=1e-1)
+    assert t.drift_z("d0") > 1.5
+    assert t.score("d0") < 0.8 < healthy
+    # Still zero faults: this is drift detection, not fault counting.
+    assert t.rows()["d0"]["detected"] == 0
+    assert t.rows()["d0"]["uncorrectable"] == 0
+
+
+def test_sync_counts_is_idempotent():
+    t = DeviceHealthTracker()
+    t.sync_counts("d0", calls=8, detected=4, uncorrectable=0)
+    first = t.score("d0")
+    t.sync_counts("d0", calls=8, detected=4, uncorrectable=0)
+    assert t.score("d0") == first  # re-scrape never double-counts
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_server_endpoints():
+    mon = Monitor(registry=MetricsRegistry())
+    mon.observe_request({"outcome": "clean", "op": "serve_gemm",
+                         "device": "d0",
+                         "extra": {"latency_seconds": 0.01, "ok": True,
+                                   "trace_id": "abc"}})
+    srv = MonitorServer(mon, port=0).start()
+    try:
+        assert srv.port > 0
+        code, metrics = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "slo_budget_remaining 1.0" in metrics
+        assert 'device_health{device="d0"} 1.0' in metrics
+        parse_prometheus(metrics)  # valid exposition
+        code, health = _get(srv.url + "/healthz")
+        assert code == 200
+        h = json.loads(health)
+        assert h["status"] == "OK" and h["reasons"] == []
+        code, ev = _get(srv.url + "/events?since=0")
+        body = json.loads(ev)
+        assert body["next"] == 1
+        assert body["events"][0]["extra"]["trace_id"] == "abc"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_healthz_failing_returns_503():
+    mon = Monitor(registry=MetricsRegistry())
+    mon.health.observe("dead", calls=10, detected=10, uncorrectable=10)
+    assert mon.health_status()["status"] == "FAILING"
+    srv = MonitorServer(mon, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["status"] == "FAILING"
+        assert any("dead" in r for r in body["reasons"])
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: serve engine + monitor
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One monitored, prewarmed engine that served a known load: a
+    correctable-injected request, a clean one, and an adversarial one
+    (whose uncorrectable fault costs a bucket-scoped retry). Shared by
+    the trace-join / exporter / concurrency tests below."""
+    telemetry.reset()
+    registry = telemetry.configure(None, log_clean=True)
+    mon = Monitor(registry=registry,
+                  slo=SloConfig(p99_latency_seconds=600.0)).attach()
+    srv = MonitorServer(mon, port=0).start()
+    rng = np.random.default_rng(10)
+    eng = ServeEngine(default_bucket_set((128, 256)), max_batch=2,
+                      max_wait=0.02, retry_backoff=0.001, monitor=mon)
+    eng.start()
+    eng.prewarm()
+
+    def req(m, n, k, variant):
+        return ServeRequest(
+            a=rng.standard_normal((m, k)).astype(np.float32),
+            b=rng.standard_normal((n, k)).astype(np.float32),
+            variant=variant)
+
+    requests = {"inject": req(200, 180, 160, "inject"),
+                "clean": req(64, 64, 64, "clean"),
+                "adversarial": req(200, 200, 200, "adversarial")}
+    results = {name: eng.submit(r).result(timeout=300.0)
+               for name, r in requests.items()}
+    eng.drain(timeout=60.0)
+    yield {"engine": eng, "monitor": mon, "server": srv,
+           "requests": requests, "results": results, "rng": rng}
+    eng.close()
+    srv.close()
+    mon.detach()
+    telemetry.reset()
+
+
+def test_trace_join_via_events_endpoint(served):
+    """THE acceptance pin: one injected request's trace_id links its
+    serve_gemm event (with tile blame), and the adversarial request's
+    trace_id links its serve_gemm event AND its retry event — all read
+    from the live /events endpoint, not the JSONL file."""
+    _, body = _get(served["server"].url + "/events?since=0")
+    events = json.loads(body)["events"]
+    serve_evs = {e["extra"]["trace_id"]: e for e in events
+                 if e.get("op") == "serve_gemm"}
+    retry_evs = [e for e in events if e.get("outcome") == "retry"]
+
+    inj = served["requests"]["inject"]
+    res = served["results"]["inject"]
+    assert res.trace_id == inj.trace_id  # response carries the trace
+    ev = serve_evs[inj.trace_id]
+    assert ev["outcome"] == "corrected"
+    assert ev["tiles"], "tile blame missing from the traced event"
+    assert ev["tiles"] == res.blame_tiles
+    assert ev["extra"]["request_id"] == inj.request_id
+    assert ev["device"], "device attribution missing"
+
+    adv = served["requests"]["adversarial"]
+    adv_res = served["results"]["adversarial"]
+    assert adv_res.retries >= 1 and adv_res.ok
+    adv_ev = serve_evs[adv.trace_id]
+    assert adv_ev["extra"]["retries"] >= 1
+    joined = [e for e in retry_evs
+              if e["extra"]["trace_id"] == adv.trace_id]
+    assert joined, "retry event does not join the adversarial trace"
+    assert joined[0]["extra"]["request_id"] == adv.request_id
+
+    clean_ev = serve_evs[served["requests"]["clean"].trace_id]
+    assert clean_ev["outcome"] == "clean" and not clean_ev.get("tiles")
+
+
+def test_trace_id_spans_jsonl_and_timeline(tmp_path):
+    """The same trace_id lands in the JSONL fault event, the retry
+    ladder event, AND the timeline's enqueue/batch records — the
+    one-grep join across every stream."""
+    from ft_sgemm_tpu.telemetry import timeline as tl_mod
+
+    log = tmp_path / "ev.jsonl"
+    tl_path = str(tmp_path / "serve.tl.jsonl")
+    telemetry.configure(log, log_clean=True)
+    rng = np.random.default_rng(3)
+    eng = ServeEngine(default_bucket_set((256,)), max_batch=1,
+                      max_wait=0.01, retry_backoff=0.001,
+                      timeline=tl_path)
+    eng.start()
+    try:
+        r = ServeRequest(
+            a=rng.standard_normal((200, 200)).astype(np.float32),
+            b=rng.standard_normal((200, 200)).astype(np.float32),
+            variant="adversarial")
+        assert eng.submit(r).result(timeout=300.0).ok
+    finally:
+        eng.close()
+        telemetry.disable()
+    evs = list(telemetry.read_events(log))
+    assert any(e.op == "serve_gemm"
+               and e.extra.get("trace_id") == r.trace_id for e in evs)
+    assert any(e.outcome == "retry"
+               and e.extra.get("trace_id") == r.trace_id for e in evs)
+    records = tl_mod.read_timeline(tl_path)
+    assert any(rec.get("name") == "enqueue"
+               and rec.get("trace_id") == r.trace_id for rec in records)
+    assert any(rec.get("kind") == "stage"
+               and r.trace_id in (rec.get("trace_ids") or [])
+               for rec in records)
+
+
+def test_metrics_exposition_covers_serve_and_health(served):
+    _, text = _get(served["server"].url + "/metrics")
+    assert "serve_latency_seconds_bucket" in text
+    assert "slo_budget_remaining" in text and "slo_burn_rate" in text
+    gauges = re.findall(r'device_health\{device="([^"]+)"\} ([0-9.eE+-]+)',
+                        text)
+    assert gauges and all(0.0 < float(v) <= 1.0 for _, v in gauges)
+    series = parse_prometheus(text)  # the exposition stays parseable
+    hist = [s for s in series if s["name"] == "serve_latency_seconds"
+            and not s["labels"]]
+    assert hist and hist[0]["value"]["count"] >= 3
+
+
+def test_slo_snapshot_and_artifact_shape(served):
+    snap = served["monitor"].snapshot()
+    assert snap["status"] in ("OK", "DEGRADED", "FAILING")
+    assert snap["window_requests"] >= 3
+    assert 0.0 <= snap["budget_remaining"] <= 1.0
+    assert snap["device_health"] and snap["device_health_min"] is not None
+    assert snap["device_health_min"] == min(snap["device_health"].values())
+
+
+def test_concurrent_scrape_during_serve(served):
+    """Satellite: hammer /metrics from threads while the engine drains an
+    injected load — no exceptions, monotone counters between scrapes,
+    and a valid final exposition."""
+    url = served["server"].url
+    errors = []
+    totals = []
+    stop = threading.Event()
+
+    def scraper():
+        last = -1.0
+        try:
+            while not stop.is_set():
+                _, text = _get(url + "/metrics")
+                series = parse_prometheus(text)
+                total = sum(s["value"] for s in series
+                            if s["name"] == "serve_requests")
+                assert total >= last, (total, last)  # counters monotone
+                last = total
+                totals.append(total)
+        except Exception as e:  # noqa: BLE001 — the test's whole point
+            errors.append(e)
+
+    threads = [threading.Thread(target=scraper) for _ in range(4)]
+    for t in threads:
+        t.start()
+    eng, rng = served["engine"], served["rng"]
+    futs = []
+    for i in range(12):
+        variant = "inject" if i % 3 == 0 else "clean"
+        futs.append(eng.submit(ServeRequest(
+            a=rng.standard_normal((100, 90)).astype(np.float32),
+            b=rng.standard_normal((80, 90)).astype(np.float32),
+            variant=variant)))
+    for f in futs:
+        assert f.result(timeout=300.0).ok
+    eng.drain(timeout=60.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors, errors
+    assert totals, "scrapers never completed a scrape"
+    _, final = _get(url + "/metrics")
+    series = parse_prometheus(final)  # final exposition valid
+    assert sum(s["value"] for s in series
+               if s["name"] == "serve_requests") >= 15
+
+
+def test_monitor_off_is_byte_identical(served):
+    """Zero overhead when off: monitor= changes NOTHING about the
+    compiled serve executables — the lowered HLO of a bucket's kernel is
+    byte-identical with and without a monitor (the --telemetry
+    discipline from PR 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    bucket = default_bucket_set((128,))[0]
+
+    def lowered(monitor):
+        eng = ServeEngine([bucket], monitor=monitor)
+        kern = eng._kernel(bucket, "clean")
+        spec = eng._variant_spec(bucket, "clean")
+        avals = [jax.ShapeDtypeStruct((128, 128), jnp.float32)] * 3
+        return jax.jit(lambda a, b, c: kern(a, b, c, spec)).lower(
+            *avals).as_text()
+
+    assert lowered(None) == lowered(served["monitor"])
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: top + telemetry --watch
+# ---------------------------------------------------------------------------
+
+
+def test_cli_top_renders_live_view(served, capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "top", served["server"].url, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "health:" in out
+    assert "slo: budget remaining" in out
+    assert "device health:" in out
+    assert "bucket" in out and "p99" in out
+    assert re.search(r"trace=[0-9a-f]{16}", out), "event tail lost traces"
+
+
+def test_cli_top_unreachable_exits_2(capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "top", "http://127.0.0.1:9/", "--once",
+                   "--interval=0.01"])
+    assert rc == 2
+
+
+def test_cli_telemetry_watch_follows_growing_log(tmp_path, capsys):
+    """Satellite: --watch tails a shard that grows WHILE the watcher
+    runs — the late-appended events appear in a re-rendered summary."""
+    from ft_sgemm_tpu import cli
+    from ft_sgemm_tpu.telemetry.events import FaultEvent
+
+    log = tmp_path / "grow.jsonl"
+    log.write_text(FaultEvent(outcome="corrected", op="early",
+                              detected=1, corrected=1).to_json() + "\n")
+
+    def appender():
+        time.sleep(0.4)
+        with open(log, "a") as fh:
+            for _ in range(3):
+                fh.write(FaultEvent(outcome="uncorrectable", op="late",
+                                    detected=2,
+                                    uncorrectable=1).to_json() + "\n")
+                fh.flush()
+
+    t = threading.Thread(target=appender)
+    t.start()
+    rc = cli.main(["cli", "telemetry", str(log), "--watch",
+                   "--watch-seconds=1.5", "--interval=0.1"])
+    t.join(timeout=10.0)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "early" in out
+    assert "late" in out, "appended events never surfaced"
+    # Re-summarized: a later frame counts all four events.
+    assert "(4 events)" in out
+    assert out.index("(1 events)") < out.index("(4 events)")
+
+
+def test_cli_telemetry_watch_waits_for_missing_file(tmp_path, capsys):
+    from ft_sgemm_tpu import cli
+
+    rc = cli.main(["cli", "telemetry", str(tmp_path / "nope.jsonl"),
+                   "--watch", "--watch-seconds=0.2", "--interval=0.05"])
+    assert rc == 0  # absent file = empty stream, not an error
+    assert "(0 events)" in capsys.readouterr().out
+
+
+def test_watch_skips_torn_tail_until_complete(tmp_path, capsys):
+    from ft_sgemm_tpu import cli
+
+    log = tmp_path / "t.jsonl"
+    log.write_text('{"outcome": "corrected", "op": "ok", "detected": 1}\n'
+                   '{"outcome": "corrected", "op": "tornop", "det')
+    rc = cli.main(["cli", "telemetry", str(log), "--watch",
+                   "--watch-seconds=0.2", "--interval=0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "(1 events)" in out and "tornop" not in out
+
+
+# ---------------------------------------------------------------------------
+# Mesh localization goes live (acceptance). Runs AFTER every
+# served-dependent test: its cleanup resets process-wide telemetry.
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_injected_device_ranks_worst_and_healthz_degrades(rng):
+    """Acceptance: under a single-device inject_coords load on the
+    8-vdev CPU mesh, /metrics ranks the injected device worst with every
+    other device at 1.0, and /healthz reports DEGRADED naming it; a
+    clean load reports OK with all-healthy scores."""
+    from ft_sgemm_tpu import InjectionSpec
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.parallel import make_mesh, sharded_ft_sgemm
+
+    a = rng.standard_normal((256, 512)).astype(np.float32)
+    b = rng.standard_normal((128, 512)).astype(np.float32)
+    c = rng.standard_normal((256, 128)).astype(np.float32)
+    mesh = make_mesh(8)
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+    target = (1, 2)
+    target_dev = str(mesh.devices[target[0]][target[1]])
+
+    def run(inject):
+        telemetry.reset()
+        registry = telemetry.configure(None, log_clean=True)
+        mon = Monitor(registry=registry).attach()
+        srv = MonitorServer(mon, port=0).start()
+        try:
+            for _ in range(3):
+                kwargs = ({"inject": InjectionSpec(enabled=True, every=1),
+                           "inject_coords": target} if inject else {})
+                sharded_ft_sgemm(a, b, c, mesh, tile, **kwargs)
+            _, text = _get(srv.url + "/metrics")
+            gauges = {d: float(v) for d, v in re.findall(
+                r'device_health\{device="([^"]+)"\} ([0-9.eE+-]+)', text)}
+            try:
+                _, body = _get(srv.url + "/healthz")
+                health = json.loads(body)
+            except urllib.error.HTTPError as e:
+                health = json.loads(e.read().decode())
+            return gauges, health
+        finally:
+            srv.close()
+            mon.detach()
+            telemetry.reset()
+
+    gauges, health = run(inject=True)
+    assert len(gauges) == 8, gauges
+    assert min(gauges, key=gauges.get) == target_dev
+    assert gauges[target_dev] < 0.9
+    assert all(v == 1.0 for d, v in gauges.items() if d != target_dev)
+    assert health["status"] == "DEGRADED"
+    assert any(target_dev in r for r in health["reasons"])
+
+    clean_gauges, clean_health = run(inject=False)
+    assert len(clean_gauges) == 8
+    assert all(v == 1.0 for v in clean_gauges.values())
+    assert clean_health["status"] == "OK" and not clean_health["reasons"]
+
+
+# ---------------------------------------------------------------------------
+# Serve-bench artifact carries the SLO section
+# ---------------------------------------------------------------------------
+
+
+def test_run_serve_bench_embeds_slo_and_health(tmp_path):
+    from ft_sgemm_tpu.serve import run_serve_bench
+
+    stats = run_serve_bench(smoke=True, bucket_sizes=(128, 256),
+                            num_requests=6, inject_rate=0.5,
+                            adversarial_rate=0.0)
+    slo = stats["slo"]
+    assert slo["status"] in ("OK", "DEGRADED", "FAILING")
+    assert slo["window_requests"] == stats["completed"] > 0
+    assert 0.0 <= slo["budget_remaining"] <= 1.0
+    assert stats["device_health"]
+    assert slo["device_health_min"] is not None
+    # And the RunReport SLO section renders it.
+    from ft_sgemm_tpu.perf.report import RunReport
+
+    rr = RunReport(manifest={}, slo=slo)
+    md = rr.to_markdown()
+    assert "## SLO" in md and "error budget remaining" in md
+    assert RunReport.from_dict(rr.to_dict()).slo == slo
+
+
+def test_run_serve_bench_monitor_port_serves_http():
+    from ft_sgemm_tpu.serve import run_serve_bench
+
+    seen = {}
+
+    class _Probe:
+        """Timeline stand-in: grab the live URL mid-run and scrape it."""
+
+        path = None
+
+        def point(self, kind, name, **fields):
+            if "monitor_url" in fields:
+                seen["url"] = fields["monitor_url"]
+                _, text = _get(fields["monitor_url"] + "/metrics")
+                seen["scrape"] = text
+
+        def span(self, *a, **k):
+            import contextlib
+
+            return contextlib.nullcontext({})
+
+    stats = run_serve_bench(smoke=True, bucket_sizes=(128,),
+                            num_requests=3, inject_rate=0.0,
+                            adversarial_rate=0.0, monitor_port=0,
+                            timeline=_Probe())
+    assert stats["monitor_url"] == seen["url"]
+    assert "slo_budget_remaining" in seen["scrape"]

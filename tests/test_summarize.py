@@ -63,3 +63,44 @@ def test_summarizer_annotates_partial_salvaged_artifact(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0
     assert "PARTIAL" not in out.stdout
+
+
+def test_summarizer_surfaces_slo_section(tmp_path):
+    """A serving artifact's slo section (telemetry/monitor.py snapshot)
+    renders as summary rows: status + named reasons, error budget
+    remaining / burn rate, and the device-health minimum with the worst
+    device named — and an artifact WITHOUT one renders no slo rows."""
+    p = tmp_path / "serve_artifact.json"
+    p.write_text(json.dumps({
+        "metric": "serve_goodput_rps", "value": 4.2,
+        "unit": "requests/s", "vs_baseline": None,
+        "context": {
+            "goodput_rps": 4.2,
+            "slo": {"status": "DEGRADED",
+                    "reasons": ["device TFRT_CPU_6 health 0.368 "
+                                "below 0.9"],
+                    "budget_remaining": 0.75, "burn_rate": 0.25,
+                    "goodput_ratio": 0.99,
+                    "device_health": {"TFRT_CPU_0": 1.0,
+                                      "TFRT_CPU_6": 0.368},
+                    "device_health_min": 0.368}},
+    }))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(p)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "slo status" in out.stdout and "DEGRADED" in out.stdout
+    assert "TFRT_CPU_6 health 0.368" in out.stdout
+    assert "remaining 0.75" in out.stdout and "burn 0.25x" in out.stdout
+    assert "device health min" in out.stdout
+    assert "(worst: TFRT_CPU_6)" in out.stdout
+    # No slo section -> no slo rows.
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({
+        "metric": "serve_goodput_rps", "value": 1.0, "unit": "requests/s",
+        "vs_baseline": None, "context": {}}))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(bare)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "slo status" not in out.stdout
