@@ -68,7 +68,13 @@ code can never inherit stale numbers), ``FT_SGEMM_COMPILE_CACHE``
 persistent XLA compile-cache location (default: the shared
 ``~/.cache/ft_sgemm_tpu/jaxcache`` alongside the tuner cache — XLA keys
 entries by module content, so sharing across code versions is safe;
-``0``/``off`` disables; see ``ft_sgemm_tpu/perf/compile_cache.py``).
+``0``/``off`` disables; see ``ft_sgemm_tpu/perf/compile_cache.py``),
+``FT_SGEMM_LEDGER`` run-ledger path — every emitted artifact line
+(headline, ``--smoke``, ``--serve``; null and partial ones included)
+also appends one distilled row to the longitudinal run ledger
+(``ft_sgemm_tpu/perf/ledger.py``; ``FT_SGEMM_LEDGER_RUN_ID`` overrides
+the timestamp-derived run id), feeding ``cli history`` /
+``cli trend --gate``.
 The worker records the cache's enable status and end-of-run
 hit/miss/bytes-written stats (``context.compile_cache``), every stage
 span carries a compile/execute wall split, and the RunReport embeds the
@@ -171,6 +177,51 @@ def _timeline_path(records_path):
     if env:
         return env
     return (records_path + ".timeline.jsonl") if records_path else None
+
+
+_LEDGER_MOD = None
+
+
+def _load_ledger_mod():
+    """perf/ledger.py loaded standalone (stdlib-only by contract, same
+    file-path discipline as the timeline module). None when unloadable."""
+    global _LEDGER_MOD
+    if _LEDGER_MOD is not None:
+        return _LEDGER_MOD
+    try:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "ft_sgemm_tpu", "perf", "ledger.py")
+        spec = importlib.util.spec_from_file_location("_ft_ledger", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _LEDGER_MOD = mod
+    except Exception:  # noqa: BLE001 — observability must not kill the run
+        _LEDGER_MOD = None
+    return _LEDGER_MOD
+
+
+def _ledger_append(artifact):
+    """Append the just-emitted artifact line to the run ledger when
+    ``FT_SGEMM_LEDGER=`` names one. Best-effort by construction: the
+    ledger row is observability, the printed JSON line is the contract —
+    nothing here may fail the run. ``FT_SGEMM_LEDGER_RUN_ID`` overrides
+    the timestamp-derived run id (CI sets it to the workflow run)."""
+    path = os.environ.get("FT_SGEMM_LEDGER")
+    if not path:
+        return
+    try:
+        mod = _load_ledger_mod()
+        if mod is None or not isinstance(artifact, dict):
+            return
+        run_id = (os.environ.get("FT_SGEMM_LEDGER_RUN_ID")
+                  or f"{artifact.get('metric') or 'run'}-"
+                     f"{time.strftime('%Y%m%d-%H%M%S')}")
+        mod.append(path, mod.ingest(artifact, run_id=run_id,
+                                    source="bench.py"))
+    except Exception:  # noqa: BLE001
+        pass
 
 
 class _NoTimeline:
@@ -691,14 +742,16 @@ def _emit_locked(values, errors, extra_errors=None):
         if tpath:
             context["timeline"] = os.path.basename(tpath)
     context["errors"] = errors
-    print(json.dumps({
+    artifact = {
         "metric": "abft_kernel_huge_gflops_4096",
         "value": None if ft is None else round(ft, 1),
         "unit": "GFLOPS",
         "vs_baseline": (None if ft is None
                         else round(ft / REFERENCE_ABFT_HUGE_GFLOPS, 3)),
         "context": context,
-    }), flush=True)
+    }
+    print(json.dumps(artifact), flush=True)
+    _ledger_append(artifact)
     if ft is not None:
         return 0
     # No TPU headline, but a completed backend-fallback measurement is a
@@ -2238,10 +2291,12 @@ def serve_main(argv):
             stages=[], slo=context.get("slo")).to_dict()
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["run_report"] = f"{type(e).__name__}: {e}"
-    print(json.dumps({"metric": "serve_goodput_rps",
-                      "value": value,
-                      "unit": "requests/s", "vs_baseline": None,
-                      "context": context}), flush=True)
+    artifact = {"metric": "serve_goodput_rps",
+                "value": value,
+                "unit": "requests/s", "vs_baseline": None,
+                "context": context}
+    print(json.dumps(artifact), flush=True)
+    _ledger_append(artifact)
     ok = (value is not None and value > 0
           and context.get("completed", 0) > 0
           and context.get("correct") == context.get("completed")
@@ -2307,9 +2362,10 @@ def smoke_main():
         sys.stderr.write(traceback.format_exc())
         ok_all = False
     context["seconds_total"] = round(time.monotonic() - t0, 3)
-    print(json.dumps({"metric": "bench_smoke", "value": 1 if ok_all else 0,
-                      "unit": "ok", "vs_baseline": None,
-                      "context": context}), flush=True)
+    artifact = {"metric": "bench_smoke", "value": 1 if ok_all else 0,
+                "unit": "ok", "vs_baseline": None, "context": context}
+    print(json.dumps(artifact), flush=True)
+    _ledger_append(artifact)
     return 0 if ok_all else 1
 
 

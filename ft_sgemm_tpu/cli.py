@@ -57,6 +57,15 @@ Usage:
     python -m ft_sgemm_tpu.cli serve-bench [--smoke] [--buckets=...] \
         [--requests=N] [--inject-rate=R] [--rate=RPS] \
         [--monitor-port=N] [--out=ARTIFACT.json]
+    python -m ft_sgemm_tpu.cli history [LEDGER.jsonl] \
+        [--limit=N] [--format=text|json]
+    python -m ft_sgemm_tpu.cli trend [LEDGER.jsonl] [--gate] \
+        [--window=N] [--min-runs=N] [--sigma=X] [--floor=F] \
+        [--format=text|json]
+    python -m ft_sgemm_tpu.cli ingest LEDGER.jsonl ARTIFACT.json... \
+        [--run-id=ID]
+    python -m ft_sgemm_tpu.cli trace-export RUN.timeline.jsonl \
+        [--events=LOG.jsonl] [--out=TRACE.json] [--run-id=ID]
 
 ``report`` renders the RunReport a bench artifact embeds
 (``ft_sgemm_tpu.perf``): the environment manifest (device, jax/jaxlib,
@@ -187,6 +196,25 @@ endpoints: SLO budget, per-bucket latency/goodput, the device-health
 column, and the recent-event tail, refreshed until Ctrl-C.
 ``telemetry LOG --watch`` follows a GROWING shard instead (incremental
 tail + re-summarize) when only the JSONL plane is available.
+
+Run history & trends (``ft_sgemm_tpu.perf.ledger`` / ``.trend``,
+DESIGN.md §13): ``ingest`` appends artifacts to the append-only run
+ledger (null/partial ones land with named degradation reasons, never
+errors); ``history`` renders the run table with PARTIAL/kill
+annotations — the BENCH_r01–r05 trajectory at a glance; ``trend``
+judges the latest run of every (measurement, platform) series against
+a rolling-window noise model estimated from the ledger itself —
+improvement / flat / regression / insufficient-data — plus fault-rate
+and SLO-burn drift. ``--gate`` makes the exit code CI-facing
+(``perf/compare.py`` contract: only regression verdicts fail;
+insufficient data never does). The ledger path defaults to
+``$FT_SGEMM_LEDGER`` or ``LEDGER.jsonl``. ``trace-export`` merges one
+run's streamed timeline (+ optional fault-event JSONL via
+``--events=``) into a single Chrome-trace-event JSON — stage/attempt/
+compile spans on per-kind tracks, faults as instants with tile coords,
+serve requests as flows joined by ``trace_id`` across
+enqueue→flush→detect→retry — loadable directly in Perfetto or
+``chrome://tracing``.
 """
 
 from __future__ import annotations
@@ -654,6 +682,166 @@ def run_bench_compare(baseline_path: str, candidate_path: str, out=None,
         print(f"candidate: {candidate_path}", file=out)
         print(perf_compare.format_comparison(result), file=out)
     return perf_compare.exit_code(result)
+
+
+def _default_ledger_path() -> str:
+    return os.environ.get("FT_SGEMM_LEDGER") or "LEDGER.jsonl"
+
+
+def run_history(args, flags, out=None) -> int:
+    """``history`` subcommand: the run table over the ledger — one line
+    per run with PARTIAL/kill annotations and degradation reasons.
+    Exit 2 = ledger unreadable."""
+    import json as _json
+
+    from ft_sgemm_tpu.perf import ledger as perf_ledger
+
+    out = sys.stdout if out is None else out
+    path = args[0] if args else _default_ledger_path()
+    limit = None
+    fmt = "text"
+    for f in flags:
+        if f.startswith("--limit="):
+            try:
+                limit = int(f.split("=", 1)[1])
+            except ValueError:
+                print(f"--limit must be an int, got {f!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--format="):
+            fmt = f.split("=", 1)[1]
+            if fmt not in ("text", "json"):
+                print(f"--format must be text or json, got {fmt!r}",
+                      file=sys.stderr)
+                return 2
+    try:
+        entries = perf_ledger.read_ledger(path)
+    except OSError as e:
+        print(f"ft_sgemm: cannot read ledger: {e}", file=sys.stderr)
+        return 2
+    if fmt == "json":
+        shown = perf_ledger.dedup_entries(entries)
+        if limit:
+            shown = shown[-limit:]
+        print(_json.dumps(shown, indent=1, sort_keys=True), file=out)
+    else:
+        print(f"ledger: {path}", file=out)
+        print(perf_ledger.format_history(entries, limit=limit), file=out)
+    return 0
+
+
+def run_trend(args, flags, out=None) -> int:
+    """``trend`` subcommand: N-run verdicts against the ledger's own
+    rolling-window noise model.
+
+    Exit contract (``--gate``): 0 = no regression (flat / improvement /
+    insufficient-data all pass), 1 = at least one regression verdict,
+    2 = the ledger could not be read. Without ``--gate`` the exit code
+    is informational-0 unless the ledger is unreadable."""
+    import json as _json
+
+    from ft_sgemm_tpu.perf import ledger as perf_ledger
+    from ft_sgemm_tpu.perf import trend as perf_trend
+
+    out = sys.stdout if out is None else out
+    path = args[0] if args else _default_ledger_path()
+    kw = {}
+    fmt = "text"
+    bad = None
+    for f in flags:
+        try:
+            if f.startswith("--window="):
+                kw["window"] = int(f.split("=", 1)[1])
+            elif f.startswith("--min-runs="):
+                kw["min_runs"] = int(f.split("=", 1)[1])
+            elif f.startswith("--sigma="):
+                kw["sigma"] = float(f.split("=", 1)[1])
+            elif f.startswith("--floor="):
+                kw["rel_floor"] = float(f.split("=", 1)[1])
+            elif f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("text", "json"):
+                    print(f"--format must be text or json, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        except ValueError as e:
+            bad = f"{f}: {e}"
+    if bad:
+        print(f"ft_sgemm: bad trend flag {bad}", file=sys.stderr)
+        return 2
+    try:
+        entries = perf_ledger.dedup_entries(perf_ledger.read_ledger(path))
+    except OSError as e:
+        print(f"ft_sgemm: cannot read ledger: {e}", file=sys.stderr)
+        return 2
+    report = perf_trend.trend_report(entries, **kw)
+    if fmt == "json":
+        print(_json.dumps(report, indent=1, sort_keys=True), file=out)
+    else:
+        print(f"ledger: {path} ({len(entries)} runs)", file=out)
+        print(perf_trend.format_trend(report), file=out)
+    return perf_trend.exit_code(report) if "--gate" in flags else 0
+
+
+def run_ingest(args, flags, out=None) -> int:
+    """``ingest`` subcommand: append one or more artifacts to the run
+    ledger. Hostile inputs never fail the command — they land as rows
+    with named degradation reasons (the r01–r05 diet is the norm)."""
+    from ft_sgemm_tpu.perf import ledger as perf_ledger
+
+    out = sys.stdout if out is None else out
+    ledger_path, artifacts = args[0], args[1:]
+    run_id = None
+    for f in flags:
+        if f.startswith("--run-id="):
+            run_id = f.split("=", 1)[1]
+    if run_id is not None and len(artifacts) > 1:
+        print("--run-id= only applies to a single artifact",
+              file=sys.stderr)
+        return 2
+    for path in artifacts:
+        entry = perf_ledger.ingest_file(path, run_id=run_id)
+        perf_ledger.append(ledger_path, entry)
+        deg = entry.get("degradations") or []
+        print(f"ingested {entry['run_id']} ({entry['kind']}) from"
+              f" {os.path.basename(path)}"
+              + (f"  [{'; '.join(deg[:2])}]" if deg else ""), file=out)
+    return 0
+
+
+def run_trace_export(args, flags, out=None) -> int:
+    """``trace-export`` subcommand: one merged Chrome-trace JSON per
+    run, loadable in Perfetto / ``chrome://tracing``. Exit 2 = the
+    timeline could not be read; 1 = it held no records (nothing to
+    draw is a named outcome, not a silent empty file)."""
+    from ft_sgemm_tpu.telemetry import traceview
+
+    out = sys.stdout if out is None else out
+    timeline_path = args[0]
+    events_path = out_path = run_id = None
+    for f in flags:
+        if f.startswith("--events="):
+            events_path = f.split("=", 1)[1]
+        elif f.startswith("--out="):
+            out_path = f.split("=", 1)[1]
+        elif f.startswith("--run-id="):
+            run_id = f.split("=", 1)[1]
+    try:
+        trace, path = traceview.export_trace(
+            timeline_path, events_path=events_path, out_path=out_path,
+            run_id=run_id)
+    except OSError as e:
+        print(f"ft_sgemm: cannot read timeline: {e}", file=sys.stderr)
+        return 2
+    meta = trace["otherData"]
+    print(f"trace written to {path}: {meta['spans']} spans"
+          f" ({meta['in_flight']} in flight), {meta['points']} points,"
+          f" {meta['fault_events']} fault events, {meta['flows']} request"
+          f" flows ({meta['flow_events']} flow events),"
+          f" {meta['dropped']} dropped", file=out)
+    if not (meta["spans"] or meta["points"] or meta["fault_events"]):
+        print("ft_sgemm: timeline held no records", file=sys.stderr)
+        return 1
+    return 0
 
 
 def run_tune(args, flags, out=None) -> int:
@@ -1374,6 +1562,20 @@ def main(argv=None) -> int:
         return run_serve(flags)
     if args and args[0] == "serve-bench":
         return run_serve_bench_cmd(flags)
+    if args and args[0] == "history":
+        return run_history(args[1:], flags)
+    if args and args[0] == "trend":
+        return run_trend(args[1:], flags)
+    if args and args[0] == "ingest":
+        if len(args) < 3:
+            print(__doc__)
+            return 2
+        return run_ingest(args[1:], flags)
+    if args and args[0] == "trace-export":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        return run_trace_export(args[1:], flags)
     if args and args[0] == "top":
         if len(args) < 2:
             print(__doc__)

@@ -26,6 +26,16 @@ whether a candidate artifact regressed against a baseline:
   first-class observable: ``FT_SGEMM_COMPILE_CACHE`` location control,
   hit/miss/bytes-written counting via ``jax.monitoring`` events, and
   the named-reason enable status bench artifacts record.
+- :mod:`.ledger` — the longitudinal run ledger: append-only,
+  schema-versioned JSONL where every bench/serve artifact (null and
+  partial ones included, with named degradation reasons) lands as one
+  row keyed by (run_id, git rev, platform triple). Pure stdlib,
+  path-loadable by the jax-free bench supervisor.
+- :mod:`.trend` — N-run trend verdicts over the ledger: a rolling-
+  window noise model per (measurement, platform) series extends
+  :mod:`.compare`'s pairwise verdicts to improvement / flat /
+  regression / insufficient-data with the same exit-code contract,
+  plus fault-rate and SLO-burn drift detection. Pure stdlib.
 
 Importing this package never imports jax (the bench supervisor's
 constraint); modules that need it import lazily inside functions.
@@ -40,8 +50,10 @@ from ft_sgemm_tpu.perf import (
     compare,
     compile_cache,
     hlo,
+    ledger,
     report,
     roofline,
+    trend,
     wallclock,
 )
 from ft_sgemm_tpu.perf.compare import (
@@ -84,10 +96,12 @@ __all__ = [
     "format_comparison",
     "from_artifact",
     "hlo",
+    "ledger",
     "load_artifact",
     "report",
     "roofline",
     "roofline_summary",
     "stage_row",
+    "trend",
     "wallclock",
 ]

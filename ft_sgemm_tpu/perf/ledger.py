@@ -1,0 +1,504 @@
+"""Longitudinal run ledger: every bench/serve artifact as one durable row.
+
+Every observability surface so far is scoped to a single run — the
+roofline report, the wall attribution, the live monitor all answer "what
+happened in THIS process". But the repo's actual perf story is
+longitudinal: ``BENCH_r01``–``r05`` are null/partial artifacts whose
+failure modes (backend init crash, four deadline kills at the 4096
+stage) only make sense as a *sequence*, and the CI gate still compares
+against one static committed baseline instead of the run history. This
+module is the cross-run memory: an append-only, schema-versioned JSONL
+ledger where each line is one run's distilled facts —
+
+- identity: ``run_id``, git rev, the platform triple
+  (requested / used / device_kind) — the ledger key;
+- the headline (metric, value, unit) and every comparable measurement
+  ``perf/compare.py`` knows how to extract (stage seconds, GFLOPS rows,
+  smoke encode timings) so pairwise verdicts extend to N-run trends;
+- wall-phase fractions, fault counters, the SLO/device-health snapshot,
+  tuner/compile-cache hit rates;
+- partial/kill metadata (``context.partial``, ``killed_at_stage``) and
+  NAMED degradation reasons for everything that could not be extracted.
+
+Null and partial artifacts ingest cleanly — they are the norm, not the
+exception (r01 crashed before measuring anything; r02–r05 were
+supervisor-killed mid-stage) — :func:`ingest` never raises. A run that
+measured nothing still lands as a row whose ``degradations`` list says
+*why*, because "five consecutive null runs, all killed at the same
+stage" is exactly the longitudinal fact the ledger exists to surface.
+
+HARD CONSTRAINT — timeline.py discipline: stdlib only, no
+package-relative imports. ``bench.py``'s jax-free supervisor loads this
+file directly via ``importlib.util.spec_from_file_location`` to append
+the artifact it just emitted (``FT_SGEMM_LEDGER=``), so importing the
+``ft_sgemm_tpu`` package root (which pulls jax) is forbidden here. The
+measurement extractor therefore MIRRORS ``perf/compare.py``'s
+``extract_stages`` instead of importing it; ``tests/test_ledger.py``
+pins the two equal on a real artifact so they cannot drift.
+
+Entry schema (one JSON object per ledger line), version 1::
+
+    {"schema": 1, "run_id": str, "source": str|null, "kind": str,
+     "git_rev": str|null,
+     "platform": {"requested": str|null, "used": str|null,
+                  "device_kind": str|null},
+     "metric": str|null, "unit": str|null, "value": float|null,
+     "measurements": {name: {"value": float, "higher_is_better": bool}},
+     "wall": {"wall_seconds": float, "fractions": {...}}|null,
+     "fault_counters": {...}|null, "slo": {...}|null,
+     "tuner_cache": {...}|null, "compile_cache": {...}|null,
+     "partial": bool, "killed_at_stage": str|null,
+     "completed_stages": [...]|null,
+     "degradations": [str, ...]}
+
+Reading migrates older lines forward (schema 0 = the pre-ledger ad-hoc
+layout some tooling banked: ``run``/``rev`` keys, flat string platform)
+and tags them ``migrated_from_schema_0`` instead of refusing them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+SCHEMA_VERSION = 1
+
+KINDS = ("bench", "smoke", "serve", "multichip", "baseline", "unknown")
+
+# Measurement keys whose value is seconds (lower is better) vs
+# throughput (higher is better) — the goodness convention compare.py
+# established and trend.py inherits.
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _measurement(value, higher_is_better: bool) -> Optional[dict]:
+    v = _num(value)
+    if v is None:
+        return None
+    return {"value": v, "higher_is_better": higher_is_better}
+
+
+def extract_measurements(artifact: dict) -> dict:
+    """Every comparable measurement of one bench artifact, keyed by the
+    SAME stage names ``perf/compare.py::extract_stages`` produces (the
+    equality is test-pinned — see module docstring for why this is a
+    mirror, not an import)."""
+    stages: dict = {}
+    if not isinstance(artifact, dict):
+        return stages
+    ctx = artifact.get("context") or {}
+    if not isinstance(ctx, dict):
+        ctx = {}
+
+    metric = artifact.get("metric") or "value"
+    s = _measurement(artifact.get("value"), higher_is_better=True)
+    if s and metric != "bench_smoke":
+        # The smoke headline is a 0/1 ok flag, not a measurement.
+        stages[metric] = s
+
+    for key, v in ctx.items():
+        if isinstance(key, str) and key.endswith("_gflops"):
+            s = _measurement(v, higher_is_better=True)
+            if s:
+                stages[key] = s
+    tuned = ctx.get("abft_tuned")
+    if isinstance(tuned, dict):
+        s = _measurement(tuned.get("gflops"), higher_is_better=True)
+        if s:
+            stages["abft_tuned_gflops"] = s
+
+    modes = ctx.get("encode_modes")
+    if isinstance(modes, dict):
+        for enc, rec in modes.items():
+            if isinstance(rec, dict):
+                s = _measurement(rec.get("seconds"), higher_is_better=False)
+                if s:
+                    stages[f"smoke_encode[{enc}].seconds"] = s
+
+    rr = ctx.get("run_report")
+    if isinstance(rr, dict):
+        for row in rr.get("stages") or []:
+            if not isinstance(row, dict) or not row.get("name"):
+                continue
+            s = _measurement(row.get("seconds"), higher_is_better=False)
+            if s:
+                stages[f"stage[{row['name']}].seconds"] = s
+    return stages
+
+
+def _infer_kind(doc: dict, ctx: dict, source: Optional[str]) -> str:
+    if "n_devices" in doc and "metric" not in doc:
+        return "multichip"
+    metric = doc.get("metric")
+    # serve before smoke: a `--serve --smoke` artifact carries both
+    # context flags, and the serve identity is the meaningful one.
+    if metric == "serve_goodput_rps" or ctx.get("serve"):
+        return "serve"
+    if metric == "bench_smoke" or ctx.get("smoke"):
+        return "smoke"
+    name = os.path.basename(source or "").upper()
+    if name.startswith("BASELINE"):
+        return "baseline"
+    if isinstance(metric, str) and ("gflops" in metric.lower()
+                                    or "abft" in metric.lower()):
+        return "bench"
+    if isinstance(metric, str) and "value" in doc:
+        return "bench"
+    return "unknown"
+
+
+def _slo_snapshot(ctx: dict) -> Optional[dict]:
+    slo = ctx.get("slo")
+    if not isinstance(slo, dict):
+        return None
+    keep = ("status", "budget_remaining", "burn_rate", "goodput_ratio",
+            "observed_p99_seconds", "device_health_min")
+    return {k: slo.get(k) for k in keep if k in slo}
+
+
+def _cache_snapshot(d, keys=("enabled", "hits", "misses",
+                             "requests")) -> Optional[dict]:
+    if not isinstance(d, dict):
+        return None
+    return {k: d.get(k) for k in keys if k in d}
+
+
+def _platform(ctx: dict, manifest: dict) -> dict:
+    return {
+        "requested": (ctx.get("platform_requested")
+                      or manifest.get("platform_requested")),
+        "used": (ctx.get("platform_used") or manifest.get("platform_used")
+                 or ctx.get("backend") or manifest.get("backend")),
+        "device_kind": (ctx.get("device_kind")
+                        or manifest.get("device_kind")),
+    }
+
+
+def platform_key(entry: dict) -> str:
+    """The platform half of the ledger key, as one comparable string."""
+    p = entry.get("platform") or {}
+    return "/".join(str(p.get(k) or "?")
+                    for k in ("requested", "used", "device_kind"))
+
+
+def entry_key(entry: dict) -> tuple:
+    """The full ledger key: (run_id, git rev, platform triple)."""
+    return (entry.get("run_id"), entry.get("git_rev"),
+            platform_key(entry))
+
+
+def ingest(doc, *, run_id: str, source: Optional[str] = None) -> dict:
+    """One parsed document -> one schema-1 ledger entry. NEVER raises:
+    hostile inputs (null artifacts, driver wrappers whose ``parsed`` is
+    null, north-star docs with no value, non-dicts) all produce a row
+    whose ``degradations`` list names what was missing — the r01–r05
+    class is the expected diet, not an error path."""
+    try:
+        return _ingest_inner(doc, run_id=run_id, source=source)
+    except Exception as e:  # noqa: BLE001 — ingestion never raises
+        return _entry_base(run_id, source,
+                           degradations=[f"ingest_error:{type(e).__name__}"])
+
+
+def _entry_base(run_id, source, *, degradations=None) -> dict:
+    return {
+        "schema": SCHEMA_VERSION,
+        "run_id": run_id,
+        "source": os.path.basename(source) if source else None,
+        "kind": "unknown",
+        "git_rev": None,
+        "platform": {"requested": None, "used": None, "device_kind": None},
+        "metric": None, "unit": None, "value": None,
+        "measurements": {},
+        "wall": None, "fault_counters": None, "slo": None,
+        "tuner_cache": None, "compile_cache": None,
+        "partial": False, "killed_at_stage": None,
+        "completed_stages": None,
+        "degradations": list(degradations or []),
+    }
+
+
+def _ingest_inner(doc, *, run_id, source) -> dict:
+    entry = _entry_base(run_id, source)
+    deg = entry["degradations"]
+    if not isinstance(doc, dict):
+        deg.append("not_a_dict")
+        return entry
+
+    # Driver wrapper ({"n", "cmd", "rc", "tail", "parsed"}): the banked
+    # BENCH_r* shape. A null "parsed" means the run died before emitting
+    # its artifact line — record the rc and whatever the tail names.
+    if "parsed" in doc and ("rc" in doc or "cmd" in doc):
+        wrapper, doc = doc, doc.get("parsed")
+        rc = wrapper.get("rc")
+        if rc not in (0, None):
+            deg.append(f"worker_rc:{rc}")
+        if not isinstance(doc, dict):
+            deg.append("no_artifact_parsed")
+            tail = wrapper.get("tail") or ""
+            last = [ln for ln in str(tail).splitlines() if ln.strip()]
+            if last:
+                deg.append(f"tail:{last[-1].strip()[:120]}")
+            name = os.path.basename(source or "").upper()
+            if name.startswith("BENCH"):
+                entry["kind"] = "bench"
+            elif name.startswith("MULTICHIP"):
+                entry["kind"] = "multichip"
+            return entry
+
+    ctx = doc.get("context")
+    if not isinstance(ctx, dict):
+        ctx = {}
+        if "context" in doc or "metric" in doc:
+            deg.append("no_context")
+    rr = ctx.get("run_report")
+    rr = rr if isinstance(rr, dict) else {}
+    manifest = rr.get("manifest")
+    manifest = manifest if isinstance(manifest, dict) else {}
+
+    entry["kind"] = _infer_kind(doc, ctx, source)
+    entry["git_rev"] = manifest.get("git_rev")
+    entry["platform"] = _platform(ctx, manifest)
+    entry["metric"] = doc.get("metric") if isinstance(
+        doc.get("metric"), str) else None
+    entry["unit"] = doc.get("unit") if isinstance(
+        doc.get("unit"), str) else None
+    entry["value"] = _num(doc.get("value"))
+    entry["measurements"] = extract_measurements(doc)
+
+    if entry["kind"] == "multichip":
+        entry["metric"] = entry["metric"] or "multichip_ok"
+        ok = doc.get("ok")
+        entry["value"] = 1.0 if ok else (0.0 if ok is not None else None)
+        deg.append("no_measurements:multichip_ok_flag_only")
+    elif entry["value"] is None and "value" in doc:
+        # The BENCH_r02–r05 class: the artifact line landed but the
+        # headline never did. Name the reason the artifact itself gives.
+        reasons = ctx.get("errors") if isinstance(ctx.get("errors"),
+                                                  dict) else {}
+        named = "; ".join(f"{k}={str(v).splitlines()[0][:80]}"
+                          for k, v in sorted(reasons.items())) if reasons \
+            else "unstated"
+        deg.append(f"null_value:{named}")
+    elif "value" not in doc:
+        deg.append("no_value")
+    if not entry["measurements"] and entry["kind"] not in ("multichip",):
+        deg.append("no_measurements")
+
+    wall = rr.get("wall")
+    if isinstance(wall, dict):
+        entry["wall"] = {"wall_seconds": wall.get("wall_seconds"),
+                         "fractions": wall.get("fractions")}
+    fc = (ctx.get("fault_counters") or manifest.get("fault_counters"))
+    if isinstance(fc, dict):
+        entry["fault_counters"] = dict(fc)
+    entry["slo"] = _slo_snapshot(ctx)
+    entry["tuner_cache"] = _cache_snapshot(
+        manifest.get("tuner_cache"), keys=("hits", "misses"))
+    entry["compile_cache"] = _cache_snapshot(
+        ctx.get("compile_cache") or manifest.get("compile_cache"))
+
+    entry["partial"] = bool(ctx.get("partial"))
+    if isinstance(ctx.get("killed_at_stage"), str):
+        entry["killed_at_stage"] = ctx["killed_at_stage"]
+    if isinstance(ctx.get("completed_stages"), list):
+        entry["completed_stages"] = [str(s)
+                                     for s in ctx["completed_stages"]]
+    if entry["partial"]:
+        deg.append("partial:" + (entry["killed_at_stage"]
+                                 or "killed_at_unknown_stage"))
+    return entry
+
+
+def load_document(path: str):
+    """Parse one artifact file: whole-file JSON, or the LAST parseable
+    JSON-object line (bench prints one line; logs may precede it) —
+    ``perf/compare.py::load_artifact`` semantics WITHOUT unwrapping the
+    driver document (the wrapper's rc/tail are ingestion facts here).
+    Returns None when no JSON object is found (named in the entry)."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+    return doc if isinstance(doc, dict) else None
+
+
+def ingest_file(path: str, *, run_id: Optional[str] = None) -> dict:
+    """One artifact file -> one ledger entry; ``run_id`` defaults to the
+    filename stem (``BENCH_r03.json`` -> ``BENCH_r03``). Never raises —
+    an unreadable file becomes a row naming the read failure."""
+    if run_id is None:
+        run_id = os.path.splitext(os.path.basename(path))[0]
+    try:
+        doc = load_document(path)
+    except OSError as e:
+        return _entry_base(run_id, path,
+                           degradations=[f"unreadable:{type(e).__name__}"])
+    if doc is None:
+        return _entry_base(run_id, path, degradations=["no_json_object"])
+    return ingest(doc, run_id=run_id, source=path)
+
+
+# ---------------------------------------------------------------------------
+# Ledger file I/O + schema migration
+# ---------------------------------------------------------------------------
+
+
+def migrate(d: dict) -> dict:
+    """One raw ledger line -> a current-schema entry.
+
+    Schema 0 (the pre-ledger ad-hoc layout: ``run``/``rev`` keys, flat
+    string ``platform``, no ``schema`` field) migrates forward and is
+    tagged; a line already at the current version passes through; a
+    NEWER version is kept (append-only files outlive readers) but tagged
+    so trend consumers can choose to skip it."""
+    schema = d.get("schema")
+    if schema == SCHEMA_VERSION:
+        return d
+    if isinstance(schema, int) and schema > SCHEMA_VERSION:
+        d = dict(d)
+        d.setdefault("degradations", []).append(
+            f"schema_newer_than_reader:{schema}")
+        return d
+    # Schema 0 / missing: map the old spellings onto the current layout.
+    entry = _entry_base(d.get("run") or d.get("run_id"), d.get("source"))
+    entry["git_rev"] = d.get("rev") or d.get("git_rev")
+    plat = d.get("platform")
+    if isinstance(plat, str):
+        entry["platform"] = {"requested": None, "used": plat,
+                             "device_kind": None}
+    elif isinstance(plat, dict):
+        entry["platform"].update({k: plat.get(k) for k in entry["platform"]})
+    for key in ("kind", "metric", "unit", "partial", "killed_at_stage"):
+        if key in d:
+            entry[key] = d[key]
+    entry["value"] = _num(d.get("value"))
+    if isinstance(d.get("measurements"), dict):
+        entry["measurements"] = d["measurements"]
+    entry["degradations"] = list(d.get("degradations") or [])
+    entry["degradations"].append("migrated_from_schema_0")
+    return entry
+
+
+def read_ledger(path: str) -> List[dict]:
+    """Parse a ledger JSONL file into current-schema entries, in append
+    order (each gains a ``seq`` index). Torn/foreign lines are skipped —
+    the file is append-only across crashes, so a torn tail is expected."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(d, dict):
+                continue
+            if not any(k in d for k in ("run_id", "run", "schema")):
+                continue
+            entry = migrate(d)
+            entry["seq"] = len(out)
+            out.append(entry)
+    return out
+
+
+def append(path: str, entry: dict) -> None:
+    """Append one entry to the ledger, fsync'd (timeline.py durability
+    stance: whatever kills the process next, this row survived)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    rec = {k: v for k, v in entry.items() if k != "seq"}
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        fh.flush()
+        try:
+            os.fsync(fh.fileno())
+        except (OSError, ValueError):
+            pass
+
+
+def latest_per_key(entries) -> dict:
+    """Collapse duplicate ledger keys, later append wins (re-ingesting
+    the same run supersedes silently — append-only storage, last-writer
+    semantics on read). Returns {entry_key: entry} preserving each
+    winner's ``seq``."""
+    out: dict = {}
+    for e in entries:
+        out[entry_key(e)] = e
+    return out
+
+
+def dedup_entries(entries) -> List[dict]:
+    """The read-side view trend analysis consumes: duplicates collapsed
+    (last wins), original append order preserved."""
+    winners = latest_per_key(entries)
+    keep = {id(e) for e in winners.values()}
+    return [e for e in entries if id(e) in keep]
+
+
+# ---------------------------------------------------------------------------
+# History rendering (the `cli history` table)
+# ---------------------------------------------------------------------------
+
+
+def format_history(entries, *, limit: Optional[int] = None) -> str:
+    """Human rendering: one line per run — id, kind, platform, value,
+    and the partial/kill/degradation annotations that make the r01–r05
+    sequence readable at a glance."""
+    entries = dedup_entries(entries)
+    if limit:
+        entries = entries[-limit:]
+    lines = [f"run ledger: {len(entries)} runs"]
+    if not entries:
+        return lines[0] + " (empty)"
+    wid = max(len(str(e.get("run_id") or "?")) for e in entries)
+    wid = max(wid, 6)
+    for e in entries:
+        val = e.get("value")
+        unit = e.get("unit") or ""
+        if isinstance(val, (int, float)):
+            shown = f"{val:12.1f} {unit}".rstrip()
+        else:
+            shown = f"{'null':>12s}"
+        note = ""
+        if e.get("partial"):
+            note = "  PARTIAL" + (f"@{e['killed_at_stage']}"
+                                  if e.get("killed_at_stage") else "")
+        deg = [d for d in (e.get("degradations") or [])
+               if not d.startswith("partial:")]
+        if deg:
+            note += f"  [{'; '.join(deg[:2])}]"
+        p = e.get("platform") or {}
+        plat = p.get("device_kind") or p.get("used") or "?"
+        rev = (e.get("git_rev") or "?")[:12]
+        lines.append(
+            f"  {str(e.get('run_id') or '?'):<{wid}}  "
+            f"{e.get('kind') or '?':<9s} {plat:<8s} {rev:<12s} "
+            f"{e.get('metric') or '-':<34s} {shown}"
+            f"  ({len(e.get('measurements') or {})} measurements){note}")
+    return "\n".join(lines)
+
+
+__all__ = ["KINDS", "SCHEMA_VERSION", "append", "dedup_entries",
+           "entry_key", "extract_measurements", "format_history",
+           "ingest", "ingest_file", "latest_per_key", "load_document",
+           "migrate", "platform_key", "read_ledger"]

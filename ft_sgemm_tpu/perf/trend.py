@@ -1,0 +1,281 @@
+"""N-run trend verdicts over the run ledger — the history-aware CI gate.
+
+``perf/compare.py`` answers "is candidate B meaningfully slower than
+baseline A?" for exactly two artifacts under a FIXED relative tolerance.
+That tolerance is a guess; the ledger knows better. Given the run
+history (``perf/ledger.py``), this module estimates a rolling-window
+noise model per (measurement, platform) series — streaming
+``(n, Σx, Σx²)`` moments, the PR-7 adaptive-threshold layout reused
+host-side — and judges the LATEST run against its own history's noise,
+the same rolling-window discipline V-ABFT (arXiv 2602.08043) applies to
+detection thresholds: a threshold derived from observed variance beats
+any static constant, for perf regressions exactly as for SDCs.
+
+Verdicts extend compare.py's pairwise set to N runs:
+
+- ``improvement`` / ``regression`` — the latest value deviates from the
+  window mean beyond ``max(rel_floor, sigma·std/|mean|)`` in the
+  series' goodness direction;
+- ``flat`` — inside the noise band (compare.py's ``within_noise``);
+- ``insufficient_data`` — fewer than ``min_runs`` non-null historical
+  values (single-run windows, fresh platforms, the null r01–r05 diet).
+  NEVER a failure: a thin history is a setup fact, not a regression —
+  the same stance compare.py takes on ``incomparable``.
+
+Exit-code contract (:func:`exit_code`, same as compare.py): 0 = no
+regression (flat, improved, or merely insufficient data), 1 = at least
+one regression verdict, 2 = the ledger could not be read at all (the
+CLI maps that).
+
+Beyond throughput/seconds series, two drift detectors run over the same
+window machinery: fault-rate drift (uncorrectable-per-call creeping up
+across runs — a chip or threshold going bad *between* runs, invisible
+to any single run's counters) and SLO burn-rate drift from the serve
+artifacts' embedded snapshots. Both flag on a z-score against the
+rolling window, higher-is-worse.
+
+Pure stdlib, no jax — CI and the bench supervisor's tooling can run it
+from any process.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+DEFAULT_WINDOW = 8
+DEFAULT_MIN_RUNS = 3
+DEFAULT_SIGMA = 3.0
+DEFAULT_REL_FLOOR = 0.05
+
+VERDICT_IMPROVEMENT = "improvement"
+VERDICT_FLAT = "flat"
+VERDICT_REGRESSION = "regression"
+VERDICT_INSUFFICIENT = "insufficient_data"
+VERDICTS = (VERDICT_IMPROVEMENT, VERDICT_FLAT, VERDICT_REGRESSION,
+            VERDICT_INSUFFICIENT)
+
+
+class Moments:
+    """Streaming ``(n, sum, sumsq)`` — the PR-7 moment-accumulator
+    layout (``ops/common.variance_bound_threshold`` consumes these same
+    three numbers in-kernel; ``telemetry/monitor.py`` keeps them per
+    device) applied to per-series run history."""
+
+    __slots__ = ("n", "sum", "sumsq")
+
+    def __init__(self, values=()):
+        self.n = 0
+        self.sum = 0.0
+        self.sumsq = 0.0
+        for v in values:
+            self.observe(v)
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.sum += v
+        self.sumsq += v * v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.n if self.n else 0.0
+
+    @property
+    def variance(self) -> float:
+        if self.n < 2:
+            return 0.0
+        return max(0.0, self.sumsq / self.n - self.mean ** 2)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(self.variance)
+
+
+def _series_key(name: str, entry: dict, ledger_mod=None) -> str:
+    p = entry.get("platform") or {}
+    plat = p.get("device_kind") or p.get("used") or "?"
+    return f"{name}@{plat}"
+
+
+def collect_series(entries) -> dict:
+    """Ledger entries (append order) -> ``{series_key: {"name", "platform",
+    "higher_is_better", "points": [{"run_id", "value"}]}}`` for every
+    measurement, plus the ``fault_rate`` / ``slo_burn`` drift series.
+    Null values stay in the points list (they are history too — a run
+    that measured nothing) but never feed the noise model."""
+    series: dict = {}
+
+    def _add(name, entry, value, higher_is_better, family="measurement"):
+        key = _series_key(name, entry)
+        s = series.setdefault(key, {
+            "name": name,
+            "platform": key.split("@", 1)[1],
+            "higher_is_better": higher_is_better,
+            "family": family,
+            "points": []})
+        s["points"].append({"run_id": entry.get("run_id"),
+                            "value": value})
+
+    for e in entries:
+        # A run whose headline metric exists but measured null (the
+        # r02–r05 class) is a NULL POINT in that series: it keeps the
+        # run count honest and makes the latest-run verdict
+        # ``insufficient_data (latest_null)`` instead of silently
+        # judging the previous run as if it were current.
+        metric = e.get("metric")
+        if (isinstance(metric, str) and e.get("value") is None
+                and e.get("kind") in ("bench", "serve")
+                and metric not in (e.get("measurements") or {})):
+            _add(metric, e, None, higher_is_better=True)
+        for name, m in sorted((e.get("measurements") or {}).items()):
+            if not isinstance(m, dict):
+                continue
+            v = m.get("value")
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                v = None
+            _add(name, e, v, bool(m.get("higher_is_better", True)))
+        fc = e.get("fault_counters")
+        if isinstance(fc, dict):
+            calls = fc.get("calls")
+            unc = fc.get("uncorrectable")
+            if isinstance(calls, (int, float)) and calls > 0 \
+                    and isinstance(unc, (int, float)):
+                _add("fault_rate", e, float(unc) / float(calls),
+                     higher_is_better=False, family="drift")
+        slo = e.get("slo")
+        if isinstance(slo, dict):
+            burn = slo.get("burn_rate")
+            if isinstance(burn, (int, float)) and not isinstance(burn, bool):
+                _add("slo_burn", e, float(burn),
+                     higher_is_better=False, family="drift")
+    return series
+
+
+def judge_series(values: List[Optional[float]], *,
+                 higher_is_better: bool,
+                 window: int = DEFAULT_WINDOW,
+                 min_runs: int = DEFAULT_MIN_RUNS,
+                 sigma: float = DEFAULT_SIGMA,
+                 rel_floor: float = DEFAULT_REL_FLOOR) -> dict:
+    """Judge the LAST value of a series against the rolling window of
+    non-null values before it.
+
+    Returns ``{"verdict", "latest", "window_n", "mean", "std",
+    "tolerance", "delta", "reason"}`` where ``delta`` is the relative
+    deviation in the GOODNESS direction (positive = better) and
+    ``tolerance`` the noise band actually applied
+    (``max(rel_floor, sigma·std/|mean|)``)."""
+    out = {"verdict": VERDICT_INSUFFICIENT, "latest": None,
+           "window_n": 0, "mean": None, "std": None,
+           "tolerance": None, "delta": None, "reason": None}
+    if not values:
+        out["reason"] = "empty_series"
+        return out
+    latest = values[-1]
+    out["latest"] = latest
+    history = [v for v in values[:-1] if isinstance(v, (int, float))
+               and not isinstance(v, bool)][-window:]
+    out["window_n"] = len(history)
+    if latest is None:
+        out["reason"] = "latest_null"
+        return out
+    if len(history) < min_runs:
+        out["reason"] = f"window_n={len(history)}<min_runs={min_runs}"
+        return out
+    mom = Moments(history)
+    mean, std = mom.mean, mom.std
+    out["mean"] = round(mean, 9)
+    out["std"] = round(std, 9)
+    if mean == 0:
+        out["reason"] = "zero_window_mean"
+        return out
+    tol = max(rel_floor, sigma * std / abs(mean))
+    out["tolerance"] = round(tol, 6)
+    delta = (latest - mean) / abs(mean)
+    if not higher_is_better:
+        delta = -delta
+    out["delta"] = round(delta, 6)
+    out["verdict"] = (VERDICT_FLAT if abs(delta) <= tol
+                      else VERDICT_IMPROVEMENT if delta > 0
+                      else VERDICT_REGRESSION)
+    return out
+
+
+def trend_report(entries, *,
+                 window: int = DEFAULT_WINDOW,
+                 min_runs: int = DEFAULT_MIN_RUNS,
+                 sigma: float = DEFAULT_SIGMA,
+                 rel_floor: float = DEFAULT_REL_FLOOR) -> dict:
+    """The full N-run trend view over deduplicated ledger entries.
+
+    Returns ``{"params", "rows": [...], "counts": {verdict: n},
+    "regressions": [series_keys]}``; one row per (measurement,
+    platform) series carrying the window facts and verdict, drift
+    series (``fault_rate``/``slo_burn``) judged by the same machinery
+    and listed under the same verdict counts."""
+    series = collect_series(entries)
+    rows = []
+    counts = {v: 0 for v in VERDICTS}
+    for key in sorted(series):
+        s = series[key]
+        values = [p["value"] for p in s["points"]]
+        j = judge_series(values, higher_is_better=s["higher_is_better"],
+                         window=window, min_runs=min_runs, sigma=sigma,
+                         rel_floor=rel_floor)
+        row = {"series": key, "name": s["name"],
+               "platform": s["platform"], "family": s["family"],
+               "runs": len(s["points"]),
+               "latest_run": (s["points"][-1]["run_id"]
+                              if s["points"] else None), **j}
+        counts[row["verdict"]] += 1
+        rows.append(row)
+    return {
+        "params": {"window": window, "min_runs": min_runs,
+                   "sigma": sigma, "rel_floor": rel_floor},
+        "rows": rows,
+        "counts": counts,
+        "regressions": [r["series"] for r in rows
+                        if r["verdict"] == VERDICT_REGRESSION],
+    }
+
+
+def exit_code(report: dict) -> int:
+    """0 = no regression verdicts (flat / improved / insufficient-data
+    all pass — compare.py's exit contract); 1 = at least one."""
+    return 1 if report["counts"][VERDICT_REGRESSION] else 0
+
+
+def format_trend(report: dict) -> str:
+    """Human rendering: one line per series — latest vs window mean,
+    the noise band applied, and the verdict."""
+    p = report["params"]
+    lines = [f"trend (window={p['window']}, min_runs={p['min_runs']}, "
+             f"sigma={p['sigma']}, floor=±{100 * p['rel_floor']:.0f}%)"]
+    width = max((len(r["series"]) for r in report["rows"]), default=6)
+
+    def num(v):
+        return "—" if v is None else f"{v:.6g}"
+
+    for r in report["rows"]:
+        band = (f" ±{100 * r['tolerance']:.1f}%"
+                if r.get("tolerance") is not None else "")
+        delta = (f"  {100 * r['delta']:+.1f}%"
+                 if r.get("delta") is not None else "")
+        reason = f"  ({r['reason']})" if r.get("reason") else ""
+        lines.append(
+            f"  {r['series']:<{width}}  {num(r.get('mean')):>12}{band} "
+            f"-> {num(r.get('latest')):>12}  "
+            f"[n={r['window_n']}] {r['verdict']}{delta}{reason}")
+    c = report["counts"]
+    lines.append("verdicts: " + "  ".join(
+        f"{k}={c[k]}" for k in VERDICTS if c[k]))
+    if not report["rows"]:
+        lines.append("no series found in the ledger")
+    return "\n".join(lines)
+
+
+__all__ = ["DEFAULT_MIN_RUNS", "DEFAULT_REL_FLOOR", "DEFAULT_SIGMA",
+           "DEFAULT_WINDOW", "Moments", "VERDICTS", "VERDICT_FLAT",
+           "VERDICT_IMPROVEMENT", "VERDICT_INSUFFICIENT",
+           "VERDICT_REGRESSION", "collect_series", "exit_code",
+           "format_trend", "judge_series", "trend_report"]

@@ -601,6 +601,14 @@ class ServeEngine:
                            "backoff_seconds": round(backoff, 6)}
             telemetry.record_step_event(
                 "retry", op="serve", uncorrectable=unc, extra=retry_extra)
+            # The retry hop also lands in the run timeline (not just the
+            # telemetry stream): the streamed file must carry the whole
+            # enqueue -> flush -> retry trace join on its own, so a
+            # trace-export of a killed run — or one with telemetry off —
+            # still draws the flow (DESIGN.md §13).
+            self._tl.point("serve", "retry", trace_id=trace_id,
+                           bucket=bucket.key, attempt=retries,
+                           uncorrectable=unc)
             if self.monitor is not None:
                 self.monitor.observe_retry(
                     {"outcome": "retry", "op": "serve",
@@ -628,6 +636,9 @@ class ServeEngine:
             telemetry.record_step_event(
                 "exhausted", op="serve", uncorrectable=unc,
                 extra=exhausted_extra)
+            self._tl.point("serve", "exhausted", trace_id=trace_id,
+                           bucket=bucket.key, attempts=retries,
+                           uncorrectable=unc)
             if self.monitor is not None:
                 self.monitor.observe_retry(
                     {"outcome": "exhausted", "op": "serve",

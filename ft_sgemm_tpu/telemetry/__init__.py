@@ -49,7 +49,7 @@ from typing import Optional
 
 import numpy as np
 
-from ft_sgemm_tpu.telemetry import aggregate, timeline
+from ft_sgemm_tpu.telemetry import aggregate, timeline, traceview
 from ft_sgemm_tpu.telemetry.events import (
     FaultEvent,
     JsonlSink,
@@ -632,6 +632,7 @@ __all__ = [
     "aggregate",
     "remove_observer",
     "timeline",
+    "traceview",
     "configure",
     "disable",
     "enabled",

@@ -1,0 +1,330 @@
+"""Unified Perfetto / ``chrome://tracing`` export of one run's streams.
+
+The per-run streams this repo already emits are exactly the Chrome
+trace-event model wearing different clothes: timeline stage/attempt/
+compile spans (``telemetry/timeline.py``) are duration events, fault
+events (``telemetry/events.py`` JSONL) are instants with tile-coordinate
+args, and serve requests — minted a ``trace_id`` at construction
+(``serve/tracing.py``) and stamped on the enqueue point, the batch-flush
+span, every ``serve_gemm`` detection, and each retry-ladder event — are
+flow events joined by that ID. This module merges the streams into ONE
+Chrome-trace-event JSON per run, loadable directly in Perfetto or
+``chrome://tracing``, so every deadline kill, retry ladder, and compile
+wall becomes visually inspectable instead of a grep across three files.
+
+Event mapping (DESIGN.md §13):
+
+- span start/end pairs -> ``ph:"X"`` complete events on a per-kind
+  track (stage / attempt / compile / tune; ``serve[...]`` batch spans
+  ride the serve track), args carrying status, value, and the
+  lower/compile/execute wall split when recorded;
+- in-flight spans (started, never ended — the kill signature) ->
+  unmatched ``ph:"B"`` begin events, which tracing UIs render as
+  running to the end of the trace: the kill point is *visible*;
+- timeline points -> tiny ``ph:"X"`` slices (1µs) so flow arrows have a
+  slice to bind to; kill markers -> process-scoped ``ph:"i"`` instants;
+  heartbeats -> thread-scoped instants on their own track;
+- fault events -> ``ph:"i"`` instants with tile coords / residual /
+  threshold args on the faults track;
+- serve requests -> ``ph:"s"/"t"/"f"`` flow events, ``id`` = the
+  request's ``trace_id``, hop sequence enqueue -> batch flush ->
+  detect (``serve_gemm``) -> retry/exhausted, each hop anchored at a
+  slice on the serve or faults track.
+
+Timestamps are microseconds relative to the earliest record across both
+streams, clamped non-negative, and the emitted ``traceEvents`` list is
+sorted by ``ts`` (metadata first) — torn tails and foreign lines are
+skipped by the underlying readers, records without a wall-clock ``t``
+are counted in ``otherData.dropped`` rather than guessed at.
+
+HARD CONSTRAINT — timeline.py discipline: stdlib only, no
+package-relative imports (loadable via
+``importlib.util.spec_from_file_location`` from jax-free processes).
+The fault-event JSONL is parsed locally with the same skip rules as
+``telemetry/events.py`` rather than importing it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+# Fixed track (tid) layout: one lane per span family so the Perfetto
+# view reads top-to-bottom as run structure, serve traffic, then faults.
+TRACKS = (
+    ("stage", 1), ("attempt", 2), ("compile", 3), ("tune", 4),
+    ("serve", 5), ("faults", 6), ("heartbeat", 7), ("other", 8),
+)
+_TID = dict(TRACKS)
+PID = 1
+
+
+def _tid_for(kind: Optional[str], name: Optional[str]) -> int:
+    if isinstance(name, str) and name.startswith("serve["):
+        return _TID["serve"]
+    return _TID.get(kind or "", _TID["other"])
+
+
+def _pair_spans(records) -> Tuple[List[dict], List[dict]]:
+    """Pair start/end records per (kind, name) stack, keeping EVERY
+    field of both records (``summarize_timeline`` drops span-start extras
+    like the flush span's ``trace_ids``; the trace needs them)."""
+    open_spans: dict = {}
+    spans: List[dict] = []
+    for rec in records:
+        kind, name, phase = rec.get("kind"), rec.get("name"), rec.get("phase")
+        if phase == "start":
+            open_spans.setdefault((kind, name), []).append(rec)
+        elif phase == "end":
+            stack = open_spans.get((kind, name))
+            start = stack.pop() if stack else None
+            merged = dict(start or {})
+            merged.update({k: v for k, v in rec.items()
+                           if k not in ("phase", "t")})
+            merged["t_start"] = (start or {}).get("t")
+            merged["t_end"] = rec.get("t")
+            spans.append(merged)
+    in_flight = [dict(r, t_start=r.get("t"))
+                 for stack in open_spans.values() for r in stack]
+    return spans, in_flight
+
+
+def _read_fault_events(path) -> List[dict]:
+    """Parse a fault-event JSONL with ``telemetry/events.py``'s skip
+    rules (blank / torn / foreign lines dropped), kept local for the
+    stdlib-only constraint."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "outcome" in d:
+                out.append(d)
+    return out
+
+
+def _span_args(span: dict) -> dict:
+    args = {}
+    for key in ("status", "seconds", "value", "error", "lower_seconds",
+                "compile_seconds", "execute_seconds", "trace_ids"):
+        if span.get(key) is not None:
+            args[key] = span[key]
+    return args
+
+
+def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
+    """Merge timeline records (+ optional fault events) into one
+    Chrome-trace document ``{"traceEvents": [...], "displayTimeUnit":
+    "ms", "otherData": {...}}``. Never raises on hostile record shapes —
+    records without a usable ``t`` are counted dropped."""
+    records = [r for r in (records or []) if isinstance(r, dict)]
+    events = [e for e in (events or []) if isinstance(e, dict)]
+    times = [r.get("t") for r in records] + [e.get("ts") for e in events]
+    times = [t for t in times if isinstance(t, (int, float))]
+    t0 = min(times) if times else 0.0
+
+    def ts_us(t) -> Optional[int]:
+        if not isinstance(t, (int, float)):
+            return None
+        return max(0, int(round((t - t0) * 1e6)))
+
+    out: List[dict] = []
+    dropped = 0
+    proc = run_id or "ft_sgemm_run"
+    out.append({"ph": "M", "pid": PID, "tid": 0, "ts": 0,
+                "name": "process_name", "args": {"name": proc}})
+    for track, tid in TRACKS:
+        out.append({"ph": "M", "pid": PID, "tid": tid, "ts": 0,
+                    "name": "thread_name", "args": {"name": track}})
+
+    spans, in_flight = _pair_spans(records)
+    # trace_id -> [(ts, tid, hop_name)] — the flow hops, gathered as the
+    # slices they anchor to are emitted.
+    flows: dict = {}
+
+    def hop(trace_id, ts, tid, name):
+        if isinstance(trace_id, str) and ts is not None:
+            flows.setdefault(trace_id, []).append((ts, tid, name))
+
+    for span in spans:
+        ts = ts_us(span.get("t_start"))
+        te = ts_us(span.get("t_end"))
+        if ts is None and te is None:
+            dropped += 1
+            continue
+        if ts is None:
+            # End with no start (torn head): a 1µs slice at the end time.
+            ts = te
+        sec = span.get("seconds")
+        dur = (int(round(float(sec) * 1e6))
+               if isinstance(sec, (int, float)) and sec > 0
+               else (te - ts if te is not None and te > ts else 1))
+        tid = _tid_for(span.get("kind"), span.get("name"))
+        out.append({"ph": "X", "pid": PID, "tid": tid, "ts": ts,
+                    "dur": max(1, dur), "cat": span.get("kind") or "span",
+                    "name": str(span.get("name")),
+                    "args": _span_args(span)})
+        for trace_id in (span.get("trace_ids") or []):
+            # The flush hop lands 1µs INSIDE the batch slice so the
+            # flow arrow binds to it, not to a neighbour.
+            hop(trace_id, ts + 1, tid, "flush")
+    for span in in_flight:
+        ts = ts_us(span.get("t_start"))
+        if ts is None:
+            dropped += 1
+            continue
+        out.append({"ph": "B", "pid": PID,
+                    "tid": _tid_for(span.get("kind"), span.get("name")),
+                    "ts": ts, "cat": span.get("kind") or "span",
+                    "name": str(span.get("name")),
+                    "args": {"in_flight": True}})
+
+    points = 0
+    for rec in records:
+        if rec.get("phase") != "point":
+            continue
+        ts = ts_us(rec.get("t"))
+        if ts is None:
+            dropped += 1
+            continue
+        points += 1
+        kind, name = rec.get("kind"), rec.get("name")
+        args = {k: v for k, v in rec.items()
+                if k not in ("kind", "name", "phase", "t")}
+        if kind == "kill":
+            out.append({"ph": "i", "pid": PID, "tid": _TID["other"],
+                        "ts": ts, "s": "p", "cat": "kill",
+                        "name": f"KILL: {name}", "args": args})
+            continue
+        if kind == "heartbeat":
+            out.append({"ph": "i", "pid": PID, "tid": _TID["heartbeat"],
+                        "ts": ts, "s": "t", "cat": "heartbeat",
+                        "name": str(name), "args": args})
+            continue
+        tid = _tid_for(kind, name)
+        # Points become 1µs slices (not bare instants) so flow arrows
+        # have a slice to bind to in Perfetto's legacy importer.
+        out.append({"ph": "X", "pid": PID, "tid": tid, "ts": ts,
+                    "dur": 1, "cat": str(kind), "name": str(name),
+                    "args": args})
+        if args.get("trace_id"):
+            hop(args["trace_id"], ts, tid, str(name))
+
+    fault_count = 0
+    for ev in events:
+        ts = ts_us(ev.get("ts"))
+        if ts is None:
+            dropped += 1
+            continue
+        fault_count += 1
+        args = {k: ev[k] for k in ("outcome", "op", "strategy", "layer",
+                                   "tiles", "residual", "threshold",
+                                   "detected", "corrected",
+                                   "uncorrectable", "device", "extra")
+                if ev.get(k) is not None}
+        name = f"{ev.get('op') or 'event'}:{ev.get('outcome')}"
+        out.append({"ph": "X", "pid": PID, "tid": _TID["faults"],
+                    "ts": ts, "dur": 1, "cat": "fault", "name": name,
+                    "args": args})
+        trace_id = (ev.get("extra") or {}).get("trace_id") \
+            if isinstance(ev.get("extra"), dict) else None
+        hop(trace_id, ts, _TID["faults"],
+            "detect" if ev.get("op") == "serve_gemm"
+            else str(ev.get("outcome")))
+
+    flow_events = 0
+    for trace_id, hops in sorted(flows.items()):
+        if len(hops) < 2:
+            continue  # a flow needs two ends to draw an arrow
+        hops.sort()
+        for i, (ts, tid, name) in enumerate(hops):
+            ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+            ev = {"ph": ph, "pid": PID, "tid": tid, "ts": ts,
+                  "cat": "serve.flow", "name": "serve_request",
+                  "id": trace_id, "args": {"hop": name}}
+            if ph == "f":
+                ev["bp"] = "e"  # bind the arrowhead to the enclosing slice
+            out.append(ev)
+            flow_events += 1
+
+    # Metadata first, then strictly non-decreasing timestamps — the
+    # contract tests pin (and chrome://tracing's importer prefers).
+    out.sort(key=lambda e: (e["ph"] != "M", e["ts"]))
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run_id": proc,
+            "spans": len(spans), "in_flight": len(in_flight),
+            "points": points, "fault_events": fault_count,
+            "flows": sum(1 for h in flows.values() if len(h) >= 2),
+            "flow_events": flow_events, "dropped": dropped,
+        },
+    }
+
+
+def _read_timeline(path) -> List[dict]:
+    """``telemetry/timeline.py::read_timeline`` semantics, local for the
+    stdlib/path-loadable constraint."""
+    out = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (isinstance(rec, dict) and "kind" in rec
+                    and "t" in rec and "name" in rec):
+                out.append(rec)
+    return out
+
+
+def default_out_path(timeline_path: str) -> str:
+    base = timeline_path
+    for suffix in (".timeline.jsonl", ".jsonl"):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+            break
+    return base + ".trace.json"
+
+
+def export_trace(timeline_path: str,
+                 events_path: Optional[str] = None,
+                 out_path: Optional[str] = None,
+                 run_id: Optional[str] = None) -> Tuple[dict, str]:
+    """Read one run's timeline (+ optional fault-event log), build the
+    merged Chrome trace, write it, and return ``(trace, out_path)``.
+    ``OSError`` from unreadable inputs propagates (the CLI maps it to
+    exit 2); a MISSING events log beside a readable timeline does not —
+    the trace simply carries no fault instants."""
+    records = _read_timeline(timeline_path)
+    events: List[dict] = []
+    if events_path:
+        try:
+            events = _read_fault_events(events_path)
+        except OSError:
+            events = []
+    if run_id is None:
+        run_id = os.path.splitext(os.path.basename(timeline_path))[0]
+        if run_id.endswith(".timeline"):
+            run_id = run_id[:-len(".timeline")]
+    trace = build_trace(records, events, run_id=run_id)
+    path = out_path or default_out_path(timeline_path)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    return trace, path
+
+
+__all__ = ["PID", "TRACKS", "build_trace", "default_out_path",
+           "export_trace"]

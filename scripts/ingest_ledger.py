@@ -1,0 +1,72 @@
+"""Ingest bench/serve artifacts into the longitudinal run ledger.
+
+The jax-free seeder/CI half of ``ft_sgemm_tpu/perf/ledger.py``: reads
+each artifact (emitted bench line, driver wrapper with ``parsed``,
+multichip probe, baseline doc — null and partial ones included) and
+appends one distilled row per file to the ledger JSONL. Never fails on
+hostile input: a run that measured nothing lands as a row whose
+``degradations`` list names why — that sequence IS the observability
+(BENCH_r01–r05 are the expected diet).
+
+The committed ``LEDGER.jsonl`` at the repo root was seeded with::
+
+    python scripts/ingest_ledger.py LEDGER.jsonl \
+        BENCH_r0*.json MULTICHIP_r0*.json BASELINE*.json
+
+and CI re-seeds a scratch copy from it, ingests the fresh smoke/serve
+artifacts, and runs ``cli trend --gate`` over the result.
+
+Usage: python scripts/ingest_ledger.py LEDGER.jsonl ARTIFACT.json...
+       [--run-id=ID]   (single artifact only)
+
+Loads the ledger module by file path (stdlib-only by contract), so this
+script runs in any process — including ones that must never import jax.
+"""
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_ledger():
+    path = os.path.join(_ROOT, "ft_sgemm_tpu", "perf", "ledger.py")
+    spec = importlib.util.spec_from_file_location("_ft_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = [a for a in argv if not a.startswith("--")]
+    flags = [a for a in argv if a.startswith("--")]
+    if len(args) < 2:
+        print(__doc__)
+        return 2
+    run_id = None
+    for f in flags:
+        if f.startswith("--run-id="):
+            run_id = f.split("=", 1)[1]
+        else:
+            print(f"unknown flag {f!r}", file=sys.stderr)
+            return 2
+    ledger_path, artifacts = args[0], args[1:]
+    if run_id is not None and len(artifacts) > 1:
+        print("--run-id= only applies to a single artifact",
+              file=sys.stderr)
+        return 2
+    ledger = _load_ledger()
+    for path in artifacts:
+        entry = ledger.ingest_file(path, run_id=run_id)
+        ledger.append(ledger_path, entry)
+        deg = entry.get("degradations") or []
+        print(f"ingested {entry['run_id']} ({entry['kind']}) from"
+              f" {os.path.basename(path)}"
+              + (f"  [{'; '.join(deg[:2])}]" if deg else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""Regenerate the RESULTS.md "Run ledger" section from LEDGER.jsonl.
+
+RESULTS.md's hand-written measurement narrative stays authoritative;
+this script owns ONLY the auto-generated block between the
+``<!-- ledger:begin -->`` / ``<!-- ledger:end -->`` markers (appended at
+the end of the file if absent), so the perf trajectory — every banked
+run including the null/killed ones, with delta-vs-previous-run columns —
+is a committed, reviewable artifact that regenerates deterministically
+from the ledger instead of drifting as prose.
+
+Usage: python scripts/regen_results.py [LEDGER.jsonl] [RESULTS.md]
+       [--check]     (exit 1 if RESULTS.md is stale, write nothing)
+
+Jax-free: loads perf/ledger.py by file path (stdlib-only by contract).
+"""
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BEGIN = "<!-- ledger:begin -->"
+END = "<!-- ledger:end -->"
+
+
+def _load_ledger():
+    path = os.path.join(_ROOT, "ft_sgemm_tpu", "perf", "ledger.py")
+    spec = importlib.util.spec_from_file_location("_ft_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def render_section(entries, ledger_mod) -> str:
+    """The markdown block between the markers: one table row per run in
+    append order, headline + delta vs the previous run of the SAME
+    (metric, platform) series, and the partial/kill/degradation notes
+    that make the null-run sequence legible."""
+    entries = ledger_mod.dedup_entries(entries)
+    lines = [BEGIN,
+             "## Run ledger (auto-generated — scripts/regen_results.py)",
+             "",
+             f"{len(entries)} runs in `LEDGER.jsonl`. `Δ prev` compares "
+             "each run's headline to the previous run of the same "
+             "(metric, platform) series; nulls propagate as `—`.",
+             "",
+             "| run | kind | platform | git rev | metric | value | "
+             "Δ prev | notes |",
+             "|---|---|---|---|---|---|---|---|"]
+    last_by_series = {}
+    for e in entries:
+        p = e.get("platform") or {}
+        plat = p.get("device_kind") or p.get("used") or "?"
+        metric = e.get("metric") or "-"
+        val = e.get("value")
+        series = (metric, plat)
+        delta = "—"
+        prev = last_by_series.get(series)
+        if isinstance(val, (int, float)):
+            if isinstance(prev, (int, float)) and prev:
+                delta = f"{100 * (val - prev) / abs(prev):+.1f}%"
+            last_by_series[series] = val
+        shown = (f"{val:.1f} {e.get('unit') or ''}".rstrip()
+                 if isinstance(val, (int, float)) else "null")
+        notes = []
+        if e.get("partial"):
+            notes.append("PARTIAL@" + (e.get("killed_at_stage")
+                                       or "?"))
+        notes += [d for d in (e.get("degradations") or [])
+                  if not d.startswith("partial:")][:2]
+        lines.append(
+            f"| {e.get('run_id') or '?'} | {e.get('kind') or '?'} "
+            f"| {plat} | {(e.get('git_rev') or '?')[:12]} | {metric} "
+            f"| {shown} | {delta} | {'; '.join(notes) or ' '} |")
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def splice(text: str, section: str) -> str:
+    if BEGIN in text and END in text:
+        head, rest = text.split(BEGIN, 1)
+        _, tail = rest.split(END, 1)
+        return head + section + tail
+    if not text.endswith("\n"):
+        text += "\n"
+    return text + "\n" + section + "\n"
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    flags = [a for a in argv if a.startswith("--")]
+    args = [a for a in argv if not a.startswith("--")]
+    check = "--check" in flags
+    ledger_path = args[0] if args else os.path.join(_ROOT, "LEDGER.jsonl")
+    results_path = args[1] if len(args) > 1 else os.path.join(
+        _ROOT, "RESULTS.md")
+    ledger = _load_ledger()
+    try:
+        entries = ledger.read_ledger(ledger_path)
+    except OSError as e:
+        print(f"cannot read ledger: {e}", file=sys.stderr)
+        return 2
+    section = render_section(entries, ledger)
+    try:
+        with open(results_path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        text = ""
+    new = splice(text, section)
+    if check:
+        if new != text:
+            print(f"{results_path} is stale vs {ledger_path} "
+                  "(run scripts/regen_results.py)", file=sys.stderr)
+            return 1
+        print(f"{results_path} is current")
+        return 0
+    if new != text:
+        with open(results_path, "w", encoding="utf-8") as fh:
+            fh.write(new)
+        print(f"wrote ledger section ({len(entries)} runs) to"
+              f" {results_path}")
+    else:
+        print(f"{results_path} already current")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

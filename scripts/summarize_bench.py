@@ -10,10 +10,19 @@ Also accepts emitted bench ARTIFACTS (the one-line ``{"metric": ...}``
 JSON object bench.py prints): the row shows metric/value/vs_baseline,
 and a salvaged partial run (``context.partial: true`` — the supervisor
 promoted the best completed stage after a deadline kill) is annotated
-PARTIAL with its kill point and completed-stage list instead of being
-mistaken for a full sweep.
+PARTIAL@<killed_at_stage> with its completed-stage list instead of
+being mistaken for a full sweep.
+
+When a run ledger is available (``--ledger=PATH``, default the repo's
+committed ``LEDGER.jsonl``), each artifact row also gets a
+delta-vs-previous-ledger-run column: the headline compared to the last
+non-null value of the same (metric, platform) series — the one-glance
+"did this window move the number" view. ``--ledger=`` with a missing
+file (or no committed ledger) degrades to no delta column, never an
+error.
 
 Usage: python scripts/summarize_bench.py [records.jsonl|artifact.json ...]
+       [--ledger=LEDGER.jsonl]
 (defaults to every .bench/records_*.jsonl, newest first)
 """
 
@@ -57,6 +66,53 @@ def _fmt(v, name=""):
     return str(v)
 
 
+_LEDGER_MOD = None
+
+
+def _load_ledger_mod():
+    global _LEDGER_MOD
+    if _LEDGER_MOD is None:
+        spec = importlib.util.spec_from_file_location(
+            "_ft_ledger",
+            os.path.join(_ROOT, "ft_sgemm_tpu", "perf", "ledger.py"))
+        _LEDGER_MOD = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_LEDGER_MOD)
+    return _LEDGER_MOD
+
+
+def _load_ledger_entries(path):
+    """Deduplicated ledger entries, or None when no ledger is readable
+    (the no-delta-column degradation, never an error)."""
+    try:
+        mod = _load_ledger_mod()
+        return mod.dedup_entries(mod.read_ledger(path))
+    except (OSError, ValueError):
+        return None
+
+
+def _ledger_delta(entries, obj):
+    """(delta_fraction, prev_run_id) of this artifact's headline vs the
+    last non-null ledger value of the same (metric, platform) series, or
+    None when either side is null/absent."""
+    if not entries:
+        return None
+    mod = _load_ledger_mod()
+    probe = mod.ingest(obj, run_id="_probe")
+    val = probe.get("value")
+    if not isinstance(val, (int, float)):
+        return None
+    if probe.get("metric") == "bench_smoke":
+        return None  # the smoke headline is a 0/1 ok flag, not a measure
+    key = (probe.get("metric"), mod.platform_key(probe).split("/")[-1])
+    for e in reversed(entries):
+        prev = e.get("value")
+        if ((e.get("metric"),
+             mod.platform_key(e).split("/")[-1]) == key
+                and isinstance(prev, (int, float)) and prev):
+            return (val - prev) / abs(prev), e.get("run_id")
+    return None
+
+
 def _try_artifact(path):
     """Parse ``path`` as an emitted bench artifact; None when it is a
     records file (JSONL stage records have no top-level "metric")."""
@@ -68,7 +124,7 @@ def _try_artifact(path):
     return obj if isinstance(obj, dict) and "metric" in obj else None
 
 
-def summarize_artifact(path, obj):
+def summarize_artifact(path, obj, ledger_entries=None):
     ctx = obj.get("context") or {}
     print(f"== {os.path.basename(path)} (bench artifact)")
     v = obj.get("value")
@@ -78,8 +134,14 @@ def summarize_artifact(path, obj):
                 v, (int, float)) else f"{'null':>10s}"))
     if isinstance(vs, (int, float)):
         line += f"  (x{vs:.3f} vs baseline)"
+    delta = _ledger_delta(ledger_entries, obj)
+    if delta is not None:
+        line += f"  (Δ {100 * delta[0]:+.1f}% vs ledger run {delta[1]})"
     if ctx.get("partial"):
-        line += "  PARTIAL (salvaged from a killed run)"
+        # The kill stage rides the row itself: a PARTIAL row pasted in
+        # isolation must still say where the run died.
+        line += ("  PARTIAL@" + (ctx.get("killed_at_stage") or "?")
+                 + " (salvaged from a killed run)")
     print(line)
     if ctx.get("partial"):
         if ctx.get("killed_at_stage"):
@@ -116,10 +178,10 @@ def summarize_artifact(path, obj):
     print()
 
 
-def summarize(path):
+def summarize(path, ledger_entries=None):
     artifact = _try_artifact(path)
     if artifact is not None:
-        summarize_artifact(path, artifact)
+        summarize_artifact(path, artifact, ledger_entries=ledger_entries)
         return
     vals, errs = _load(path)
     print(f"== {os.path.basename(path)}")
@@ -154,16 +216,23 @@ def summarize(path):
 
 
 def main():
-    paths = sys.argv[1:] or sorted(
-        glob.glob(os.path.join(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))),
-            ".bench", "records_*.jsonl")),
+    argv = sys.argv[1:]
+    ledger_path = os.path.join(_ROOT, "LEDGER.jsonl")
+    paths = []
+    for a in argv:
+        if a.startswith("--ledger="):
+            ledger_path = a.split("=", 1)[1]
+        else:
+            paths.append(a)
+    paths = paths or sorted(
+        glob.glob(os.path.join(_ROOT, ".bench", "records_*.jsonl")),
         key=os.path.getmtime, reverse=True)
     if not paths:
         print("no records files found under .bench/")
         return 1
+    ledger_entries = _load_ledger_entries(ledger_path)
     for p in paths:
-        summarize(p)
+        summarize(p, ledger_entries=ledger_entries)
     return 0
 
 

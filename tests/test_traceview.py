@@ -1,0 +1,225 @@
+"""Perfetto/Chrome-trace export contract: valid JSON, monotone
+timestamps, span/point/fault mapping, and THE acceptance pin — a
+trace_id flow joining an enqueue point, a flush span, and a retry event
+from a real serve run's streamed timeline (ISSUE 10)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu.cli import main as cli_main
+from ft_sgemm_tpu.telemetry import traceview
+from ft_sgemm_tpu.telemetry.timeline import TimelineRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_synthetic_timeline(path):
+    rec = TimelineRecorder(str(path))
+    with rec.span("import_jax", kind="compile"):
+        pass
+    rec.point("serve", "enqueue", trace_id="t1", request_id=1,
+              bucket="B128")
+    rec.point("serve", "enqueue", trace_id="t2", request_id=2,
+              bucket="B128")
+    with rec.span("serve[B128]", kind="stage",
+                  trace_ids=["t1", "t2"]) as info:
+        rec.point("serve", "retry", trace_id="t1", bucket="B128",
+                  attempt=1)
+        info["value"] = {"batch": 2}
+    rec.point("heartbeat", "beat")
+    rec.point("kill", "deadline reached")
+    # An in-flight span: started, never ended (the kill signature).
+    rec._write({"kind": "stage", "name": "ft_huge", "phase": "start",
+                "t": 9e9})
+    rec.close()
+
+
+def _flow_hops(trace, trace_id):
+    return [(e["ph"], e["args"]["hop"]) for e in trace["traceEvents"]
+            if e.get("id") == trace_id and e.get("cat") == "serve.flow"]
+
+
+def test_trace_is_valid_json_with_monotone_timestamps(tmp_path):
+    tl = tmp_path / "run.timeline.jsonl"
+    _write_synthetic_timeline(tl)
+    trace, out_path = traceview.export_trace(str(tl))
+    # Valid JSON on disk, loadable round-trip.
+    loaded = json.loads(open(out_path).read())
+    assert loaded["traceEvents"]
+    evs = trace["traceEvents"]
+    # Monotone timestamps (metadata first), all non-negative.
+    body = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert body == sorted(body)
+    assert all(ts >= 0 for ts in body)
+    # Every event carries the Chrome-trace required fields.
+    for e in evs:
+        assert {"ph", "pid", "tid", "ts", "name"} <= set(e)
+    meta = trace["otherData"]
+    assert meta["spans"] == 2
+    assert meta["in_flight"] == 1
+    assert meta["dropped"] == 0
+
+
+def test_span_point_and_kill_mapping(tmp_path):
+    tl = tmp_path / "run.timeline.jsonl"
+    _write_synthetic_timeline(tl)
+    trace, _ = traceview.export_trace(str(tl))
+    evs = trace["traceEvents"]
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    # Completed spans are "X" complete events with duration.
+    compile_span = by_name["import_jax"][0]
+    assert compile_span["ph"] == "X" and compile_span["dur"] >= 1
+    flush = by_name["serve[B128]"][0]
+    assert flush["ph"] == "X"
+    assert flush["args"]["trace_ids"] == ["t1", "t2"]
+    # In-flight span -> unmatched "B" (renders as running to trace end).
+    assert by_name["ft_huge"][0]["ph"] == "B"
+    assert by_name["ft_huge"][0]["args"]["in_flight"] is True
+    # Kill markers -> process-scoped instants.
+    kill = by_name["KILL: deadline reached"][0]
+    assert kill["ph"] == "i" and kill["s"] == "p"
+    # Track names are declared as metadata.
+    threads = {e["args"]["name"] for e in evs
+               if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"stage", "compile", "serve", "faults"} <= threads
+
+
+def test_flow_join_and_fault_event_merge(tmp_path):
+    tl = tmp_path / "run.timeline.jsonl"
+    _write_synthetic_timeline(tl)
+    # A fault-event JSONL joins the flow via extra.trace_id; torn and
+    # foreign lines are skipped; an event without ts is counted dropped.
+    records = [json.loads(ln) for ln in open(tl) if ln.strip()]
+    ev_path = tmp_path / "events.jsonl"
+    with open(ev_path, "w") as fh:
+        fh.write(json.dumps({
+            "outcome": "corrected", "op": "serve_gemm",
+            "ts": records[2]["t"] + 0.001, "tiles": [[1, 2]],
+            "residual": 42.0, "extra": {"trace_id": "t1"}}) + "\n")
+        fh.write(json.dumps({"outcome": "clean", "op": "gemm"}) + "\n")
+        fh.write("torn {{{\n")
+        fh.write("not json at all\n")
+    trace = traceview.build_trace(
+        traceview._read_timeline(str(tl)),
+        traceview._read_fault_events(str(ev_path)))
+    hops = _flow_hops(trace, "t1")
+    assert [h[0] for h in hops][0] == "s"
+    assert [h[0] for h in hops][-1] == "f"
+    names = [h[1] for h in hops]
+    assert names.index("enqueue") < names.index("flush")
+    assert "detect" in names and "retry" in names
+    # t2 never retried: enqueue + flush only, still a drawable 2-hop flow.
+    assert len(_flow_hops(trace, "t2")) == 2
+    # The fault instant landed with its tile args on the faults track.
+    fault = [e for e in trace["traceEvents"]
+             if e["name"] == "serve_gemm:corrected"][0]
+    assert fault["args"]["tiles"] == [[1, 2]]
+    # The no-ts event was dropped, named in the counts.
+    assert trace["otherData"]["dropped"] == 1
+
+
+def test_hostile_records_never_raise():
+    trace = traceview.build_trace(
+        [{"kind": "stage"}, {"not": "a record"}, 7, None,
+         {"kind": "stage", "name": "x", "phase": "end", "t": "wat"},
+         {"kind": "serve", "name": "enqueue", "phase": "point"}],
+        [{"outcome": "clean"}, "junk", {"ts": None}])
+    json.dumps(trace)
+    assert trace["otherData"]["dropped"] >= 2
+
+
+def test_acceptance_serve_run_flow_joins_enqueue_flush_retry(tmp_path,
+                                                            rng):
+    """ISSUE 10 acceptance: `cli trace-export` of a REAL serve run
+    yields a Chrome-trace JSON where at least one trace_id flow connects
+    an enqueue point, a flush span, and a retry event — driven through
+    the actual engine (an adversarial request forces the bucket-scoped
+    retry ladder), not a synthetic timeline."""
+    from ft_sgemm_tpu.serve import ServeEngine, ServeRequest, \
+        default_bucket_set
+
+    tl_path = str(tmp_path / "serve.timeline.jsonl")
+    eng = ServeEngine(default_bucket_set((128, 256)), max_batch=1,
+                      max_wait=0.01, retry_backoff=0.0, timeline=tl_path)
+    eng.start()
+    try:
+        req = ServeRequest(
+            a=rng.standard_normal((200, 200)).astype(np.float32),
+            b=rng.standard_normal((200, 200)).astype(np.float32),
+            variant="adversarial")
+        res = eng.submit(req).result(timeout=120.0)
+        assert res.retries >= 1 and res.ok
+        trace_id = res.trace_id
+    finally:
+        eng.close()
+
+    out_path = str(tmp_path / "serve.trace.json")
+    rc = cli_main(["cli", "trace-export", tl_path, f"--out={out_path}"])
+    assert rc == 0
+    trace = json.loads(open(out_path).read())
+    hops = [(e["ph"], e["args"]["hop"])
+            for e in trace["traceEvents"]
+            if e.get("id") == trace_id and e.get("cat") == "serve.flow"]
+    names = [h[1] for h in hops]
+    assert "enqueue" in names, hops
+    assert "flush" in names, hops
+    assert "retry" in names, hops
+    assert names.index("enqueue") < names.index("flush") \
+        < names.index("retry")
+    assert hops[0][0] == "s" and hops[-1][0] == "f"
+    # The flush hop anchors INSIDE the batch slice carrying the trace id.
+    flush_slices = [e for e in trace["traceEvents"]
+                    if e["ph"] == "X" and trace_id in
+                    (e.get("args", {}).get("trace_ids") or [])]
+    assert flush_slices, "no batch slice names the trace"
+
+
+def test_cli_trace_export_exit_codes(tmp_path, capsys):
+    # Missing timeline -> 2.
+    assert cli_main(["cli", "trace-export",
+                     str(tmp_path / "missing.jsonl")]) == 2
+    # Readable but empty timeline -> 1 (named, not a silent empty file).
+    empty = tmp_path / "empty.timeline.jsonl"
+    empty.write_text("not a record\n")
+    assert cli_main(["cli", "trace-export", str(empty)]) == 1
+    # Success prints the summary and defaults the output path.
+    tl = tmp_path / "ok.timeline.jsonl"
+    _write_synthetic_timeline(tl)
+    capsys.readouterr()
+    assert cli_main(["cli", "trace-export", str(tl)]) == 0
+    out = capsys.readouterr().out
+    assert "request flows" in out
+    assert (tmp_path / "ok.trace.json").exists()
+
+
+def test_module_is_loadable_without_the_package(tmp_path):
+    """timeline.py discipline: stdlib-only, loadable by file path from a
+    process that never imports jax."""
+    tl = tmp_path / "run.timeline.jsonl"
+    _write_synthetic_timeline(tl)
+    code = """
+import importlib.util, sys
+assert "jax" not in sys.modules
+spec = importlib.util.spec_from_file_location("tv", {mod_path!r})
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+assert "jax" not in sys.modules, "traceview.py pulled jax in"
+trace, path = mod.export_trace({tl_path!r})
+assert trace["traceEvents"]
+print("OK")
+""".format(mod_path=os.path.join(REPO, "ft_sgemm_tpu", "telemetry",
+                                 "traceview.py"),
+           tl_path=str(tl))
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout

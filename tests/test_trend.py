@@ -1,0 +1,257 @@
+"""Trend-gate contract: rolling-window verdict math, insufficient-data
+semantics, drift detection, and the CLI exit-code acceptance pins
+(injected >=20% headline slowdown exits nonzero; flat-noise history
+exits 0; insufficient data NEVER fails)."""
+
+import json
+import os
+
+import pytest
+
+from ft_sgemm_tpu.cli import main as cli_main
+from ft_sgemm_tpu.perf import ledger, trend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _entry(run_id, value, *, metric="headline_gflops", platform="v5e",
+           **ctx):
+    return ledger.ingest(
+        {"metric": metric, "value": value, "unit": "GFLOPS",
+         "context": dict({"platform_used": "tpu",
+                          "device_kind": platform}, **ctx)},
+        run_id=run_id)
+
+
+def _ledger_file(tmp_path, entries, name="led.jsonl"):
+    path = str(tmp_path / name)
+    for e in entries:
+        ledger.append(path, e)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Verdict math
+# ---------------------------------------------------------------------------
+
+
+def test_regression_verdict_on_20pct_slowdown():
+    j = trend.judge_series([100.0, 101.0, 99.0, 100.0, 79.0],
+                           higher_is_better=True)
+    assert j["verdict"] == trend.VERDICT_REGRESSION
+    assert j["delta"] < -0.2
+    assert j["window_n"] == 4
+
+
+def test_improvement_and_direction_flip():
+    up = trend.judge_series([100.0, 100.0, 100.0, 130.0],
+                            higher_is_better=True)
+    assert up["verdict"] == trend.VERDICT_IMPROVEMENT
+    # seconds series: LOWER is better, same numbers regress.
+    down = trend.judge_series([100.0, 100.0, 100.0, 130.0],
+                              higher_is_better=False)
+    assert down["verdict"] == trend.VERDICT_REGRESSION
+
+
+def test_flat_inside_noise_band():
+    j = trend.judge_series([100.0, 104.0, 96.0, 100.0, 97.0],
+                           higher_is_better=True)
+    assert j["verdict"] == trend.VERDICT_FLAT
+    # The band widened past the floor by the window's own noise.
+    assert j["tolerance"] >= trend.DEFAULT_REL_FLOOR
+
+
+def test_noisy_history_widens_tolerance_over_floor():
+    noisy = [100.0, 140.0, 60.0, 120.0, 80.0, 100.0]
+    j = trend.judge_series(noisy, higher_is_better=True)
+    assert j["tolerance"] > 0.5  # 3 sigma of that spread
+    assert j["verdict"] == trend.VERDICT_FLAT
+
+
+@pytest.mark.parametrize("values,reason_frag", [
+    ([], "empty_series"),
+    ([100.0], "window_n=0"),
+    ([100.0, 101.0], "window_n=1"),          # single-run window
+    ([100.0, 101.0, 99.0], "window_n=2"),
+    ([None, None, None, 100.0], "window_n=0"),  # nulls never feed model
+    ([100.0, 101.0, 99.0, 100.0, None], "latest_null"),
+])
+def test_insufficient_data_cases(values, reason_frag):
+    j = trend.judge_series(values, higher_is_better=True)
+    assert j["verdict"] == trend.VERDICT_INSUFFICIENT
+    assert reason_frag in j["reason"]
+
+
+def test_zero_window_mean_is_insufficient_not_crash():
+    j = trend.judge_series([0.0, 0.0, 0.0, 5.0], higher_is_better=True)
+    assert j["verdict"] == trend.VERDICT_INSUFFICIENT
+    assert j["reason"] == "zero_window_mean"
+
+
+def test_window_limits_history():
+    # Ancient bad values fall out of the window: only the last `window`
+    # non-null points feed the model.
+    vals = [10.0] * 5 + [100.0, 101.0, 99.0, 100.0]
+    j = trend.judge_series(vals, higher_is_better=True, window=3)
+    assert j["verdict"] == trend.VERDICT_FLAT
+    assert j["window_n"] == 3
+    assert abs(j["mean"] - 100.0) < 2.0
+
+
+def test_moments_layout_matches_monitor():
+    """The (n, sum, sumsq) accumulator is the PR-7 streaming-moments
+    layout — same mean/std as the monitor's per-device accumulator."""
+    from ft_sgemm_tpu.telemetry.monitor import _Moments
+
+    vals = [1.0, 2.0, 3.5, -1.0]
+    a, b = trend.Moments(vals), _Moments()
+    for v in vals:
+        b.observe(v)
+    assert (a.n, a.sum, a.sumsq) == (b.n, b.sum, b.sumsq)
+    assert a.mean == b.mean and a.std == b.std
+
+
+# ---------------------------------------------------------------------------
+# Series collection: platforms separate, nulls recorded, drift series
+# ---------------------------------------------------------------------------
+
+
+def test_platforms_make_separate_series():
+    entries = ([_entry(f"a{i}", 100.0 + i, platform="v5e")
+                for i in range(4)]
+               + [_entry(f"b{i}", 50.0, platform="cpu")
+                  for i in range(2)])
+    series = trend.collect_series(entries)
+    assert "headline_gflops@v5e" in series
+    assert "headline_gflops@cpu" in series
+    assert len(series["headline_gflops@v5e"]["points"]) == 4
+    assert len(series["headline_gflops@cpu"]["points"]) == 2
+
+
+def test_null_headline_runs_are_null_points():
+    """The r02–r05 class: a bench run whose metric exists but measured
+    null lands as a null point — the latest-run verdict must say
+    insufficient (latest_null), not silently judge the previous run."""
+    entries = [_entry(f"r{i}", 100.0) for i in range(4)]
+    entries.append(_entry("killed", None,
+                          errors={"worker_rc": "killed"}))
+    report = trend.trend_report(entries)
+    row = [r for r in report["rows"]
+           if r["series"] == "headline_gflops@v5e"][0]
+    assert row["verdict"] == trend.VERDICT_INSUFFICIENT
+    assert row["reason"] == "latest_null"
+    assert row["latest_run"] == "killed"
+    assert trend.exit_code(report) == 0  # never fails a gate
+
+
+def test_fault_rate_and_slo_burn_drift():
+    def fc_entry(run_id, unc, burn):
+        doc = {"metric": "serve_goodput_rps", "value": 10.0,
+               "unit": "requests/s",
+               "context": {"serve": True, "platform_used": "cpu",
+                           "device_kind": "cpu",
+                           "fault_counters": {"calls": 1000,
+                                              "detections": 10,
+                                              "uncorrectable": unc},
+                           "slo": {"status": "OK", "burn_rate": burn,
+                                   "budget_remaining": 0.5}}}
+        return ledger.ingest(doc, run_id=run_id)
+
+    # Stable fault-rate/burn history, then both spike in the latest run.
+    entries = [fc_entry(f"r{i}", 1, 0.1) for i in range(5)]
+    entries.append(fc_entry("spike", 40, 3.0))
+    report = trend.trend_report(entries)
+    by_series = {r["series"]: r for r in report["rows"]}
+    fr = by_series["fault_rate@cpu"]
+    burn = by_series["slo_burn@cpu"]
+    assert fr["family"] == "drift" and burn["family"] == "drift"
+    assert fr["verdict"] == trend.VERDICT_REGRESSION
+    assert burn["verdict"] == trend.VERDICT_REGRESSION
+    assert trend.exit_code(report) == 1
+    # Flat drift history stays flat.
+    flat = trend.trend_report([fc_entry(f"f{i}", 1, 0.1)
+                               for i in range(6)])
+    assert trend.exit_code(flat) == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI gate acceptance pins
+# ---------------------------------------------------------------------------
+
+
+def test_cli_trend_gate_regression_exits_nonzero(tmp_path, capsys):
+    """ISSUE 10 acceptance: a synthetic ledger with an injected >=20%
+    headline slowdown exits nonzero with a regression verdict."""
+    entries = [_entry(f"r{i}", v) for i, v in
+               enumerate([25600.0, 25400.0, 25800.0, 25500.0])]
+    entries.append(_entry("slow", 25600.0 * 0.78))
+    path = _ledger_file(tmp_path, entries)
+    rc = cli_main(["cli", "trend", path, "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "regression" in out
+    assert "-2" in out  # the ~-22% delta is printed
+    # Without --gate the same report is informational (exit 0).
+    assert cli_main(["cli", "trend", path]) == 0
+
+
+def test_cli_trend_gate_flat_noise_exits_zero(tmp_path, capsys):
+    entries = [_entry(f"r{i}", 25600.0 * (1.0 + 0.02 * ((-1) ** i)))
+               for i in range(6)]
+    path = _ledger_file(tmp_path, entries)
+    rc = cli_main(["cli", "trend", path, "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "regression" not in out.replace("regression=0", "")
+
+
+def test_cli_trend_gate_insufficient_data_never_fails(tmp_path, capsys):
+    # The committed-seed shape: nulls and single runs everywhere.
+    entries = [_entry("r0", None), _entry("r1", 100.0),
+               _entry("r2", 55.0, metric="other_gflops",
+                      platform="cpu")]
+    path = _ledger_file(tmp_path, entries)
+    rc = cli_main(["cli", "trend", path, "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "insufficient_data" in out
+
+
+def test_cli_trend_over_committed_ledger_gates_clean(capsys):
+    """The REAL committed ledger (mostly-null r01–r05 + probes) must
+    read as insufficient data / flat — never a regression at seed."""
+    rc = cli_main(["cli", "trend", os.path.join(REPO, "LEDGER.jsonl"),
+                   "--gate"])
+    assert rc == 0
+
+
+def test_cli_trend_unreadable_ledger_exits_2(tmp_path):
+    assert cli_main(["cli", "trend",
+                     str(tmp_path / "missing.jsonl"), "--gate"]) == 2
+
+
+def test_cli_trend_json_format(tmp_path, capsys):
+    path = _ledger_file(tmp_path, [_entry(f"r{i}", 100.0)
+                                   for i in range(4)])
+    rc = cli_main(["cli", "trend", path, "--format=json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["counts"]["flat"] == 1
+    assert doc["rows"][0]["series"] == "headline_gflops@v5e"
+
+
+def test_cli_trend_param_flags(tmp_path, capsys):
+    # min-runs raised past the history -> insufficient; floor widened
+    # past the injected drop -> flat.
+    entries = [_entry(f"r{i}", v) for i, v in
+               enumerate([100.0, 100.0, 100.0, 100.0, 80.0])]
+    path = _ledger_file(tmp_path, entries)
+    assert cli_main(["cli", "trend", path, "--gate"]) == 1
+    capsys.readouterr()
+    assert cli_main(["cli", "trend", path, "--gate",
+                     "--min-runs=10"]) == 0
+    capsys.readouterr()
+    assert cli_main(["cli", "trend", path, "--gate", "--floor=0.3"]) == 0
+    capsys.readouterr()
+    assert cli_main(["cli", "trend", path, "--gate",
+                     "--floor=junk"]) == 2
