@@ -202,6 +202,40 @@ def _load_ledger_mod():
     return _LEDGER_MOD
 
 
+_LINT_FACTS = False  # False = not yet run; None = unavailable
+
+
+def _lint_facts():
+    """``{"findings", "seconds"}`` from one run of the static contract
+    checker (ft_sgemm_tpu/lint/core.py, path-loaded — stdlib-only by
+    contract, same discipline as the timeline/ledger modules), memoized
+    per process. Rides the RunReport manifest so the ledger's
+    ``lint.findings`` / ``lint.seconds`` series track checker health
+    longitudinally like any other measurement. None when the source
+    tree is not alongside this file (an installed wheel) or the checker
+    fails — observability must not fail the run."""
+    global _LINT_FACTS
+    if _LINT_FACTS is not False:
+        return _LINT_FACTS
+    try:
+        import importlib.util
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(root, "ft_sgemm_tpu", "lint", "core.py")
+        spec = importlib.util.spec_from_file_location("_ft_lint", path)
+        mod = importlib.util.module_from_spec(spec)
+        # Registered before exec: dataclasses (py3.10, PEP 563 strings)
+        # resolves the defining module through sys.modules.
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        facts = mod.lint_facts(root)
+        _LINT_FACTS = {"findings": facts["findings"],
+                       "seconds": facts["seconds"]}
+    except Exception:  # noqa: BLE001 — observability must not kill the run
+        _LINT_FACTS = None
+    return _LINT_FACTS
+
+
 def _ledger_append(artifact):
     """Append the just-emitted artifact line to the run ledger when
     ``FT_SGEMM_LEDGER=`` names one. Best-effort by construction: the
@@ -1889,6 +1923,9 @@ def _record_run_report(rec, live, tl=None):
         if cc_stats is not None:
             rec.ok("compile_cache", cc_stats)
             extra["compile_cache"] = cc_stats
+        lint = _lint_facts()
+        if lint is not None:
+            extra["lint"] = lint
         tl_summary = _tl_summary_for_report(tl)
         wall = None
         if tl_summary:
@@ -2127,6 +2164,9 @@ def _smoke_measure(context, *, device_kind=None, facts=None, tl=None):
             if cc_stats.get("reason"):
                 context["compile_cache_reason"] = cc_stats["reason"]
             extra["compile_cache"] = cc_stats
+        lint = _lint_facts()
+        if lint is not None:
+            extra["lint"] = lint
         tl_summary = _tl_summary_for_report(tl)
         wall = None
         if tl_summary:
@@ -2286,8 +2326,12 @@ def serve_main(argv):
         # (ISSUE 9: the artifact embeds the SLO/budget snapshot).
         from ft_sgemm_tpu.perf.report import RunReport, build_manifest
 
+        serve_extra = {"serve": True}
+        lint = _lint_facts()
+        if lint is not None:
+            serve_extra["lint"] = lint
         context["run_report"] = RunReport(
-            manifest=build_manifest(extra={"serve": True}),
+            manifest=build_manifest(extra=serve_extra),
             stages=[], slo=context.get("slo")).to_dict()
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["run_report"] = f"{type(e).__name__}: {e}"

@@ -25,21 +25,13 @@ automatically pipelined VMEM blocks.
 
 from ft_sgemm_tpu import perf, serve, telemetry, tuner, utils
 from ft_sgemm_tpu.configs import (
-    KernelShape,
-    SHAPES,
     ENCODE_MODES,
     KERNEL_TABLE,
+    SHAPES,
+    KernelShape,
     kernel_for_id,
 )
 from ft_sgemm_tpu.injection import InjectionSpec
-from ft_sgemm_tpu.ops.reference import sgemm_reference
-from ft_sgemm_tpu.ops.sgemm import make_sgemm, sgemm
-from ft_sgemm_tpu.ops.ft_sgemm import (
-    STRATEGIES,
-    FtSgemmResult,
-    ft_sgemm,
-    make_ft_sgemm,
-)
 from ft_sgemm_tpu.ops.abft_baseline import abft_baseline_sgemm
 from ft_sgemm_tpu.ops.attention import (
     FtAttentionResult,
@@ -53,6 +45,14 @@ from ft_sgemm_tpu.ops.autodiff import (
     ft_matmul,
     make_ft_matmul,
 )
+from ft_sgemm_tpu.ops.ft_sgemm import (
+    STRATEGIES,
+    FtSgemmResult,
+    ft_sgemm,
+    make_ft_sgemm,
+)
+from ft_sgemm_tpu.ops.reference import sgemm_reference
+from ft_sgemm_tpu.ops.sgemm import make_sgemm, sgemm
 
 __version__ = "0.1.0"
 

@@ -66,6 +66,8 @@ Usage:
         [--run-id=ID]
     python -m ft_sgemm_tpu.cli trace-export RUN.timeline.jsonl \
         [--events=LOG.jsonl] [--out=TRACE.json] [--run-id=ID]
+    python -m ft_sgemm_tpu.cli lint [--format=text|json] \
+        [--only=CHECK,...] [--allowlist=PATH] [--root=DIR]
 
 ``report`` renders the RunReport a bench artifact embeds
 (``ft_sgemm_tpu.perf``): the environment manifest (device, jax/jaxlib,
@@ -215,6 +217,19 @@ compile spans on per-kind tracks, faults as instants with tile coords,
 serve requests as flows joined by ``trace_id`` across
 enqueue→flush→detect→retry — loadable directly in Perfetto or
 ``chrome://tracing``.
+
+Static analysis (``ft_sgemm_tpu.lint``, DESIGN.md §14): ``lint`` runs
+the repo-native static contract checker — five AST passes verifying the
+hand-maintained invariants (stdlib-only/path-loadable modules, kernel-
+axis spellings across configs/vmem/tuner/telemetry/serve/CLI, lock-
+guarded shared state, the SMEM scalar-slot ABI, the declared telemetry
+schema) against the literal declarations in ``contracts.py`` /
+``configs.py``. Exit 0 clean, 1 findings (or stale allowlist entries),
+2 internal error — the ``bench-compare`` contract; CI runs it blocking.
+``--only=`` selects checks; audited-safe findings ride the committed
+``lint-allowlist.json`` (one justification per entry). The checker
+itself is stdlib-only: ``python ft_sgemm_tpu/lint/core.py`` runs it by
+file path with no jax anywhere in the process.
 """
 
 from __future__ import annotations
@@ -224,12 +239,12 @@ import os
 import sys
 import time
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ft_sgemm_tpu.configs import (
+    DEFAULT_STRATEGY,
     ENCODE_MODES,
     IN_DTYPES,
     KERNEL_TABLE,
@@ -1550,6 +1565,12 @@ def main(argv=None) -> int:
     argv = list(sys.argv if argv is None else argv)
     args = [a for a in argv[1:] if not a.startswith("--")]
     flags = {a for a in argv[1:] if a.startswith("--")}
+    if args and args[0] == "lint":
+        # The linter is stdlib-only and reads declarations via ast; its
+        # own main() parses the flag set (order-independent).
+        from ft_sgemm_tpu.lint.core import main as lint_main
+
+        return lint_main(sorted(flags))
     if args and args[0] == "tune":
         return run_tune(args[1:], flags)
     if args and args[0] == "tune-show":
@@ -1746,10 +1767,11 @@ def main(argv=None) -> int:
         # weighted is the reference default, but int8 only ships the
         # exact strategies (configs.check_kernel_legality); an explicit
         # illegal --strategy= still errors with the constraint.
-        strategy = "rowcol" if in_dtype == "int8" else "weighted"
+        strategy = DEFAULT_STRATEGY[in_dtype]
         if in_dtype == "int8":
-            print("--dtype=int8: defaulting --strategy=rowcol (weighted-"
-                  "ratio localization is illegal for int8)", file=sys.stderr)
+            print(f"--dtype=int8: defaulting --strategy={strategy}"
+                  " (weighted-ratio localization is illegal for int8)",
+                  file=sys.stderr)
 
     if telemetry_log is not None:
         # Observability mode: events + host-side residual measurements

@@ -32,8 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from ft_sgemm_tpu.configs import SHAPES, SHAPE_ORDER
-from ft_sgemm_tpu.ops.common import dtype_suffix
 from ft_sgemm_tpu.injection import InjectionSpec
+from ft_sgemm_tpu.ops.common import dtype_suffix
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
 

@@ -100,6 +100,14 @@ def sublane_granule(in_itemsize: int) -> int:
     return {4: 8, 2: 16, 1: 32}[in_itemsize]
 
 
+# Fused-ABFT checksum strategies of the FT kernel family (ops/ft_sgemm;
+# hoisted here so every axis of the kernel family — strategy, encode,
+# threshold, dtype — has ONE declaration module the static contract
+# checker (ft_sgemm_tpu/lint, "axis-drift" pass) can read and cross-check
+# against the tuner key, vmem variants, telemetry labels, serve routing,
+# and CLI spellings). ops/ft_sgemm re-exports this name unchanged.
+STRATEGIES = ("rowcol", "global", "weighted", "fused")
+
 # Checksum-encode modes of the FT kernel family (ops/ft_sgemm):
 #   "vpu" — per-K-step whole-tile VPU reductions build the expected
 #           checksums (the original design; the default).
@@ -140,6 +148,40 @@ _IN_DTYPE_ALIASES = {
     "float8_e4m3": "float8_e4m3fn",
 }
 
+# Per-dtype axis legality as STATIC tables (DESIGN.md §10 derives each
+# constraint; :func:`check_kernel_legality` raises the derivations as
+# errors). These are data, not code, so the static contract checker can
+# cross-check them against every other spelling of the axes without
+# importing anything:
+#   - 1-byte dtypes cannot carry checksum rows (encode="mxu" /
+#     strategy="fused" saturate/overflow the operand dtype);
+#   - int8 ships only the non-ratio-localizing strategies (wrapping int32
+#     checksums cannot guarantee the weighted-residual ratio).
+STRATEGY_LEGALITY = {
+    "float32": ("rowcol", "global", "weighted", "fused"),
+    "bfloat16": ("rowcol", "global", "weighted", "fused"),
+    "float8_e4m3fn": ("rowcol", "global", "weighted"),
+    "int8": ("rowcol", "global"),
+}
+ENCODE_LEGALITY = {
+    "float32": ("vpu", "mxu"),
+    "bfloat16": ("vpu", "mxu"),
+    "float8_e4m3fn": ("vpu",),
+    "int8": ("vpu",),
+}
+# The strategy an entry point defaults to when the caller names only a
+# dtype: the family flagship (weighted — deferred localization, lowest
+# overhead) wherever legal, rowcol for int8 (the exact path ships no
+# ratio localization). serve/buckets.py and the CLI route from THIS
+# table — one declaration, machine-checked, instead of per-site
+# ``"rowcol" if dtype == "int8" else "weighted"`` spellings.
+DEFAULT_STRATEGY = {
+    "float32": "weighted",
+    "bfloat16": "weighted",
+    "float8_e4m3fn": "weighted",
+    "int8": "rowcol",
+}
+
 
 def canonical_in_dtype(in_dtype) -> str:
     """The canonical ``IN_DTYPES`` name for one in-dtype spelling.
@@ -171,8 +213,12 @@ def check_kernel_legality(*, strategy: str, encode: str, in_dtype,
                           multifault: Optional[bool] = None) -> str:
     """Validate one (strategy, encode, dtype, threshold-mode) combination.
 
-    Returns the canonical dtype name. The low-precision constraints are
-    representational, not policy (DESIGN.md §10 derives each):
+    Returns the canonical dtype name. The constraints themselves live in
+    the static :data:`STRATEGY_LEGALITY` / :data:`ENCODE_LEGALITY`
+    tables (machine-checked by the lint subsystem); this function turns
+    a violation into the explanatory error. The low-precision
+    constraints are representational, not policy (DESIGN.md §10 derives
+    each):
 
     - **1-byte dtypes cannot carry checksum rows** (``encode="mxu"`` /
       ``strategy="fused"``): an augmented-operand checksum row holds sums
@@ -193,7 +239,7 @@ def check_kernel_legality(*, strategy: str, encode: str, in_dtype,
         raise ValueError(
             f"unknown threshold mode {threshold_mode!r}; pick from"
             f" {THRESHOLD_MODES}")
-    if dtype_name in ("float8_e4m3fn", "int8"):
+    if "mxu" not in ENCODE_LEGALITY[dtype_name]:
         if encode == "mxu" or strategy == "fused":
             raise ValueError(
                 f"encode='mxu' (and strategy='fused') is illegal for"
@@ -202,11 +248,11 @@ def check_kernel_legality(*, strategy: str, encode: str, in_dtype,
                 " encode='vpu' (checksums are computed in the 32-bit"
                 " accumulation domain there)")
     if dtype_name == "int8":
-        if strategy not in ("rowcol", "global"):
+        if strategy not in STRATEGY_LEGALITY["int8"]:
             raise ValueError(
                 f"strategy {strategy!r} is illegal for int8: weighted-"
                 "ratio fault localization needs non-wrapping moment"
-                " checksums; int8 supports ('rowcol', 'global')")
+                f" checksums; int8 supports {STRATEGY_LEGALITY['int8']}")
         if multifault:
             raise ValueError(
                 "multifault=True is illegal for int8: the multifault"

@@ -1,0 +1,84 @@
+"""Static contracts: the hand-maintained invariants, declared as data.
+
+Several of the repo's hardest-won disciplines existed only as prose in
+DESIGN.md and as reactive fixes (the PR-8 tuner-cache single-flight race
+was found at runtime, not review). This module turns them into literal
+tables a machine can read — the declaration half of the static contract
+checker (``ft_sgemm_tpu/lint``, DESIGN.md §14), which parses this file
+with ``ast`` (never imports it alongside jax) and cross-checks the
+claims below against the actual source tree on every CI run.
+
+Everything here is a pure literal: the checker extracts values
+statically, and the module itself is one of its own stdlib-only targets
+(loadable by file path from the jax-free bench supervisor and the CI
+path-loadability smoke, ``scripts/stdlib_smoke.py``).
+"""
+
+from __future__ import annotations
+
+# --- stdlib-only / path-loadable modules -------------------------------
+#
+# Modules the jax-free supervisor side of the system (bench.py's
+# monitor process, scripts/{ingest_ledger,regen_results,summarize_bench},
+# CI smoke steps) loads by FILE PATH via importlib — so they must import
+# ONLY the standard library at module scope (collaborators lazy +
+# injectable), use no relative imports anywhere, and stay loadable in a
+# bare ``python -S`` process with no site-packages at all. The lint
+# subsystem's import-graph pass enforces all three statically; the CI
+# smoke proves it dynamically. Paths are repo-relative.
+STDLIB_ONLY_MODULES = (
+    "ft_sgemm_tpu/contracts.py",
+    "ft_sgemm_tpu/lint/core.py",
+    "ft_sgemm_tpu/perf/compile_cache.py",
+    "ft_sgemm_tpu/perf/ledger.py",
+    "ft_sgemm_tpu/perf/trend.py",
+    "ft_sgemm_tpu/perf/wallclock.py",
+    "ft_sgemm_tpu/serve/tracing.py",
+    "ft_sgemm_tpu/telemetry/monitor.py",
+    "ft_sgemm_tpu/telemetry/timeline.py",
+    "ft_sgemm_tpu/telemetry/traceview.py",
+)
+
+# --- SMEM scalar-operand slot map --------------------------------------
+#
+# Every FT Pallas kernel body receives ONE flat SMEM scalar operand
+# (``inj_ref``) carrying the injection spec and the runtime thresholds
+# (ops/ft_sgemm.py builds it; thresholds ride as runtime scalars so
+# auto/traced thresholds cost zero recompiles). The slot assignments are
+# a cross-kernel ABI: two kernel bodies reading the same index MUST mean
+# the same thing by it, or a silent mis-parameterization ships. The
+# table maps each slot to its canonical meaning and the accepted
+# binding spellings (the variable or keyword name a kernel body binds
+# the read to — how the lint smem-slots pass verifies meaning
+# statically). Slots 0-3 are the injection spec (PR 1), 4-6 the
+# detect/correct thresholds (PR 3), 7 the adaptive margin (PR 7).
+SCALAR_SLOTS = {
+    0: ("inject_enabled", ("enabled",)),
+    1: ("inject_every", ("every",)),
+    2: ("inject_magnitude", ("magnitude",)),
+    3: ("inject_col_stride", ("col_stride",)),
+    4: ("detect_threshold", ("threshold",)),
+    5: ("moment1_recheck_threshold", ("thr_m1",)),
+    6: ("moment2_recheck_threshold", ("thr_m2",)),
+    7: ("adaptive_margin", ("margin",)),
+}
+
+# Total scalar-operand length when every slot rides along (4 injection
+# + 3 threshold slots always; slot 7 appended in adaptive mode).
+N_SCALAR_SLOTS = 8
+
+# --- kernel-axis declaration sources -----------------------------------
+#
+# The six places the kernel axes (strategy x encode x dtype x threshold
+# x bucket) are spelled — ROADMAP item 5's hand-threading surface. The
+# lint axis-drift pass reads every one of these files and cross-checks
+# the spellings; a new axis value added in one place but not the others
+# is a finding. Paths are repo-relative.
+AXIS_DECLARATION_SOURCES = (
+    "ft_sgemm_tpu/configs.py",          # the axis tuples + legality tables
+    "ft_sgemm_tpu/ops/vmem.py",         # per-variant VMEM footprint names
+    "ft_sgemm_tpu/tuner/cache.py",      # cache-key components (enc=/thr=)
+    "ft_sgemm_tpu/telemetry/events.py",  # event label schema mirror
+    "ft_sgemm_tpu/serve/buckets.py",    # bucket legality + dtype routing
+    "ft_sgemm_tpu/cli.py",              # user-facing flag spellings
+)

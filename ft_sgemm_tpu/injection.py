@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax.numpy as jnp
 import numpy as np
 
 # Reference constants (include_code_gen/ft_sgemm_huge.cuh:49-51).

@@ -1,0 +1,25 @@
+"""Static contract checking (ftlint) — see :mod:`.core` for the passes.
+
+``core.py`` is deliberately self-contained and stdlib-only (it is one of
+its own declared stdlib-only targets, ``contracts.STDLIB_ONLY_MODULES``):
+CI and the jax-free bench supervisor run it BY FILE PATH
+(``python ft_sgemm_tpu/lint/core.py``). This package init exists for the
+ergonomic in-process spellings — ``python -m ft_sgemm_tpu.cli lint`` and
+``from ft_sgemm_tpu.lint import run_lint`` — which accept the package
+import cost (including jax, via the package root) that the path-loaded
+entry avoids.
+"""
+
+from ft_sgemm_tpu.lint.core import (
+    CHECK_ORDER,
+    Finding,
+    LintResult,
+    format_text,
+    lint_facts,
+    load_allowlist,
+    main,
+    run_lint,
+)
+
+__all__ = ["CHECK_ORDER", "Finding", "LintResult", "format_text",
+           "lint_facts", "load_allowlist", "main", "run_lint"]

@@ -1,0 +1,12 @@
+"""``python -m ft_sgemm_tpu.lint`` — the in-process linter entry.
+
+(For the zero-jax invocation CI uses, run the file by path instead:
+``python ft_sgemm_tpu/lint/core.py``.)
+"""
+
+import sys
+
+from ft_sgemm_tpu.lint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

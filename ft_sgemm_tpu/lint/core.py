@@ -1,0 +1,1234 @@
+"""ftlint — the repo-native static contract checker.
+
+Every hand-maintained invariant this codebase runs on — stdlib-only /
+path-loadable supervisor modules, the kernel-axis spellings threaded
+through six subsystems (ROADMAP item 5), lock-guarded shared state, the
+SMEM scalar-slot ABI, the declared telemetry schema — used to live as
+prose in DESIGN.md and get enforced by review (or, as with the PR-8
+tuner-cache single-flight race, by a runtime failure). This module makes
+them machine-verified at commit time: five AST-based passes over the
+source tree, cross-checking the code against the literal declarations in
+``ft_sgemm_tpu/contracts.py``, ``configs.py``, ``telemetry/events.py``,
+``telemetry/timeline.py`` and ``telemetry/registry.py``.
+
+Passes (check names; ``--only=`` selects a subset):
+
+  import-graph      stdlib-only modules import nothing but the standard
+                    library at module scope (and nothing jax-importing
+                    transitively), no relative imports anywhere in them,
+                    and the whole package's module-level import graph is
+                    acyclic.
+  axis-drift        every spelling of the strategy/encode/dtype/threshold
+                    axes — configs tables, vmem variant names, tuner
+                    cache-key components, telemetry label schema, serve
+                    routing, CLI flag docs and axis-named assignments —
+                    agrees with the configs declarations.
+  lock-discipline   module-level mutable state written from any function
+                    reachable from a ``threading.Thread`` target or the
+                    serve/monitor request paths must be written under a
+                    ``with <lock>:`` in the same function (audited-safe
+                    cases ride the committed allowlist).
+  smem-slots        every ``inj_ref[<const>]`` read in a Pallas kernel
+                    body matches the declared scalar-slot table
+                    (``contracts.SCALAR_SLOTS``): no undeclared slot, no
+                    slot silently claimed for a different meaning.
+  telemetry-schema  every emitted event outcome, timeline kind, and
+                    metric family appears in the declared schema and has
+                    a curated ``# HELP`` string.
+
+Exit contract (the ``perf/compare.py`` convention): 0 clean, 1 findings,
+2 internal error. ``lint-allowlist.json`` at the repo root suppresses
+audited-safe findings — each entry carries a one-line justification, and
+a stale entry (nothing matches it anymore) is itself a finding so the
+allowlist can only shrink honestly.
+
+HARD CONSTRAINT — stdlib only, fully self-contained: this file is one of
+its own stdlib-only targets. It imports ONLY the standard library, never
+imports the package it checks (declarations are read via ``ast``), and
+runs by file path (``python ft_sgemm_tpu/lint/core.py``) in a bare
+interpreter with no jax — which is exactly how the CI static-analysis
+job invokes it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+LINT_VERSION = 1
+
+# Relative paths (from the repo root) of the declaration sources every
+# run must be able to read; a missing one is an internal error, not a
+# clean pass.
+CONTRACTS_PATH = "ft_sgemm_tpu/contracts.py"
+CONFIGS_PATH = "ft_sgemm_tpu/configs.py"
+VMEM_PATH = "ft_sgemm_tpu/ops/vmem.py"
+TUNER_CACHE_PATH = "ft_sgemm_tpu/tuner/cache.py"
+EVENTS_PATH = "ft_sgemm_tpu/telemetry/events.py"
+TIMELINE_PATH = "ft_sgemm_tpu/telemetry/timeline.py"
+REGISTRY_PATH = "ft_sgemm_tpu/telemetry/registry.py"
+BUCKETS_PATH = "ft_sgemm_tpu/serve/buckets.py"
+CLI_PATH = "ft_sgemm_tpu/cli.py"
+
+DEFAULT_ALLOWLIST = "lint-allowlist.json"
+
+# Modules whose every function is treated as running on a request/serve
+# thread (the lock-discipline threat roots, beyond explicit
+# ``threading.Thread(target=...)`` sites).
+THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
+                    "ft_sgemm_tpu/telemetry/monitor.py")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation: which check, where, and what drifted."""
+
+    check: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def key(self) -> Tuple[str, str, str]:
+        """The allowlist identity: (check, path, symbol) — line numbers
+        churn with unrelated edits and deliberately do not key."""
+        return (self.check, self.path, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.symbol}: "
+                f"{self.message}")
+
+
+class Repo:
+    """The parsed source tree one lint run checks.
+
+    Scans ``ft_sgemm_tpu/**/*.py`` plus ``bench.py`` and ``scripts/*.py``
+    when present (the emission checks cover the supervisor and tooling
+    too). Trees are parsed once and shared by every pass.
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.trees: Dict[str, ast.Module] = {}
+        self.sources: Dict[str, str] = {}
+        self.errors: List[Finding] = []
+        pkg = os.path.join(self.root, "ft_sgemm_tpu")
+        paths = []
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    paths.append(os.path.join(dirpath, name))
+        for extra in ("bench.py",):
+            p = os.path.join(self.root, extra)
+            if os.path.isfile(p):
+                paths.append(p)
+        scripts = os.path.join(self.root, "scripts")
+        if os.path.isdir(scripts):
+            paths.extend(os.path.join(scripts, n)
+                         for n in sorted(os.listdir(scripts))
+                         if n.endswith(".py"))
+        for path in paths:
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                self.sources[rel] = src
+                self.trees[rel] = ast.parse(src, filename=rel)
+            except (OSError, SyntaxError) as e:
+                self.errors.append(Finding(
+                    "internal", rel, getattr(e, "lineno", 0) or 0,
+                    "parse", f"unparseable source: {e}"))
+
+    def package_files(self) -> List[str]:
+        return [p for p in self.trees if p.startswith("ft_sgemm_tpu/")]
+
+    def tree(self, rel: str) -> Optional[ast.Module]:
+        return self.trees.get(rel)
+
+    def module_name(self, rel: str) -> Optional[str]:
+        """Dotted module name for a package file, None outside it."""
+        if not rel.startswith("ft_sgemm_tpu/"):
+            return None
+        mod = rel[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+# --- small AST helpers --------------------------------------------------
+
+def module_literals(tree: ast.Module) -> Dict[str, object]:
+    """Module-level ``NAME = <literal>`` assignments, best-effort
+    evaluated (non-literal values are skipped, never an error)."""
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            try:
+                out[node.targets[0].id] = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError, TypeError):
+                pass
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_fragments(node: ast.AST) -> List[str]:
+    """The constant string fragments of a JoinedStr (or a plain str)."""
+    if isinstance(node, ast.JoinedStr):
+        return [v.value for v in node.values
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+    s = str_const(node)
+    return [s] if s is not None else []
+
+
+def iter_functions(tree: ast.Module):
+    """Yield ``(qualname, class_name_or_None, node)`` for every function
+    and method (qualname is ``Class.method`` for methods)."""
+    def walk(body, prefix, cls):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (prefix + node.name, cls, node)
+                yield from walk(node.body, prefix + node.name + ".", cls)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, prefix + node.name + ".",
+                                node.name)
+    yield from walk(tree.body, "", None)
+
+
+def stdlib_names() -> frozenset:
+    return frozenset(sys.stdlib_module_names) | {"__future__"}
+
+
+# --- checker registry ---------------------------------------------------
+
+CHECKERS: Dict[str, Callable] = {}
+CHECK_ORDER: List[str] = []
+
+
+def checker(name: str):
+    """Register one pass. A checker is ``fn(repo, decls) -> (findings,
+    sources_read)`` — adding a pass is one decorated function (DESIGN.md
+    §14 documents the extension contract)."""
+    def deco(fn):
+        CHECKERS[name] = fn
+        CHECK_ORDER.append(name)
+        return fn
+    return deco
+
+
+class Declarations:
+    """The literal contract tables, AST-extracted from their owning
+    modules (the linter never imports the package it checks)."""
+
+    def __init__(self, repo: Repo):
+        self.missing: List[str] = []
+
+        def lits(rel):
+            tree = repo.tree(rel)
+            if tree is None:
+                self.missing.append(rel)
+                return {}
+            return module_literals(tree)
+
+        contracts = lits(CONTRACTS_PATH)
+        configs = lits(CONFIGS_PATH)
+        vmem = lits(VMEM_PATH)
+        events = lits(EVENTS_PATH)
+        timeline = lits(TIMELINE_PATH)
+        registry = lits(REGISTRY_PATH)
+        tuner = lits(TUNER_CACHE_PATH)
+
+        self.stdlib_only = tuple(contracts.get("STDLIB_ONLY_MODULES", ()))
+        self.scalar_slots = dict(contracts.get("SCALAR_SLOTS", {}))
+        self.n_scalar_slots = contracts.get("N_SCALAR_SLOTS", 0)
+        self.axis_sources = tuple(
+            contracts.get("AXIS_DECLARATION_SOURCES", ()))
+
+        self.strategies = tuple(configs.get("STRATEGIES", ()))
+        self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
+        self.threshold_modes = tuple(configs.get("THRESHOLD_MODES", ()))
+        self.in_dtypes = tuple(configs.get("IN_DTYPES", ()))
+        self.dtype_aliases = dict(configs.get("_IN_DTYPE_ALIASES", {}))
+        self.strategy_legality = dict(configs.get("STRATEGY_LEGALITY", {}))
+        self.encode_legality = dict(configs.get("ENCODE_LEGALITY", {}))
+        self.default_strategy = dict(configs.get("DEFAULT_STRATEGY", {}))
+
+        self.vmem_variants = tuple(vmem.get("TEMP_TILE_FACTORS", {}))
+        self.vmem_smem = tuple(vmem.get("_SMEM_SCRATCH_BYTES", {}))
+
+        self.outcomes = tuple(events.get("OUTCOMES", ()))
+        self.axis_labels = dict(events.get("AXIS_LABELS", {}))
+        self.timeline_kinds = tuple(timeline.get("KINDS", ()))
+        self.metric_help = dict(registry.get("_METRIC_HELP", {}))
+        self.metric_help_prefixes = dict(
+            registry.get("_METRIC_HELP_PREFIXES", {}))
+        self.tuner_schema_version = tuner.get("SCHEMA_VERSION")
+
+    def dtype_spellings(self) -> frozenset:
+        return frozenset(self.in_dtypes) | frozenset(self.dtype_aliases)
+
+
+# --- pass 1: import-graph ----------------------------------------------
+
+def _module_level_imports(tree: ast.Module):
+    """Every import statement NOT nested in a function: ``(node,
+    module-name, relative-level, from-names)``. Class bodies and
+    module-level if/try blocks count (they execute at import time).
+    ``from-names`` lets the resolver distinguish ``from pkg import
+    submodule`` (an edge to the submodule) from a symbol import (an
+    edge to ``pkg`` itself)."""
+    out = []
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    out.append((node, alias.name, None, ()))
+            elif isinstance(node, ast.ImportFrom):
+                out.append((node, node.module or "", node.level,
+                            tuple(a.name for a in node.names)))
+            for child_body in (getattr(node, "body", []),
+                               getattr(node, "orelse", []),
+                               getattr(node, "finalbody", [])):
+                if isinstance(child_body, list):
+                    walk(child_body)
+            for handler in getattr(node, "handlers", []):
+                walk(handler.body)
+    walk(tree.body)
+    return out
+
+
+@checker("import-graph")
+def check_import_graph(repo: Repo, decls: Declarations):
+    findings: List[Finding] = []
+    sources = [CONTRACTS_PATH]
+    stdlib = stdlib_names()
+
+    # Module-level intra-package import graph over dotted names.
+    mod_of = {}  # dotted module -> rel path
+    for rel in repo.package_files():
+        mod = repo.module_name(rel)
+        if mod:
+            mod_of[mod] = rel
+    edges: Dict[str, List[str]] = {}
+    nonstd: Dict[str, List[str]] = {}  # rel -> non-stdlib top imports
+    for rel in repo.package_files():
+        tree = repo.tree(rel)
+        mod = repo.module_name(rel)
+        if tree is None or mod is None:
+            continue
+        edges.setdefault(mod, [])
+        for node, name, level, from_names in _module_level_imports(tree):
+            if level:  # relative import at module level
+                base = mod.split(".")
+                # level=1 from a module strips the module name itself.
+                target = ".".join(base[:-level] + ([name] if name else []))
+            else:
+                target = name
+            top = target.split(".")[0]
+            if top == "ft_sgemm_tpu":
+                # ``from pkg import sub`` binds the SUBMODULE when one
+                # exists — edge to it, not to pkg's __init__ (the
+                # aggregator-root idiom is not a cycle).
+                targets = []
+                for fn in from_names or ("",):
+                    cand = f"{target}.{fn}" if fn else target
+                    t = cand
+                    while t and t not in mod_of and "." in t:
+                        t = t.rsplit(".", 1)[0]
+                    if t in mod_of and t != mod:
+                        targets.append(t)
+                edges[mod].extend(sorted(set(targets)))
+            elif top not in stdlib:
+                nonstd.setdefault(rel, []).append(
+                    f"{target} (line {node.lineno})")
+
+    # Cycle detection (module-level edges only — a cycle there is an
+    # import-time hazard; lazy in-function cycles are the sanctioned
+    # escape and are not flagged).
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {m: WHITE for m in edges}
+    stack: List[str] = []
+
+    def dfs(m):
+        color[m] = GRAY
+        stack.append(m)
+        for dep in edges.get(m, ()):
+            if color.get(dep, WHITE) == GRAY:
+                cyc = stack[stack.index(dep):] + [dep]
+                findings.append(Finding(
+                    "import-graph", mod_of.get(m, m), 1,
+                    "cycle:" + "->".join(cyc),
+                    "module-level import cycle: " + " -> ".join(cyc)))
+            elif color.get(dep, WHITE) == WHITE:
+                dfs(dep)
+        stack.pop()
+        color[m] = BLACK
+
+    for m in sorted(edges):
+        if color[m] == WHITE:
+            dfs(m)
+
+    # Transitive jax/third-party reachability per module (module-level).
+    def reaches_nonstd(mod, seen):
+        rel = mod_of.get(mod)
+        if rel in nonstd:
+            return [rel]
+        seen.add(mod)
+        for dep in edges.get(mod, ()):
+            if dep in seen:
+                continue
+            chain = reaches_nonstd(dep, seen)
+            if chain is not None:
+                return [mod_of.get(mod, mod)] + chain
+        return None
+
+    declared = set(decls.stdlib_only)
+    for rel in sorted(declared):
+        sources.append(rel)
+        if not rel.startswith("ft_sgemm_tpu/"):
+            continue
+        tree = repo.tree(rel)
+        if tree is None:
+            findings.append(Finding(
+                "import-graph", CONTRACTS_PATH, 1, rel,
+                "declared stdlib-only module does not exist"))
+            continue
+        mod = repo.module_name(rel)
+        # (a) direct non-stdlib imports at module scope.
+        for msg in nonstd.get(rel, ()):
+            findings.append(Finding(
+                "import-graph", rel, int(msg.rsplit("line ", 1)[1][:-1]),
+                msg.split(" ")[0],
+                "stdlib-only module imports a non-stdlib module at module"
+                f" scope: {msg} (lazy + injectable is the discipline)"))
+        # (b) intra-package module-level imports: allowed only toward
+        # other DECLARED stdlib-only modules (anything else could pull
+        # jax transitively and always breaks path-loading).
+        for dep in edges.get(mod, ()):
+            dep_rel = mod_of.get(dep, dep)
+            if dep_rel not in declared:
+                findings.append(Finding(
+                    "import-graph", rel, 1, dep,
+                    f"stdlib-only module imports sibling {dep} at module"
+                    " scope, which is not itself declared stdlib-only"
+                    " (transitive jax risk; breaks path-loading)"))
+            else:
+                chain = reaches_nonstd(dep, set())
+                if chain:
+                    findings.append(Finding(
+                        "import-graph", rel, 1, dep,
+                        "stdlib-only module transitively reaches a"
+                        " non-stdlib import: " + " -> ".join(chain)))
+        # (c) path-loadability: relative imports anywhere in the file
+        # (even lazy ones explode when the file is loaded by path).
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level:
+                findings.append(Finding(
+                    "import-graph", rel, node.lineno,
+                    f"from {'.' * node.level}{node.module or ''}",
+                    "relative import in a path-loadable module (the"
+                    " jax-free supervisor loads this file by path; it"
+                    " has no package context)"))
+    return findings, sources
+
+
+# --- pass 2: axis-drift -------------------------------------------------
+
+# Variable / keyword names whose string values ARE axis values.
+AXIS_VAR_SETS = {
+    "strategy": "strategies",
+    "encode": "encode_modes",
+    "threshold_mode": "threshold_modes",
+    "in_dtype": "dtypes",
+}
+
+
+def _value_consts(node: ast.AST) -> List[ast.Constant]:
+    """String constants an expression can EVALUATE TO: a bare constant,
+    the branches of a ternary, the arms of an ``or`` chain. Function
+    arguments and subscripts inside the expression are deliberately not
+    walked (``f.split("=")`` must not read as an axis value)."""
+    if str_const(node) is not None:
+        return [node]  # type: ignore[list-item]
+    if isinstance(node, ast.IfExp):
+        return _value_consts(node.body) + _value_consts(node.orelse)
+    if isinstance(node, ast.BoolOp):
+        out = []
+        for v in node.values:
+            out.extend(_value_consts(v))
+        return out
+    return []
+
+
+def _axis_value_uses(tree: ast.Module):
+    """Yield ``(axis_var, value, lineno)`` for string constants bound to
+    axis-named variables: assignments (incl. ternaries), equality /
+    membership comparisons, and keyword arguments."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            name = None
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                name = t.id
+            if name in AXIS_VAR_SETS:
+                for sub in _value_consts(node.value):
+                    yield name, sub.value, sub.lineno
+        elif isinstance(node, ast.Compare):
+            left = node.left
+            lname = left.id if isinstance(left, ast.Name) else (
+                left.attr if isinstance(left, ast.Attribute) else None)
+            if lname in AXIS_VAR_SETS:
+                for comp in node.comparators:
+                    vals = ([comp] if str_const(comp) is not None else
+                            list(getattr(comp, "elts", [])))
+                    for v in vals:
+                        s = str_const(v)
+                        if s is not None:
+                            yield lname, s, v.lineno
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in AXIS_VAR_SETS:
+                    s = str_const(kw.value)
+                    if s is not None:
+                        yield kw.arg, s, kw.value.lineno
+
+
+def _cli_doc_axes(doc: str):
+    """``--strategy=a|b`` style spellings from the CLI module docstring:
+    yields (flag, token, approximate_line)."""
+    import re
+
+    for lineno, line in enumerate(doc.splitlines(), 2):
+        for m in re.finditer(
+                r"--(strategy|encode|threshold|dtype)=([A-Za-z0-9_.|]+)",
+                line):
+            flag = m.group(1)
+            for token in m.group(2).split("|"):
+                if token and not token.startswith("..."):
+                    yield flag, token, lineno
+
+
+@checker("axis-drift")
+def check_axis_drift(repo: Repo, decls: Declarations):
+    findings: List[Finding] = []
+    sources = list(decls.axis_sources) or [
+        CONFIGS_PATH, VMEM_PATH, TUNER_CACHE_PATH, EVENTS_PATH,
+        BUCKETS_PATH, CLI_PATH]
+
+    def f(path, line, symbol, message):
+        findings.append(Finding("axis-drift", path, line, symbol, message))
+
+    strategies = set(decls.strategies)
+    encodes = set(decls.encode_modes)
+    thresholds = set(decls.threshold_modes)
+    dtypes = set(decls.in_dtypes)
+    if not (strategies and encodes and thresholds and dtypes):
+        f(CONFIGS_PATH, 1, "declarations",
+          "configs axis declarations missing or non-literal "
+          "(STRATEGIES/ENCODE_MODES/THRESHOLD_MODES/IN_DTYPES)")
+        return findings, sources
+
+    # (1) configs' own tables are closed over the declared axes.
+    for tname, table, domain, universe in (
+            ("STRATEGY_LEGALITY", decls.strategy_legality, dtypes,
+             strategies),
+            ("ENCODE_LEGALITY", decls.encode_legality, dtypes, encodes)):
+        if set(table) != domain:
+            f(CONFIGS_PATH, 1, tname,
+              f"{tname} keys {sorted(table)} != IN_DTYPES"
+              f" {sorted(domain)}")
+        for k, legal in table.items():
+            extra = set(legal) - universe
+            if extra:
+                f(CONFIGS_PATH, 1, f"{tname}[{k}]",
+                  f"undeclared axis values {sorted(extra)}")
+    if set(decls.default_strategy) != dtypes:
+        f(CONFIGS_PATH, 1, "DEFAULT_STRATEGY",
+          f"keys {sorted(decls.default_strategy)} != IN_DTYPES"
+          f" {sorted(dtypes)}")
+    for k, v in decls.default_strategy.items():
+        if v not in set(decls.strategy_legality.get(k, ())):
+            f(CONFIGS_PATH, 1, f"DEFAULT_STRATEGY[{k}]",
+              f"default {v!r} is not legal for {k}"
+              f" ({decls.strategy_legality.get(k)})")
+
+    # (2) vmem variant names cover exactly the kernel family.
+    expected_variants = ({"plain", "weighted_precomp"} | strategies
+                         | {s + "_mxu" for s in strategies
+                            if s in ("rowcol", "global")})
+    got = set(decls.vmem_variants)
+    if got != expected_variants:
+        f(VMEM_PATH, 1, "TEMP_TILE_FACTORS",
+          f"variant names {sorted(got)} != expected"
+          f" {sorted(expected_variants)} (derived from"
+          " configs.STRATEGIES; a new strategy needs a calibrated"
+          " footprint factor)")
+    if set(decls.vmem_smem) != got:
+        f(VMEM_PATH, 1, "_SMEM_SCRATCH_BYTES",
+          f"keys {sorted(decls.vmem_smem)} != TEMP_TILE_FACTORS keys"
+          f" {sorted(got)}")
+
+    # (3) tuner cache key carries every axis component.
+    tree = repo.tree(TUNER_CACHE_PATH)
+    make_key = None
+    if tree is not None:
+        for _, _, fn in iter_functions(tree):
+            if fn.name == "make_key":
+                make_key = fn
+                break
+    if make_key is None:
+        f(TUNER_CACHE_PATH, 1, "make_key",
+          "tuner cache-key builder not found")
+    else:
+        frags: List[str] = []
+        strs: List[str] = []
+        for node in ast.walk(make_key):
+            frags.extend(fstring_fragments(node))
+            s = str_const(node)
+            if s is not None:
+                strs.append(s)
+        blob = "|".join(frags)
+        for marker, axis in (("enc=", "encode"), ("thr=", "threshold"),
+                             ("inj=", "injection")):
+            if marker not in blob:
+                f(TUNER_CACHE_PATH, make_key.lineno, "make_key",
+                  f"cache key is missing the {axis} component"
+                  f" ({marker!r} not in the key template) — two {axis}"
+                  " modes' winners would silently collide")
+        for s in strs:
+            if s in ("plain",) or s in strategies or s in encodes:
+                continue
+            if s in ("static", "adaptive") and s not in thresholds:
+                f(TUNER_CACHE_PATH, make_key.lineno, f"make_key:{s}",
+                  f"threshold spelling {s!r} not in THRESHOLD_MODES"
+                  f" {sorted(thresholds)}")
+        if not isinstance(decls.tuner_schema_version, int):
+            f(TUNER_CACHE_PATH, 1, "SCHEMA_VERSION",
+              "tuner cache SCHEMA_VERSION missing or non-literal")
+
+    # (4) telemetry label schema mirrors configs.
+    mirror = {"strategy": decls.strategies, "encode": decls.encode_modes,
+              "threshold_mode": decls.threshold_modes}
+    if not decls.axis_labels:
+        f(EVENTS_PATH, 1, "AXIS_LABELS",
+          "telemetry axis-label schema missing")
+    for axis, want in mirror.items():
+        have = tuple(decls.axis_labels.get(axis, ()))
+        if decls.axis_labels and have != tuple(want):
+            f(EVENTS_PATH, 1, f"AXIS_LABELS[{axis}]",
+              f"telemetry labels {have} != configs declaration {want}")
+
+    # (5) serve routing reads the hoisted tables.
+    btree = repo.tree(BUCKETS_PATH)
+    if btree is not None:
+        refs = {n.id for n in ast.walk(btree) if isinstance(n, ast.Name)}
+        refs |= {n.attr for n in ast.walk(btree)
+                 if isinstance(n, ast.Attribute)}
+        for needed in ("check_kernel_legality", "DEFAULT_STRATEGY"):
+            if needed not in refs:
+                f(BUCKETS_PATH, 1, needed,
+                  f"serve bucket routing no longer references"
+                  f" configs.{needed} — per-dtype legality/routing must"
+                  " derive from the declared tables")
+
+    # (6) CLI flag documentation + axis-named string uses everywhere.
+    cli_tree = repo.tree(CLI_PATH)
+    if cli_tree is not None:
+        doc = ast.get_docstring(cli_tree) or ""
+        alias_ok = dtypes | set(decls.dtype_aliases)
+        for flag, token, line in _cli_doc_axes(doc):
+            ok = {
+                "strategy": lambda t: t in strategies,
+                "encode": lambda t: t in encodes,
+                "threshold": lambda t: t in thresholds or t == "FLOAT",
+                "dtype": lambda t: t in alias_ok,
+            }[flag](token)
+            if not ok:
+                f(CLI_PATH, line, f"--{flag}={token}",
+                  f"CLI usage documents {flag} spelling {token!r} that"
+                  " the declared axis does not contain")
+
+    # The internal ``strategy`` spelling sometimes carries the encode-
+    # resolved VARIANT name (rowcol_mxu, weighted_precomp, plain — the
+    # vmem/cost-model vocabulary), which part (2) above pins against
+    # STRATEGIES; accept that whole checked family here.
+    axis_universe = {"strategy": strategies | {"plain"}
+                     | set(decls.vmem_variants),
+                     "encode": encodes,
+                     "threshold_mode": thresholds,
+                     "in_dtype": dtypes | set(decls.dtype_aliases)}
+    for rel in sorted(repo.trees):
+        if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
+                or rel.startswith("scripts/")):
+            continue
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for axis, value, line in _axis_value_uses(tree):
+            if value not in axis_universe[axis]:
+                f(rel, line, f"{axis}={value!r}",
+                  f"axis value {value!r} is not declared in the"
+                  f" {axis} axis ({sorted(axis_universe[axis])}) — add"
+                  " it to the configs declaration first or fix the"
+                  " spelling")
+    return findings, sources
+
+
+# --- pass 3: lock-discipline -------------------------------------------
+
+_MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                  "deque", "Counter"}
+_MUTATING_METHODS = {"append", "add", "update", "pop", "popitem",
+                     "clear", "extend", "insert", "remove", "discard",
+                     "setdefault", "appendleft", "extendleft"}
+
+
+def _is_mutable_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        return name.split(".")[-1] in _MUTABLE_CALLS
+    return False
+
+
+def _subscript_root(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _writes_in(fn: ast.AST, mutable: frozenset):
+    """Yield ``(name, node)`` for writes to module-level mutable names
+    inside ``fn`` (subscript stores, mutating method calls, global
+    rebinds, del of an item)."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    root = _subscript_root(t)
+                    if root in mutable:
+                        yield root, node
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    root = _subscript_root(t)
+                    if root in mutable:
+                        yield root, node
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            root = node.func.value
+            root = _subscript_root(root) if isinstance(
+                root, ast.Subscript) else (
+                root.id if isinstance(root, ast.Name) else None)
+            if root in mutable:
+                yield root, node
+
+
+def _lock_guarded(fn: ast.AST, write: ast.AST, lock_names: frozenset)\
+        -> bool:
+    """Whether ``write`` sits inside a ``with`` whose context expression
+    names a lock (a module lock, an attribute/call containing 'lock')
+    within the same function."""
+    # Build parent links lazily per function.
+    parents = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    node = write
+    while node is not None and node is not fn:
+        node = parents.get(node)
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                name = dotted_name(expr)
+                if name is None and isinstance(expr, ast.Call):
+                    name = dotted_name(expr.func)
+                if name and ("lock" in name.lower()
+                             or name.split(".")[-1] in lock_names):
+                    return True
+    return False
+
+
+@checker("lock-discipline")
+def check_lock_discipline(repo: Repo, decls: Declarations):
+    findings: List[Finding] = []
+    sources: List[str] = []
+
+    # Per-module facts.
+    mutable: Dict[str, frozenset] = {}
+    locks: Dict[str, frozenset] = {}
+    funcs: Dict[Tuple[str, str], ast.AST] = {}  # (rel, qual) -> node
+    by_name: Dict[str, List[Tuple[str, str]]] = {}
+    calls: Dict[Tuple[str, str], set] = {}
+    threat_roots: List[Tuple[str, str]] = []
+
+    for rel in repo.package_files():
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        mut, lk = set(), set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_mutable_ctor(node.value):
+                    mut.add(name)
+                dn = dotted_name(node.value.func) if isinstance(
+                    node.value, ast.Call) else None
+                if dn and dn.split(".")[-1] in ("Lock", "RLock"):
+                    lk.add(name)
+        mutable[rel] = frozenset(mut)
+        locks[rel] = frozenset(lk)
+        for qual, cls, fn in iter_functions(tree):
+            funcs[(rel, qual)] = fn
+            by_name.setdefault(fn.name, []).append((rel, qual))
+            callees = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func)
+                    if dn:
+                        callees.add(dn.split(".")[-1])
+                # threading.Thread(target=X) marks X a threat root.
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func) or ""
+                    if dn.split(".")[-1] == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                tn = dotted_name(kw.value)
+                                if tn:
+                                    threat_roots.append(
+                                        (rel, tn.split(".")[-1]))
+            calls[(rel, qual)] = callees
+        if rel in THREADED_MODULES:
+            sources.append(rel)
+            for qual, cls, fn in iter_functions(tree):
+                threat_roots.append((rel, fn.name))
+
+    # Reachability over the best-effort name-matched call graph: seed
+    # with the threat roots, close over callee names (same module first,
+    # then any module exporting the name — over-approximate on purpose;
+    # the allowlist absorbs audited over-matches).
+    reachable: set = set()
+    frontier: List[Tuple[str, str]] = []
+    for rel, fname in threat_roots:
+        for key in by_name.get(fname, []):
+            if key not in reachable:
+                reachable.add(key)
+                frontier.append(key)
+    while frontier:
+        key = frontier.pop()
+        for callee in calls.get(key, ()):
+            for cand in by_name.get(callee, []):
+                if cand not in reachable:
+                    reachable.add(cand)
+                    frontier.append(cand)
+
+    for (rel, qual), fn in sorted(funcs.items()):
+        if (rel, qual) not in reachable:
+            continue
+        mut = mutable.get(rel, frozenset())
+        if not mut:
+            continue
+        lock_names = locks.get(rel, frozenset())
+        for name, node in _writes_in(fn, mut):
+            if not _lock_guarded(fn, node, lock_names):
+                findings.append(Finding(
+                    "lock-discipline", rel, node.lineno,
+                    f"{qual}:{name}",
+                    f"module-level mutable {name!r} written without an"
+                    f" enclosing lock in {qual}(), which is reachable"
+                    " from a thread target / request path — guard it or"
+                    " allowlist with an audit note"))
+    return findings, sources
+
+
+# --- pass 4: smem-slots -------------------------------------------------
+
+@checker("smem-slots")
+def check_smem_slots(repo: Repo, decls: Declarations):
+    findings: List[Finding] = []
+    sources = [CONTRACTS_PATH, "ft_sgemm_tpu/ops/ft_sgemm.py"]
+    slots = decls.scalar_slots
+    if not slots:
+        findings.append(Finding(
+            "smem-slots", CONTRACTS_PATH, 1, "SCALAR_SLOTS",
+            "declared scalar-slot table missing or non-literal"))
+        return findings, sources
+    accepted = {int(k): tuple(v[1]) for k, v in slots.items()}
+    meanings = {int(k): v[0] for k, v in slots.items()}
+
+    for rel in repo.package_files():
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for qual, _, fn in iter_functions(tree):
+            argnames = {a.arg for a in list(fn.args.args)
+                        + list(fn.args.posonlyargs)
+                        + list(fn.args.kwonlyargs)}
+            if "inj_ref" not in argnames:
+                continue
+            parents = {}
+            for node in ast.walk(fn):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Subscript)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "inj_ref"):
+                    continue
+                idx = node.slice
+                if not (isinstance(idx, ast.Constant)
+                        and isinstance(idx.value, int)):
+                    continue
+                slot = idx.value
+                if slot not in accepted:
+                    findings.append(Finding(
+                        "smem-slots", rel, node.lineno,
+                        f"{qual}:slot{slot}",
+                        f"kernel reads undeclared scalar slot {slot}"
+                        f" (declared: {sorted(accepted)}) — claim it in"
+                        " contracts.SCALAR_SLOTS first"))
+                    continue
+                # Find the binding spelling: nearest enclosing Assign
+                # target or keyword argument.
+                spelling = None
+                p = node
+                while p is not None and p is not fn:
+                    parent = parents.get(p)
+                    if isinstance(parent, ast.keyword):
+                        spelling = parent.arg
+                        break
+                    if isinstance(parent, ast.Assign) \
+                            and len(parent.targets) == 1 \
+                            and isinstance(parent.targets[0], ast.Name):
+                        spelling = parent.targets[0].id
+                        break
+                    p = parent
+                if spelling is not None \
+                        and spelling not in accepted[slot]:
+                    findings.append(Finding(
+                        "smem-slots", rel, node.lineno,
+                        f"{qual}:slot{slot}",
+                        f"scalar slot {slot} bound as {spelling!r} but"
+                        f" declared {meanings[slot]!r} (accepted"
+                        f" spellings {accepted[slot]}) — two kernels"
+                        " must never claim one slot for different"
+                        " meanings"))
+    return findings, sources
+
+
+# --- pass 5: telemetry-schema ------------------------------------------
+
+@checker("telemetry-schema")
+def check_telemetry_schema(repo: Repo, decls: Declarations):
+    findings: List[Finding] = []
+    sources = [EVENTS_PATH, TIMELINE_PATH, REGISTRY_PATH]
+    outcomes = set(decls.outcomes)
+    kinds = set(decls.timeline_kinds)
+    help_exact = set(decls.metric_help)
+    help_prefixes = tuple(decls.metric_help_prefixes)
+
+    def prom(name: str) -> str:
+        return "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in name)
+
+    def curated(name: str) -> bool:
+        p = prom(name)
+        return p in help_exact or any(p.startswith(pref)
+                                      for pref in help_prefixes)
+
+    if not outcomes:
+        findings.append(Finding(
+            "telemetry-schema", EVENTS_PATH, 1, "OUTCOMES",
+            "declared outcome schema missing"))
+    if not kinds:
+        findings.append(Finding(
+            "telemetry-schema", TIMELINE_PATH, 1, "KINDS",
+            "declared timeline-kind schema missing"))
+
+    for rel in sorted(repo.trees):
+        tree = repo.tree(rel)
+        if tree is None or rel == EVENTS_PATH:
+            continue  # the schema module's own tuples are declarations
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            last = fname.split(".")[-1]
+            # Event outcomes: FaultEvent("x", ...) / outcome="x".
+            if last == "FaultEvent" and outcomes:
+                out = None
+                if node.args:
+                    out = str_const(node.args[0])
+                for kw in node.keywords:
+                    if kw.arg == "outcome":
+                        out = str_const(kw.value)
+                if out is not None and out not in outcomes:
+                    findings.append(Finding(
+                        "telemetry-schema", rel, node.lineno,
+                        f"outcome={out!r}",
+                        f"event outcome {out!r} is not declared in"
+                        " telemetry.events.OUTCOMES"))
+            # Timeline kinds: .span(name, kind=K) / .point(K, name).
+            if last == "span" and kinds:
+                k = "stage"
+                for kw in node.keywords:
+                    if kw.arg == "kind":
+                        k = str_const(kw.value) or None
+                if k is not None and k not in kinds:
+                    findings.append(Finding(
+                        "telemetry-schema", rel, node.lineno,
+                        f"kind={k!r}",
+                        f"timeline span kind {k!r} is not declared in"
+                        " telemetry.timeline.KINDS"))
+            if (last == "point" or last.endswith("_point")) and kinds \
+                    and node.args:
+                k = str_const(node.args[0])
+                if k is not None and k not in kinds:
+                    findings.append(Finding(
+                        "telemetry-schema", rel, node.lineno,
+                        f"kind={k!r}",
+                        f"timeline point kind {k!r} is not declared in"
+                        " telemetry.timeline.KINDS"))
+            # Metric families: .counter/.gauge/.histogram("name").
+            if last in ("counter", "gauge", "histogram") \
+                    and isinstance(node.func, ast.Attribute) and node.args:
+                arg = node.args[0]
+                name = str_const(arg)
+                if name is not None:
+                    if not curated(name):
+                        findings.append(Finding(
+                            "telemetry-schema", rel, node.lineno,
+                            f"metric={name!r}",
+                            f"metric family {name!r} has no curated"
+                            " # HELP string (telemetry.registry"
+                            "._METRIC_HELP / _METRIC_HELP_PREFIXES)"))
+                elif isinstance(arg, ast.JoinedStr):
+                    frags = fstring_fragments(arg)
+                    prefix = frags[0] if frags and isinstance(
+                        arg.values[0], ast.Constant) else ""
+                    if not prefix or not any(
+                            prom(prefix).startswith(p) or
+                            p.startswith(prom(prefix))
+                            for p in help_prefixes):
+                        findings.append(Finding(
+                            "telemetry-schema", rel, node.lineno,
+                            f"metric=f{prefix!r}...",
+                            "dynamically-named metric family has no"
+                            " matching curated # HELP prefix entry"
+                            " (telemetry.registry._METRIC_HELP_PREFIXES)"))
+    return findings, sources
+
+
+# --- allowlist + driver -------------------------------------------------
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    stale_entries: List[dict]
+    seconds: float
+    sources: Dict[str, List[str]]
+    checks_run: List[str]
+    internal_error: Optional[str] = None
+
+    @property
+    def exit_code(self) -> int:
+        if self.internal_error:
+            return 2
+        return 1 if (self.findings or self.stale_entries) else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "version": LINT_VERSION,
+            "seconds": round(self.seconds, 3),
+            "checks_run": self.checks_run,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "stale_allowlist_entries": self.stale_entries,
+            "sources": self.sources,
+            "internal_error": self.internal_error,
+            "exit_code": self.exit_code,
+        }
+
+
+def load_allowlist(path: str) -> List[dict]:
+    """The committed audited-safe entries; [] when absent. Each entry is
+    ``{"check", "path", "symbol", "reason"}`` — reason is REQUIRED (an
+    allowlist without justifications is just a mute button)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError:
+        return []
+    entries = doc.get("entries") if isinstance(doc, dict) else None
+    out = []
+    for e in entries or []:
+        if isinstance(e, dict) and e.get("check") and e.get("path") \
+                and e.get("symbol") and e.get("reason"):
+            out.append(e)
+    return out
+
+
+def run_lint(root: str, *, only: Optional[Sequence[str]] = None,
+             allowlist_path: Optional[str] = None) -> LintResult:
+    """Run the registered passes over the tree at ``root``.
+
+    ``only`` limits to a subset of check names; ``allowlist_path``
+    defaults to ``<root>/lint-allowlist.json``. Never raises: an
+    internal checker failure lands as ``internal_error`` with exit 2.
+    """
+    t0 = time.monotonic()
+    selected = list(only) if only else list(CHECK_ORDER)
+    unknown = [c for c in selected if c not in CHECKERS]
+    if unknown:
+        return LintResult([], [], [], time.monotonic() - t0, {}, [],
+                          internal_error=f"unknown checks: {unknown}"
+                          f" (available: {CHECK_ORDER})")
+    repo = Repo(root)
+    decls = Declarations(repo)
+    findings: List[Finding] = list(repo.errors)
+    sources: Dict[str, List[str]] = {}
+    internal = None
+    if decls.missing:
+        internal = ("declaration sources unreadable: "
+                    + ", ".join(decls.missing))
+    for name in selected:
+        if internal:
+            break
+        try:
+            found, read = CHECKERS[name](repo, decls)
+            findings.extend(found)
+            sources[name] = sorted(set(read))
+        except Exception as e:  # noqa: BLE001 — exit-2 contract
+            internal = f"checker {name} crashed: {type(e).__name__}: {e}"
+    allow = load_allowlist(allowlist_path or
+                           os.path.join(root, DEFAULT_ALLOWLIST))
+    allowed_keys = {(e["check"], e["path"], e["symbol"]): e
+                    for e in allow}
+    kept, suppressed = [], []
+    matched = set()
+    for f in findings:
+        if f.key() in allowed_keys:
+            suppressed.append(f)
+            matched.add(f.key())
+        else:
+            kept.append(f)
+    stale = [e for k, e in sorted(allowed_keys.items())
+             if k not in matched] if not only or set(selected) == set(
+        CHECK_ORDER) else []
+    stale_findings = [dict(e, stale=True) for e in stale]
+    kept.sort(key=lambda f: (f.path, f.line, f.check, f.symbol))
+    return LintResult(kept, suppressed, stale_findings,
+                      time.monotonic() - t0, sources, selected,
+                      internal_error=internal)
+
+
+def format_text(result: LintResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f.render())
+    for e in result.stale_entries:
+        lines.append(f"{e['path']}: [allowlist] {e['check']}:"
+                     f"{e['symbol']}: stale allowlist entry (nothing"
+                     " matches it anymore) — remove it")
+    if result.internal_error:
+        lines.append(f"ftlint: internal error: {result.internal_error}")
+    lines.append(
+        f"ftlint: {len(result.findings)} finding(s),"
+        f" {len(result.suppressed)} allowlisted,"
+        f" {len(result.stale_entries)} stale allowlist entr(y/ies),"
+        f" {len(result.checks_run)} check(s)"
+        f" in {result.seconds:.2f}s")
+    return "\n".join(lines)
+
+
+def lint_facts(root: str) -> dict:
+    """The two longitudinal lint measurements the bench manifest and run
+    ledger record: post-allowlist finding count and checker wall time
+    (``lint.findings`` / ``lint.seconds`` ledger series)."""
+    result = run_lint(root)
+    return {"findings": len(result.findings) + len(result.stale_entries),
+            "seconds": round(result.seconds, 3),
+            "internal_error": result.internal_error}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fmt = "text"
+    only = None
+    allowlist = None
+    root = None
+    for a in argv:
+        if a.startswith("--format="):
+            fmt = a.split("=", 1)[1]
+            if fmt not in ("text", "json"):
+                print(f"--format must be text or json, got {fmt!r}",
+                      file=sys.stderr)
+                return 2
+        elif a.startswith("--only="):
+            only = [c for c in a.split("=", 1)[1].split(",") if c]
+        elif a.startswith("--allowlist="):
+            allowlist = a.split("=", 1)[1]
+        elif a.startswith("--root="):
+            root = a.split("=", 1)[1]
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            print(f"unknown argument {a!r} (try --help)",
+                  file=sys.stderr)
+            return 2
+    if root is None:
+        # Default: the repo root this file lives in (…/ft_sgemm_tpu/lint/
+        # core.py -> two levels up), falling back to cwd when the layout
+        # is foreign (an installed wheel).
+        here = os.path.dirname(os.path.abspath(__file__))
+        cand = os.path.dirname(os.path.dirname(here))
+        root = cand if os.path.isdir(
+            os.path.join(cand, "ft_sgemm_tpu")) else os.getcwd()
+    result = run_lint(root, only=only, allowlist_path=allowlist)
+    if fmt == "json":
+        print(json.dumps(result.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(format_text(result))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

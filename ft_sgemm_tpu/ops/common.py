@@ -4,10 +4,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental.pallas import tpu as pltpu
 
 # jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;

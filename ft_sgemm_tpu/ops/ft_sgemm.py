@@ -126,10 +126,9 @@ import functools
 import math
 from typing import NamedTuple, Optional
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -137,6 +136,7 @@ from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import (
     ENCODE_MODES,
     SHAPES,
+    STRATEGIES,
     THRESHOLD_MODES,
     KernelShape,
     aug_rows as _aug_rows,
@@ -159,7 +159,9 @@ from ft_sgemm_tpu.ops.common import (
 )
 from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
 
-STRATEGIES = ("rowcol", "global", "weighted", "fused")
+# STRATEGIES is declared in configs (the kernel-axis single source the
+# static contract checker reads) and re-exported here unchanged — every
+# historical importer spells it ``ops.ft_sgemm.STRATEGIES``.
 
 
 class FtSgemmResult(NamedTuple):
@@ -1670,7 +1672,6 @@ def make_ft_sgemm(
         ap = _pad_to(a, bm, bk)
         bp = _pad_to(b, bn, bk)
         cp = _pad_to(c, bm, bn)
-        nk = ap.shape[1] // bk
         _, ce = resolve_cadence(eff)
         if strategy != "rowcol" or exact:
             # Only rowcol reads the flag (keep jit keys stable); the

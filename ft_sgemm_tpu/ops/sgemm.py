@@ -35,7 +35,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu.configs import (
     SHAPES,

@@ -57,8 +57,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ft_sgemm_tpu import telemetry
-from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.attention import (
     FtAttentionResult, PV_SHAPE, QK_SHAPE)
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm

@@ -23,13 +23,20 @@ CPU devices (the test/dry-run story — SURVEY.md §4).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ft_sgemm_tpu import telemetry
+from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+from ft_sgemm_tpu.ops.common import resolve_in_dtype
+from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+from ft_sgemm_tpu.ops.sgemm import make_sgemm
+
 
 def shard_map(f, *, mesh, in_specs, out_specs):
     # Replication/varying-axes checking is off either way: pallas_call
@@ -42,13 +49,6 @@ def shard_map(f, *, mesh, in_specs, out_specs):
 
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False)
-
-from ft_sgemm_tpu import telemetry
-from ft_sgemm_tpu.configs import KernelShape
-from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
-from ft_sgemm_tpu.ops.common import resolve_in_dtype
-from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
-from ft_sgemm_tpu.ops.sgemm import make_sgemm
 
 
 def make_mesh(n_devices: Optional[int] = None,

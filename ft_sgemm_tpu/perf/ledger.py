@@ -271,6 +271,21 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
         doc.get("unit"), str) else None
     entry["value"] = _num(doc.get("value"))
     entry["measurements"] = extract_measurements(doc)
+    # Static-analysis health rides the manifest (bench.py runs the
+    # contract checker per process): ledger rows carry lint.findings /
+    # lint.seconds as ordinary lower-is-better measurements so `cli
+    # trend` watches checker runtime and finding count longitudinally.
+    # NOT part of extract_measurements — that function mirrors
+    # compare.extract_stages exactly (test-pinned), and lint facts are
+    # not an A/B-comparable stage.
+    lint = manifest.get("lint")
+    if isinstance(lint, dict):
+        entry["lint"] = {"findings": lint.get("findings"),
+                         "seconds": lint.get("seconds")}
+        for key in ("findings", "seconds"):
+            s = _measurement(lint.get(key), higher_is_better=False)
+            if s:
+                entry["measurements"][f"lint.{key}"] = s
 
     if entry["kind"] == "multichip":
         entry["metric"] = entry["metric"] or "multichip_ok"

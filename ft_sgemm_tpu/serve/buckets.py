@@ -33,7 +33,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable, Optional, Sequence, Tuple
 
-from ft_sgemm_tpu.configs import canonical_in_dtype, check_kernel_legality
+from ft_sgemm_tpu.configs import (
+    DEFAULT_STRATEGY,
+    canonical_in_dtype,
+    check_kernel_legality,
+)
 
 
 class BucketOverflowError(ValueError):
@@ -111,7 +115,10 @@ def default_bucket_set(sizes: Sequence[int] = (256, 512, 1024),
     """
     dtype = canonical_in_dtype(in_dtype)
     if strategy is None:
-        strategy = "rowcol" if dtype == "int8" else "weighted"
+        # One declaration for per-dtype routing (configs.DEFAULT_STRATEGY,
+        # machine-checked against the legality tables) instead of a local
+        # int8-vs-rest spelling that could drift from the kernel family.
+        strategy = DEFAULT_STRATEGY[dtype]
     out = []
     for s in sorted(set(int(v) for v in sizes)):
         if s != _pow2_dim(s):

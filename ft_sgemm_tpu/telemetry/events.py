@@ -39,6 +39,21 @@ from typing import IO, Iterable, Iterator, Optional
 OUTCOMES = ("clean", "corrected", "uncorrectable", "retry", "restore",
             "raise", "exhausted", "alert")
 
+# Kernel-axis label values an event (or the registry series rebuilt from
+# one, :func:`registry_from_events`) may carry: ``strategy`` rides the
+# event field of that name; ``encode`` / ``threshold_mode`` ride
+# ``extra``. Deliberately a MIRROR of the configs declarations
+# (``configs.STRATEGIES`` / ``ENCODE_MODES`` / ``THRESHOLD_MODES``)
+# rather than an import: this module stays jax-free and import-light,
+# and the lint axis-drift pass cross-checks the two spellings statically
+# — drift between what kernels can run and what telemetry can label is
+# a CI finding, not a silent unlabeled series.
+AXIS_LABELS = {
+    "strategy": ("rowcol", "global", "weighted", "fused"),
+    "encode": ("vpu", "mxu"),
+    "threshold_mode": ("static", "auto", "adaptive"),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:

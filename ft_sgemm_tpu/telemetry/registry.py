@@ -221,7 +221,49 @@ _METRIC_HELP = {
                      "(1 = healthy; see DESIGN.md §12)",
     "device_health_drift": "Residual-distribution drift z-score per "
                            "device (creep toward the threshold)",
+    "tuner_measurements": "Tuner candidate measurements taken",
+    "tuner_failures": "Tuner candidate measurements that failed",
+    "tuner_candidate_gflops": "Last measured GFLOP/s per tuner candidate",
+    "tuner_cache_lookups": "Tile-cache dispatch lookups by hit/miss",
+    "compile_cache_enabled": "Whether the persistent XLA compile cache "
+                             "is active (1) or off (0)",
+    "wall_total_seconds": "Total wall seconds attributed by the "
+                          "timeline phase rollup",
+    "lint_findings": "Static contract checker findings (cli lint)",
+    "lint_seconds": "Static contract checker runtime",
 }
+
+# Dynamically-named families (``wall.{phase}_seconds``,
+# ``compile.{key}``, ``hlo.{attr}`` ...) get one curated string per
+# PREFIX — longest prefix wins at lookup. The lint telemetry-schema
+# pass requires every emitted family name (or its static f-string
+# prefix) to resolve through _METRIC_HELP or this table, so a new
+# metric cannot ship with only the generic fallback text.
+_METRIC_HELP_PREFIXES = {
+    "wall_": "Wall-clock phase attribution (perf/wallclock.py)",
+    "compile_": "Compile probe facts (perf/hlo.py wall/cost analysis)",
+    "compile_cache_": "Persistent XLA compile-cache counters "
+                      "(perf/compile_cache.py)",
+    "hlo_": "Optimized-HLO census facts (perf/hlo.py)",
+    "tuner_": "Autotuner search/measurement counters",
+    "lint_": "Static contract checker facts (ft_sgemm_tpu/lint)",
+}
+
+
+def _metric_help(name: str) -> str:
+    """The curated HELP string for one prom-sanitized family name:
+    exact entry, else longest matching prefix entry, else the generic
+    fallback (kept so foreign series still render self-describing)."""
+    if name in _METRIC_HELP:
+        return _METRIC_HELP[name]
+    best = None
+    for prefix, text in _METRIC_HELP_PREFIXES.items():
+        if name.startswith(prefix) and (best is None
+                                        or len(prefix) > len(best[0])):
+            best = (prefix, text)
+    if best is not None:
+        return best[1]
+    return f"ft_sgemm_tpu metric {name}"
 
 
 def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
@@ -261,8 +303,7 @@ def to_prometheus(series: Sequence[dict]) -> str:
     for (name, kind), group in sorted(by_name.items()):
         prom_kind = {"counter": "counter", "gauge": "gauge",
                      "histogram": "histogram"}.get(kind, "untyped")
-        help_text = _METRIC_HELP.get(
-            name, f"ft_sgemm_tpu metric {name}").replace(
+        help_text = _metric_help(name).replace(
             "\\", "\\\\").replace("\n", "\\n")
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {prom_kind}")

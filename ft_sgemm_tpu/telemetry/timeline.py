@@ -46,6 +46,17 @@ from typing import IO, Iterable, List, Optional
 
 SPAN_KINDS = ("stage", "attempt", "compile")
 
+# Every record kind any emitter may write — the spans above plus the
+# point-event families (worker heartbeats, supervisor kill markers, the
+# serve engine's enqueue/retry/exhausted points, loadgen progress).
+# This is the timeline half of the declared telemetry schema: the lint
+# telemetry-schema pass statically checks every ``span(kind=...)`` /
+# ``point(kind, ...)`` call site in the tree against this tuple, so an
+# emitter cannot invent a kind the readers (summarize_timeline,
+# traceview, wallclock) have never heard of.
+KINDS = ("stage", "attempt", "compile", "heartbeat", "kill", "serve",
+         "serve_progress")
+
 
 class TimelineRecorder:
     """Append-only JSONL span recorder, thread-safe, flushed per event.

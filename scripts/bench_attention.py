@@ -10,8 +10,8 @@ Usage: python scripts/bench_attention.py [L] [--bf16]
 
 import sys
 
-import numpy as np
 import jax
+import numpy as np
 
 sys.path.insert(0, ".")
 

@@ -12,8 +12,8 @@ Usage: python scripts/detection_study.py [size] [--strategy=all|rowcol|...]
 
 import sys
 
-import numpy as np
 import jax
+import numpy as np
 
 sys.path.insert(0, ".")
 

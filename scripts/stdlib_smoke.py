@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Path-loadability smoke for the declared stdlib-only modules.
+
+The static half of the contract lives in ftlint's import-graph pass
+(AST-verified: stdlib-only imports at module scope, no relative
+imports). This script is the DYNAMIC half CI runs next to it: every
+module in ``contracts.STDLIB_ONLY_MODULES`` is executed by FILE PATH in
+a bare ``python -S`` subprocess (no site-packages, so jax/numpy are not
+merely unimported — they are uninstallable) whose meta-path additionally
+raises on any jax import attempt. A module that passes here is proven
+loadable by the jax-free bench supervisor and the CI artifact tooling,
+not just believed to be.
+
+Exit 0 all loadable / 1 any failure / 2 internal error (the compare.py
+contract). Stdlib-only itself, obviously.
+
+Usage: python scripts/stdlib_smoke.py [REPO_ROOT]
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+_CHILD_PROG = r"""
+import importlib.util, sys
+
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax import attempted in stdlib-only module")
+
+sys.meta_path.insert(0, _Block())
+path = sys.argv[1]
+spec = importlib.util.spec_from_file_location("_stdlib_smoke_target", path)
+mod = importlib.util.module_from_spec(spec)
+# Register before exec: stdlib machinery (dataclasses under
+# `from __future__ import annotations`) resolves the defining module
+# through sys.modules — the full canonical path-load recipe.
+sys.modules[spec.name] = mod
+spec.loader.exec_module(mod)
+assert "jax" not in sys.modules
+print("ok")
+"""
+
+
+def declared_modules(root: str):
+    """STDLIB_ONLY_MODULES, read by path-loading contracts.py itself —
+    the declaration module is its own first smoke target."""
+    path = os.path.join(root, "ft_sgemm_tpu", "contracts.py")
+    spec = importlib.util.spec_from_file_location("_contracts", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return list(mod.STDLIB_ONLY_MODULES)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = os.path.abspath(argv[0]) if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    try:
+        modules = declared_modules(root)
+    except Exception as e:  # noqa: BLE001 — exit-2 contract
+        print(f"stdlib_smoke: cannot read contracts: {e}",
+              file=sys.stderr)
+        return 2
+    results = {}
+    failed = []
+    for rel in modules:
+        target = os.path.join(root, rel)
+        # -S: no site-packages — the interpreter literally cannot import
+        # jax even if the blocker were bypassed. -E ignores PYTHONPATH
+        # pollution from the calling environment.
+        proc = subprocess.run(
+            [sys.executable, "-S", "-E", "-c", _CHILD_PROG, target],
+            capture_output=True, text=True, timeout=120)
+        ok = proc.returncode == 0 and proc.stdout.strip() == "ok"
+        results[rel] = "ok" if ok else (
+            proc.stderr.strip().splitlines()[-1] if proc.stderr.strip()
+            else f"rc={proc.returncode}")
+        if not ok:
+            failed.append(rel)
+        print(f"{'PASS' if ok else 'FAIL'}  {rel}"
+              + ("" if ok else f"  ({results[rel]})"))
+    print(json.dumps({"checked": len(modules), "failed": failed},
+                     sort_keys=True))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

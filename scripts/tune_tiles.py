@@ -9,16 +9,16 @@ Usage: python scripts/tune_tiles.py [size] [--ft] [--rowcol] [--bf16]
 
 import sys
 
-import numpy as np
 import jax
+import numpy as np
 
 sys.path.insert(0, ".")
 
 from ft_sgemm_tpu.configs import KernelShape, vmem_limit_bytes  # noqa: E402
 from ft_sgemm_tpu.injection import InjectionSpec  # noqa: E402
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm  # noqa: E402
-from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes  # noqa: E402
 from ft_sgemm_tpu.ops.sgemm import make_sgemm  # noqa: E402
+from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes  # noqa: E402
 from ft_sgemm_tpu.utils.matrices import generate_random_matrix  # noqa: E402
 from ft_sgemm_tpu.utils.timing import bench_seconds_per_call  # noqa: E402
 

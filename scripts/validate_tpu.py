@@ -11,10 +11,9 @@ over the same bf16-rounded inputs (full-rate MXU path).
 """
 
 import sys
-import time
 
-import numpy as np
 import jax
+import numpy as np
 
 sys.path.insert(0, ".")
 

@@ -1,6 +1,5 @@
 """Two-pass ABFT baseline tests (reference include/baseline_ft_sgemm.cuh)."""
 
-import jax.numpy as jnp
 import numpy as np
 
 from ft_sgemm_tpu import InjectionSpec, abft_baseline_sgemm, sgemm_reference

@@ -2,7 +2,6 @@
 
 import io
 
-import numpy as np
 
 from ft_sgemm_tpu import cli
 

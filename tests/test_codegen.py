@@ -2,7 +2,6 @@
 
 import io
 
-import pytest
 
 from ft_sgemm_tpu.codegen import gen
 

@@ -13,10 +13,9 @@ jit boundary (``donated_invars`` pinned in the traced pjit params) with
 unchanged numerics.
 """
 
+import jax
 import numpy as np
 import pytest
-
-import jax
 
 from ft_sgemm_tpu.configs import KernelShape
 from ft_sgemm_tpu.injection import InjectionSpec

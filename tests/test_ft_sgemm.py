@@ -374,7 +374,6 @@ def test_weighted_deep_k_wraps_column_cycle():
 
 def test_rectangular_with_padding_and_injection():
     a, b, c = _inputs(300, 200, 520, seed=13)
-    shape = SHAPES["medium"]
     inj = InjectionSpec(enabled=True, every=2, magnitude=10000.0)
     ft = make_ft_sgemm("medium", alpha=ALPHA, beta=BETA)
     res = ft(a, b, c, inject=inj)

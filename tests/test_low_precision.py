@@ -26,7 +26,6 @@ Pins the ISSUE-7 contract points:
    with zero clean-run false positives.
 """
 
-import dataclasses
 import json
 
 import jax

@@ -8,10 +8,13 @@ import pytest
 flax = pytest.importorskip("flax")
 optax = pytest.importorskip("optax")
 
-from ft_sgemm_tpu import InjectionSpec
-from ft_sgemm_tpu.configs import KernelShape
-from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense
-from ft_sgemm_tpu.utils import generate_random_matrix, verify_matrix
+from ft_sgemm_tpu import InjectionSpec  # noqa: E402
+from ft_sgemm_tpu.configs import KernelShape  # noqa: E402
+from ft_sgemm_tpu.nn import COUNTS_COLLECTION, FtDense  # noqa: E402
+from ft_sgemm_tpu.utils import (  # noqa: E402
+    generate_random_matrix,
+    verify_matrix,
+)
 
 TILE = KernelShape("t128", 128, 128, 128, (0,) * 7)
 

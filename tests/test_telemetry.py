@@ -12,7 +12,6 @@ Pins the three contract points of ``ft_sgemm_tpu.telemetry``:
    counters of the run that produced it (the acceptance criterion).
 """
 
-import json
 import threading
 
 import jax

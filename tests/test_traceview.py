@@ -9,7 +9,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from ft_sgemm_tpu.cli import main as cli_main
 from ft_sgemm_tpu.telemetry import traceview
