@@ -236,6 +236,100 @@ def _lint_facts():
     return _LINT_FACTS
 
 
+# Ledger measurement keys <-> worker stage names for the headline rung
+# set: the abft_kernel_huge measurements a fresh worker may RESUME from
+# the run ledger instead of re-measuring. Keys are the artifact-context
+# spellings perf/ledger.py::extract_measurements banks (the metric key
+# itself carries the headline).
+LEDGER_RESUME_STAGES = {
+    "abft_kernel_huge_gflops_4096": "ft_headline",
+    "xla_dot_gflops": "xla_dot",
+    "kernel_sgemm_huge_gflops": "plain_huge",
+    "abft_rowcol_gflops": "ft_rowcol",
+    "abft_rowcol_mxu_gflops": "ft_rowcol_mxu",
+    "abft_fused_gflops": "ft_fused",
+    "bf16_abft_huge_gflops": "bf16_abft",
+    "bf16_abft_fused_gflops": "bf16_fused",
+    "bf16_sgemm_huge_gflops": "bf16_plain",
+    "bf16_xla_dot_gflops": "bf16_xla",
+}
+
+
+def _ledger_fresh_values(git_rev, platform_used, device_kind,
+                         ledger_path=None):
+    """Headline-rung values already banked in the run ledger for THIS
+    exact identity: ``{stage: {"value", "run_id"}}`` from the freshest
+    (latest-appended, deduped) ledger rows whose (git rev, platform
+    used, device kind) all match. A killed run's completed rungs reach
+    the ledger via ``_ledger_append`` even when the records file is
+    gone, so a relaunch resumes them instead of forfeiting them
+    (ROADMAP item 1). Identity-strict by construction: a different rev,
+    a dirty tree (``-dirty`` rev), or another device kind never
+    matches. Best-effort: any failure returns {}."""
+    path = ledger_path or os.environ.get("FT_SGEMM_LEDGER")
+    if not path or not git_rev or not os.path.exists(path):
+        return {}
+    mod = _load_ledger_mod()
+    if mod is None:
+        return {}
+    try:
+        entries = mod.dedup_entries(mod.read_ledger(path))
+    except Exception:  # noqa: BLE001 — resume is an accelerant only
+        return {}
+    out = {}
+    for e in entries:  # append order: later rows supersede earlier
+        if e.get("kind") != "bench" or e.get("git_rev") != git_rev:
+            continue
+        p = e.get("platform") or {}
+        if p.get("used") != platform_used \
+                or p.get("device_kind") != device_kind:
+            continue
+        meas = e.get("measurements") or {}
+        for key, stage in LEDGER_RESUME_STAGES.items():
+            m = meas.get(key)
+            v = m.get("value") if isinstance(m, dict) else None
+            if isinstance(v, (int, float)):
+                out[stage] = {"value": float(v),
+                              "run_id": e.get("run_id")}
+    return out
+
+
+def _ledger_resume_stages(rec, tl, live):
+    """Seed the records with ledger-banked rungs (see
+    :func:`_ledger_fresh_values`); each skipped rung logs the NAMED
+    ``skipped_fresh_in_ledger`` reason — in the records (so the emit's
+    resumed-stage provenance sees it) and as a timeline point."""
+    try:
+        from ft_sgemm_tpu.perf.report import _git_rev
+
+        rev = _git_rev()
+    except Exception:  # noqa: BLE001
+        rev = None
+    fresh = _ledger_fresh_values(rev, live.get("platform_used"),
+                                 live.get("device_kind"))
+    if not fresh:
+        return None
+    skipped = []
+    for stage, rec_val in sorted(fresh.items()):
+        if rec.done(stage):
+            continue
+        value = rec_val["value"]
+        if stage == "ft_headline":
+            value = {"gflops": value,
+                     "strategy": f"ledger:{rec_val['run_id']}"}
+        rec.ok(stage, value)
+        skipped.append(stage)
+        tl.point("stage", stage, note="skipped_fresh_in_ledger",
+                 run_id=rec_val["run_id"])
+        sys.stderr.write(
+            f"bench worker: {stage}: skipped_fresh_in_ledger "
+            f"(run {rec_val['run_id']}, rev {rev})\n")
+    if skipped:
+        rec.ok("ledger_resume", {"reason": "skipped_fresh_in_ledger",
+                                 "git_rev": rev, "stages": skipped})
+    return {"stages": skipped, "git_rev": rev}
+
+
 def _ledger_append(artifact):
     """Append the just-emitted artifact line to the run ledger when
     ``FT_SGEMM_LEDGER=`` names one. Best-effort by construction: the
@@ -659,6 +753,10 @@ def _emit_locked(values, errors, extra_errors=None):
         # Autotuner comparison (--tuned): cache-dispatched kernel GFLOPS
         # plus the tile the cache served, next to the heuristic rows.
         "ft_tuned": "abft_tuned",
+        # Ledger-driven resume provenance: which rungs this run seeded
+        # from the run ledger (reason: skipped_fresh_in_ledger) instead
+        # of re-measuring.
+        "ledger_resume": "ledger_resume",
         # Performance observability: the RunReport manifest + per-stage
         # roofline rows the worker banked (ft_sgemm_tpu.perf).
         "run_report": "run_report",
@@ -1512,6 +1610,14 @@ def _worker_stages(rec, tl=None):
         rec.reset()
     rec.ok("backend", live)
 
+    # Ledger-driven headline resume (ROADMAP item 1 slice): rungs this
+    # exact (git rev, platform) already measured are seeded from the run
+    # ledger instead of re-measured — a killed run's completed rungs
+    # reach the ledger via the supervisor's _ledger_append even when the
+    # records file was lost, so relaunches stop forfeiting them. Each
+    # skipped rung logs the named ``skipped_fresh_in_ledger`` reason.
+    _ledger_resume_stages(rec, tl, live)
+
     import jax.numpy as jnp
 
     from ft_sgemm_tpu import InjectionSpec, SHAPES, make_ft_sgemm, make_sgemm
@@ -2224,9 +2330,18 @@ def serve_main(argv):
     what it already accepted and emits a ``partial`` artifact — and the
     engine's streamed timeline (``FT_SGEMM_BENCH_TIMELINE``) holds
     per-batch spans and running ``serve_progress`` points for anything
-    harder-killed than that. Flags: ``--smoke`` (the CPU/CI scenario),
+    harder-killed than that. ``--workload=block`` serves TRANSFORMER
+    BLOCKS instead of bare GEMMs (``serve/blocks.py``): ragged
+    prefill/decode attention through the FT attention executors over an
+    ABFT-checked paged KV cache, goodput reported as
+    tokens-correct-per-second (metric ``serve_block_goodput_tps``) with
+    stored-state fault counters (``kv_faults`` /
+    ``kv_corrected_in_place`` / ``kv_page_restores``) in context;
+    ``--decode-ratio=R`` and ``--kv-corrupt-rate=R`` shape the mix.
+    Flags: ``--smoke`` (the CPU/CI scenario),
     ``--requests=N``, ``--inject-rate=R``, ``--adversarial-rate=R``,
-    ``--rate=RPS``, ``--buckets=256,512``, ``--monitor-port=N`` (start
+    ``--rate=RPS``, ``--buckets=256,512`` (block: padded SEQ sizes),
+    ``--monitor-port=N`` (start
     the live /metrics-/healthz-/events exporter for the run — 0 binds an
     ephemeral port, URL streamed to stderr; ``cli top URL`` renders it).
     The artifact context embeds the final SLO/error-budget and
@@ -2234,11 +2349,18 @@ def serve_main(argv):
     plus a RunReport whose SLO section ``cli report`` renders.
     """
     smoke = "--smoke" in argv
+    workload = "gemm"
     kw = {}
     bad = None
+    sizes = None
     for f in argv:
         try:
-            if f.startswith("--requests="):
+            if f.startswith("--workload="):
+                workload = f.split("=", 1)[1]
+                if workload not in ("gemm", "block"):
+                    raise ValueError(
+                        f"unknown workload {workload!r} (gemm|block)")
+            elif f.startswith("--requests="):
                 kw["num_requests"] = int(f.split("=", 1)[1])
             elif f.startswith("--inject-rate="):
                 kw["inject_rate"] = float(f.split("=", 1)[1])
@@ -2246,16 +2368,32 @@ def serve_main(argv):
                 kw["adversarial_rate"] = float(f.split("=", 1)[1])
             elif f.startswith("--rate="):
                 kw["rate"] = float(f.split("=", 1)[1])
+            elif f.startswith("--decode-ratio="):
+                kw["decode_ratio"] = float(f.split("=", 1)[1])
+            elif f.startswith("--kv-corrupt-rate="):
+                kw["kv_corrupt_rate"] = float(f.split("=", 1)[1])
             elif f.startswith("--buckets="):
-                kw["bucket_sizes"] = tuple(
+                sizes = tuple(
                     int(v) for v in f.split("=", 1)[1].split(",") if v)
             elif f.startswith("--monitor-port="):
                 kw["monitor_port"] = int(f.split("=", 1)[1])
         except ValueError as e:
             bad = f"{f}: {e}"
+    block = workload == "block"
+    # One goodput vocabulary per workload: requests-correct/sec for bare
+    # GEMMs, tokens-correct/sec for transformer blocks.
+    metric = "serve_block_goodput_tps" if block else "serve_goodput_rps"
+    unit = "tokens/s" if block else "requests/s"
+    if sizes is not None:
+        kw["seq_sizes" if block else "bucket_sizes"] = sizes
+    if not block:
+        for flag in ("decode_ratio", "kv_corrupt_rate"):
+            if flag in kw:
+                bad = f"--{flag.replace('_', '-')}= needs" \
+                    " --workload=block"
     if bad:
-        print(json.dumps({"metric": "serve_goodput_rps", "value": None,
-                          "unit": "requests/s", "vs_baseline": None,
+        print(json.dumps({"metric": metric, "value": None,
+                          "unit": unit, "vs_baseline": None,
                           "context": {"errors": {"argv": bad}}}),
               flush=True)
         return 2
@@ -2272,15 +2410,16 @@ def serve_main(argv):
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
 
-    context = {"serve": True, "smoke": smoke, "errors": {}}
+    context = {"serve": True, "smoke": smoke, "workload": workload,
+               "errors": {}}
     tl = (_make_timeline(None)
           if os.environ.get("FT_SGEMM_BENCH_TIMELINE") else _NoTimeline())
     try:
         import jax  # noqa: F401
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["import"] = f"{type(e).__name__}: {e}"
-        print(json.dumps({"metric": "serve_goodput_rps", "value": None,
-                          "unit": "requests/s", "vs_baseline": None,
+        print(json.dumps({"metric": metric, "value": None,
+                          "unit": unit, "vs_baseline": None,
                           "context": context}), flush=True)
         sys.stderr.write(traceback.format_exc())
         return 1
@@ -2294,20 +2433,28 @@ def serve_main(argv):
         facts, err = _backend_with_fallback()
     if facts is None:
         context["errors"]["backend"] = err
-        print(json.dumps({"metric": "serve_goodput_rps", "value": None,
-                          "unit": "requests/s", "vs_baseline": None,
+        print(json.dumps({"metric": metric, "value": None,
+                          "unit": unit, "vs_baseline": None,
                           "context": context}), flush=True)
         return 1
     context.update(facts)
     value = None
     try:
-        from ft_sgemm_tpu.serve import run_serve_bench
+        if block:
+            from ft_sgemm_tpu.serve import run_block_serve_bench
 
-        stats = run_serve_bench(smoke=smoke, timeline=tl,
-                                should_stop=stop.is_set,
-                                progress_out=sys.stderr, **kw)
+            stats = run_block_serve_bench(smoke=smoke, timeline=tl,
+                                          should_stop=stop.is_set,
+                                          progress_out=sys.stderr, **kw)
+            value = stats.get("goodput_tps")
+        else:
+            from ft_sgemm_tpu.serve import run_serve_bench
+
+            stats = run_serve_bench(smoke=smoke, timeline=tl,
+                                    should_stop=stop.is_set,
+                                    progress_out=sys.stderr, **kw)
+            value = stats.get("goodput_rps")
         context.update(stats)
-        value = stats.get("goodput_rps")
         if stop.is_set():
             context["partial"] = True
     except Exception as e:  # noqa: BLE001 — the line must still print
@@ -2326,7 +2473,7 @@ def serve_main(argv):
         # (ISSUE 9: the artifact embeds the SLO/budget snapshot).
         from ft_sgemm_tpu.perf.report import RunReport, build_manifest
 
-        serve_extra = {"serve": True}
+        serve_extra = {"serve": True, "workload": workload}
         lint = _lint_facts()
         if lint is not None:
             serve_extra["lint"] = lint
@@ -2335,9 +2482,9 @@ def serve_main(argv):
             stages=[], slo=context.get("slo")).to_dict()
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["run_report"] = f"{type(e).__name__}: {e}"
-    artifact = {"metric": "serve_goodput_rps",
+    artifact = {"metric": metric,
                 "value": value,
-                "unit": "requests/s", "vs_baseline": None,
+                "unit": unit, "vs_baseline": None,
                 "context": context}
     print(json.dumps(artifact), flush=True)
     _ledger_append(artifact)

@@ -51,11 +51,14 @@ Usage:
     python -m ft_sgemm_tpu.cli report ARTIFACT.json [--format=md|json]
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
-    python -m ft_sgemm_tpu.cli serve [--buckets=256,512] [--dtype=...] \
+    python -m ft_sgemm_tpu.cli serve [--workload=gemm|block] \
+        [--buckets=256,512] [--dtype=...] \
         [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] \
         [--monitor-port=N] [--dry-run]
-    python -m ft_sgemm_tpu.cli serve-bench [--smoke] [--buckets=...] \
+    python -m ft_sgemm_tpu.cli serve-bench [--smoke] \
+        [--workload=gemm|block] [--buckets=...] \
         [--requests=N] [--inject-rate=R] [--rate=RPS] \
+        [--decode-ratio=R] [--kv-corrupt-rate=R] \
         [--monitor-port=N] [--out=ARTIFACT.json]
     python -m ft_sgemm_tpu.cli history [LEDGER.jsonl] \
         [--limit=N] [--format=text|json]
@@ -185,6 +188,17 @@ compile-cache location without touching the backend (the CI smoke).
 ``serve-bench`` runs the load-generator goodput bench and prints the
 same JSON artifact line as ``python bench.py --serve``: p50/p99 latency,
 throughput, and goodput-under-injection (correct results per second).
+``--workload=block`` serves TRANSFORMER BLOCKS instead of bare GEMMs
+(``serve/blocks.py``, DESIGN.md §15): ragged prefill/decode attention
+requests bucket on padded sequence length, run through the FT attention
+executors (faults attributed through QK/softmax/PV per request), and
+decode reads every cached K/V page through the ABFT-checked KV cache —
+stored-state corruption is detected on read, corrected in place when
+localizable, or recovered by the bounded page-scoped restore ladder.
+Goodput becomes tokens-correct-per-second; ``--decode-ratio=R`` sets
+the prefill/decode mix and ``--kv-corrupt-rate=R`` the stored-page
+corruption rate (the block workload's ``--buckets=`` values are padded
+SEQUENCE sizes).
 
 Live monitoring (``ft_sgemm_tpu.telemetry.monitor``, DESIGN.md §12):
 ``--monitor-port=N`` on ``serve`` / ``serve-bench`` starts the stdlib
@@ -1223,13 +1237,22 @@ def run_prewarm(args, flags, out=None) -> int:
 
 
 def _parse_serve_flags(flags):
-    """Shared ``serve`` / ``serve-bench`` flag parsing. Returns the
-    kwargs dict or an error string."""
+    """Shared ``serve`` / ``serve-bench`` flag parsing. Returns
+    ``(workload, kwargs)`` or an error string. ``--buckets=`` values are
+    padded (M, N, K) sizes for the gemm workload and padded SEQUENCE
+    sizes for the block workload — the kwarg is renamed accordingly."""
     kw = {}
+    workload = "gemm"
+    sizes = None
     for f in flags:
         try:
-            if f.startswith("--buckets="):
-                kw["bucket_sizes"] = tuple(
+            if f.startswith("--workload="):
+                workload = f.split("=", 1)[1]
+                if workload not in ("gemm", "block"):
+                    raise ValueError(
+                        f"unknown workload {workload!r} (gemm|block)")
+            elif f.startswith("--buckets="):
+                sizes = tuple(
                     int(v) for v in f.split("=", 1)[1].split(",") if v)
             elif f.startswith("--requests="):
                 kw["num_requests"] = int(f.split("=", 1)[1])
@@ -1239,13 +1262,24 @@ def _parse_serve_flags(flags):
                 kw["adversarial_rate"] = float(f.split("=", 1)[1])
             elif f.startswith("--rate="):
                 kw["rate"] = float(f.split("=", 1)[1])
+            elif f.startswith("--decode-ratio="):
+                kw["decode_ratio"] = float(f.split("=", 1)[1])
+            elif f.startswith("--kv-corrupt-rate="):
+                kw["kv_corrupt_rate"] = float(f.split("=", 1)[1])
             elif f.startswith("--dtype="):
                 kw["in_dtype"] = canonical_in_dtype(f.split("=", 1)[1])
             elif f.startswith("--monitor-port="):
                 kw["monitor_port"] = int(f.split("=", 1)[1])
         except ValueError as e:
-            return None, f"{f}: {e}"
-    return kw, None
+            return None, None, f"{f}: {e}"
+    if workload != "block":
+        for flag in ("decode_ratio", "kv_corrupt_rate"):
+            if flag in kw:
+                return None, None, (f"--{flag.replace('_', '-')}= needs"
+                                    " --workload=block")
+    if sizes is not None:
+        kw["seq_sizes" if workload == "block" else "bucket_sizes"] = sizes
+    return workload, kw, None
 
 
 def run_serve(flags, out=None) -> int:
@@ -1260,18 +1294,24 @@ def run_serve(flags, out=None) -> int:
     and serves a short synthetic load, printing the stats table. Exit 0
     iff every completed request resolved correct.
     """
-    from ft_sgemm_tpu.serve import default_bucket_set
+    from ft_sgemm_tpu.serve import (
+        default_block_bucket_set, default_bucket_set)
     from ft_sgemm_tpu.serve.engine import VARIANTS
 
     out = sys.stdout if out is None else out
-    kw, err = _parse_serve_flags(flags)
+    workload, kw, err = _parse_serve_flags(flags)
     if err:
         print(f"ft_sgemm: serve: {err}", file=sys.stderr)
         return 2
     in_dtype = kw.pop("in_dtype", "float32")
-    sizes = kw.pop("bucket_sizes", None) or (256, 512)
+    block = workload == "block"
     try:
-        buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+        if block:
+            sizes = kw.pop("seq_sizes", None) or (128, 256)
+            buckets = default_block_bucket_set(sizes, in_dtype=in_dtype)
+        else:
+            sizes = kw.pop("bucket_sizes", None) or (256, 512)
+            buckets = default_bucket_set(sizes, in_dtype=in_dtype)
     except ValueError as e:
         print(f"ft_sgemm: serve: {e}", file=sys.stderr)
         return 2
@@ -1280,9 +1320,18 @@ def run_serve(flags, out=None) -> int:
         from ft_sgemm_tpu.perf import compile_cache
 
         path, reason = compile_cache.resolve_dir()
-        print(f"serve (dry run): {len(buckets)} buckets, compile cache "
+        print(f"serve (dry run): {len(buckets)} {workload} buckets, "
+              "compile cache "
               + (f"at {path}" if path else f"OFF ({reason})"), file=out)
         for b in buckets:
+            if block:
+                # Block buckets dispatch explicit per-bucket tiles (the
+                # tuner is off for them); the plan shows the padded
+                # geometry and the prewarmed variants.
+                print(f"  bucket {b.key:<40s}"
+                      f" variants={','.join(VARIANTS)}"
+                      f"  prefill={b.lq == b.lk}", file=out)
+                continue
             # device placeholder: the dry run must never pay (or hang
             # on) backend init just to render the plan.
             key = tuner.make_key(b.m, b.n, b.k, strategy=b.strategy,
@@ -1303,12 +1352,17 @@ def run_serve(flags, out=None) -> int:
 
         telemetry.configure(telemetry_log, log_clean=True)
     print_device_info()
-    from ft_sgemm_tpu.serve import run_serve_bench
+    from ft_sgemm_tpu.serve import run_block_serve_bench, run_serve_bench
 
     try:
-        stats = run_serve_bench(smoke=True, in_dtype=in_dtype,
-                                bucket_sizes=sizes, verify=True,
-                                progress_out=sys.stderr, **kw)
+        if block:
+            stats = run_block_serve_bench(smoke=True, in_dtype=in_dtype,
+                                          seq_sizes=sizes, verify=True,
+                                          progress_out=sys.stderr, **kw)
+        else:
+            stats = run_serve_bench(smoke=True, in_dtype=in_dtype,
+                                    bucket_sizes=sizes, verify=True,
+                                    progress_out=sys.stderr, **kw)
     finally:
         if telemetry_log:
             from ft_sgemm_tpu import telemetry
@@ -1319,8 +1373,20 @@ def run_serve(flags, out=None) -> int:
     print(f"served {stats['completed']}/{stats['requests_submitted']} "
           f"requests over {stats['wall_seconds']}s "
           f"({stats['requests_rejected']} rejected)", file=out)
-    print(f"  goodput {stats['goodput_rps']} correct req/s  "
-          f"(throughput {stats['throughput_rps']} req/s)", file=out)
+    if block:
+        print(f"  goodput {stats['goodput_tps']} correct tokens/s  "
+              f"(throughput {stats['throughput_tps']} tokens/s; "
+              f"{stats['phases']['prefill']} prefill / "
+              f"{stats['phases']['decode']} decode)", file=out)
+        kv = stats["kv"]
+        print(f"  kv cache: {kv['pages_verified']} page verifications  "
+              f"faults {stats['kv_faults']}  corrected in place "
+              f"{stats['kv_corrected_in_place']}  page restores "
+              f"{stats['kv_page_restores']}  verify hit rate "
+              f"{kv['verify_hit_rate']}", file=out)
+    else:
+        print(f"  goodput {stats['goodput_rps']} correct req/s  "
+              f"(throughput {stats['throughput_rps']} req/s)", file=out)
     print(f"  latency p50<={stats['p50_latency_seconds']}s "
           f"p99<={stats['p99_latency_seconds']}s", file=out)
     print(f"  corrected free: {stats['corrected_free']}   bucket retries: "
@@ -1350,7 +1416,7 @@ def run_serve_bench_cmd(flags, out=None) -> int:
     import json as _json
 
     out = sys.stdout if out is None else out
-    kw, err = _parse_serve_flags(flags)
+    workload, kw, err = _parse_serve_flags(flags)
     if err:
         print(f"ft_sgemm: serve-bench: {err}", file=sys.stderr)
         return 2
@@ -1359,17 +1425,28 @@ def run_serve_bench_cmd(flags, out=None) -> int:
         if f.startswith("--out="):
             out_path = f.split("=", 1)[1]
     print_device_info(out=sys.stderr)
-    from ft_sgemm_tpu.serve import run_serve_bench
+    from ft_sgemm_tpu.serve import run_block_serve_bench, run_serve_bench
 
-    stats = run_serve_bench(smoke="--smoke" in flags,
-                            progress_out=sys.stderr, **kw)
-    artifact = {
-        "metric": "serve_goodput_rps",
-        "value": stats.get("goodput_rps"),
-        "unit": "requests/s",
-        "vs_baseline": None,
-        "context": stats,
-    }
+    if workload == "block":
+        stats = run_block_serve_bench(smoke="--smoke" in flags,
+                                      progress_out=sys.stderr, **kw)
+        artifact = {
+            "metric": "serve_block_goodput_tps",
+            "value": stats.get("goodput_tps"),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "context": stats,
+        }
+    else:
+        stats = run_serve_bench(smoke="--smoke" in flags,
+                                progress_out=sys.stderr, **kw)
+        artifact = {
+            "metric": "serve_goodput_rps",
+            "value": stats.get("goodput_rps"),
+            "unit": "requests/s",
+            "vs_baseline": None,
+            "context": stats,
+        }
     line = _json.dumps(artifact)
     print(line, file=out, flush=True)
     if out_path:
@@ -1377,7 +1454,7 @@ def run_serve_bench_cmd(flags, out=None) -> int:
             fh.write(line + "\n")
     ok = (stats.get("completed", 0) > 0
           and stats.get("correct") == stats.get("completed")
-          and (stats.get("goodput_rps") or 0) > 0)
+          and (artifact["value"] or 0) > 0)
     return 0 if ok else 1
 
 
@@ -1478,6 +1555,17 @@ def _render_top(url: str, out, since: int, poll: int) -> int:
           f"  burn {value('slo_burn_rate', '-')}x"
           f"  window requests {value('slo_window_requests', '-')}"
           f"  goodput {value('slo_goodput_ratio', '-')}", file=out)
+    # Block-serving gauges (PR 12) — rendered only when the process
+    # serves the block workload; older exporters (and ledger-replayed
+    # registries) simply lack the series and the line is skipped.
+    tps = value("serve_block_tokens_per_second")
+    kv_hit = value("kv_verify_hit_rate")
+    if tps is not None or kv_hit is not None:
+        print("block: "
+              + (f"tokens-correct/s {tps}" if tps is not None else "")
+              + ("  " if tps is not None and kv_hit is not None else "")
+              + (f"kv verify hit rate {kv_hit}"
+                 if kv_hit is not None else ""), file=out)
     buckets = sorted({s["labels"]["bucket"]
                       for s in find("serve_requests")
                       if "bucket" in s["labels"]})
@@ -1494,6 +1582,26 @@ def _render_top(url: str, out, since: int, poll: int) -> int:
 
             print(f"  {b:<36s} {value('serve_requests', 0, bucket=b):>6} "
                   f"{value('serve_retries', 0, bucket=b):>7} "
+                  f"{fmt(pct.get('p50')):>10s} {fmt(pct.get('p99')):>10s}",
+                  file=out)
+    blk_buckets = sorted({s["labels"]["bucket"]
+                          for s in find("serve_block_requests")
+                          if "bucket" in s["labels"]})
+    if blk_buckets:
+        print(f"  {'block bucket':<40s} {'reqs':>6s} {'retries':>7s} "
+              f"{'p50':>10s} {'p99':>10s}", file=out)
+        for b in blk_buckets:
+            hist = value("serve_block_latency_seconds", bucket=b)
+            pct = (histogram_percentiles(hist, quantiles=(0.5, 0.99))
+                   if isinstance(hist, dict) else {})
+
+            def fmt(v):
+                return f"{v:.4g}s" if isinstance(v, (int, float)) else "-"
+
+            reqs = sum(s["value"]
+                       for s in find("serve_block_requests", bucket=b))
+            print(f"  {b:<40s} {reqs:>6.0f} "
+                  f"{value('serve_block_retries', 0, bucket=b):>7} "
                   f"{fmt(pct.get('p50')):>10s} {fmt(pct.get('p99')):>10s}",
                   file=out)
     dh = sorted(find("device_health"),

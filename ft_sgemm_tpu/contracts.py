@@ -67,6 +67,23 @@ SCALAR_SLOTS = {
 # + 3 threshold slots always; slot 7 appended in adaptive mode).
 N_SCALAR_SLOTS = 8
 
+# --- transformer-block serving declarations ----------------------------
+#
+# The block-serving plane's own axis: every serve_block event labels its
+# phase with one of these spellings, and telemetry's
+# ``events.AXIS_LABELS["block_phase"]`` MIRRORS this tuple (the same
+# import-free mirror discipline as the kernel axes — the lint axis-drift
+# pass cross-checks the two). ``serve/blocks.py::PHASES`` is the runtime
+# spelling of the same declaration.
+BLOCK_PHASES = ("prefill", "decode")
+
+# Rows appended to every KV-cache page tensor on write: the plain column
+# sum and the weighted (w_i = i + 1) column sum — the ABFT row-locator
+# pair that lets a read CORRECT a located single-element corruption in
+# place (serve/kv_cache.py mirrors this as CHECKSUM_ROWS; DESIGN.md §15
+# documents the layout).
+KV_PAGE_CHECKSUM_ROWS = 2
+
 # --- kernel-axis declaration sources -----------------------------------
 #
 # The six places the kernel axes (strategy x encode x dtype x threshold

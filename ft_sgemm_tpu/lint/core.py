@@ -81,6 +81,8 @@ DEFAULT_ALLOWLIST = "lint-allowlist.json"
 # thread (the lock-discipline threat roots, beyond explicit
 # ``threading.Thread(target=...)`` sites).
 THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
+                    "ft_sgemm_tpu/serve/blocks.py",
+                    "ft_sgemm_tpu/serve/kv_cache.py",
                     "ft_sgemm_tpu/telemetry/monitor.py")
 
 
@@ -270,6 +272,7 @@ class Declarations:
         self.n_scalar_slots = contracts.get("N_SCALAR_SLOTS", 0)
         self.axis_sources = tuple(
             contracts.get("AXIS_DECLARATION_SOURCES", ()))
+        self.block_phases = tuple(contracts.get("BLOCK_PHASES", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -636,9 +639,12 @@ def check_axis_drift(repo: Repo, decls: Declarations):
             f(TUNER_CACHE_PATH, 1, "SCHEMA_VERSION",
               "tuner cache SCHEMA_VERSION missing or non-literal")
 
-    # (4) telemetry label schema mirrors configs.
+    # (4) telemetry label schema mirrors configs (and, for the
+    # block-serving phase axis, contracts.BLOCK_PHASES).
     mirror = {"strategy": decls.strategies, "encode": decls.encode_modes,
               "threshold_mode": decls.threshold_modes}
+    if decls.block_phases:
+        mirror["block_phase"] = decls.block_phases
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")

@@ -210,6 +210,7 @@ def make_ft_attention(
     pv_shape: KernelShape = PV_SHAPE,
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
+    layer: Optional[str] = None,
 ):
     """Build ``fn(q, k, v, inject=None) -> FtAttentionResult``.
 
@@ -235,6 +236,12 @@ def make_ft_attention(
     the QK/PV dots as augmented operand rows instead of per-K-step VPU
     reductions; the default ``"vpu"`` leaves both kernels bit-for-bit
     unchanged.
+
+    ``layer`` labels the recorded telemetry event (and its registry
+    series) so stacked/composite callers — an nn block, a serving bucket
+    — attribute faults to THEIR unit, the per-layer attribution the
+    attention-ABFT literature (arXiv 2507.16676) calls for in
+    transformer stacks.
     """
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
                        encode=encode, threshold=threshold,
@@ -254,7 +261,8 @@ def make_ft_attention(
                 softmax_recheck_rows, softmax_fault)
         if telemetry.enabled():
             telemetry.record_attention("ft_attention", res,
-                                       strategy=strategy, encode=encode)
+                                       strategy=strategy, encode=encode,
+                                       layer=layer)
         return res
 
     fn.strategy = strategy

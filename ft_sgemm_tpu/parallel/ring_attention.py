@@ -181,6 +181,54 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
     )
 
 
+def make_ring_ft_attention(
+    mesh: Mesh,
+    *,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    inject: Optional[InjectionSpec] = None,
+    strategy: str = "weighted",
+    threshold: float = REFERENCE_THRESHOLD,
+    qk_shape: KernelShape = QK_SHAPE,
+    pv_shape: KernelShape = PV_SHAPE,
+    in_dtype: str = "float32",
+    interpret: Optional[bool] = None,
+    inject_coords: Optional[tuple] = None,
+):
+    """Build a REUSABLE ring-attention executor: ``fn(q, k, v) ->
+    (out, det, flags, unc, dev_det, dev_unc)`` raw arrays.
+
+    The factory form exists for callers that dispatch MANY calls through
+    one executable — the block serving engine AOT-compiles ``jax.jit(fn)``
+    once per (bucket, variant) and reuses it for every request, which a
+    per-call ``jax.jit`` of a fresh closure (the one-shot
+    :func:`ring_ft_attention` path) cannot do. The shard_map'd forward is
+    constructed at trace time from the call's static shapes, so one
+    ``fn`` serves exactly one padded geometry — precisely the bucket
+    contract. ``dev_det`` / ``dev_unc`` are the ``P("x")`` per-device
+    counter arrays (one entry per ring position) telemetry attribution
+    reads; ``inject_coords=(i,)`` restricts injection to ring position
+    ``i``, the per-device fault-localization knob the sharded GEMM paths
+    established."""
+
+    def fn(q, k, v):
+        q2, k2, v2, lq, lk, dv, dnum, sc = _ring_geometry(
+            q, k, v, mesh, scale, causal, in_dtype)
+        fwd = _build_forward(
+            mesh, scale=sc, causal=causal, inject=inject,
+            strategy=strategy, threshold=threshold, qk_shape=qk_shape,
+            pv_shape=pv_shape, in_dtype=in_dtype, interpret=interpret,
+            lq=lq, lk=lk, dv=dv, dnum=dnum, inject_coords=inject_coords)
+        out, _, _, det, flags, unc, dev_det, dev_unc = fwd(
+            q2, k2, jnp.swapaxes(v2, 0, 1))
+        return (out, det[0, 0], flags[0, 0], unc[0, 0], dev_det, dev_unc)
+
+    fn.strategy = strategy
+    fn.in_dtype = in_dtype
+    fn.causal = causal
+    return fn
+
+
 def ring_ft_attention(
     q,
     k,
@@ -210,19 +258,15 @@ def ring_ft_attention(
     ring position and host (``telemetry.record_mesh_attention``);
     ``inject_coords=(i,)`` restricts injection to ring position ``i``.
     """
-    q, k, v, lq, lk, dv, dnum, sc = _ring_geometry(
-        q, k, v, mesh, scale, causal, in_dtype)
-    fn = _build_forward(
-        mesh, scale=sc, causal=causal, inject=inject, strategy=strategy,
-        threshold=threshold, qk_shape=qk_shape, pv_shape=pv_shape,
-        in_dtype=in_dtype, interpret=interpret, lq=lq, lk=lk, dv=dv,
-        dnum=dnum, inject_coords=inject_coords)
-    # V rides the ring pre-transposed: the PV kernel consumes B = V^T and a
-    # (dv, Lk/D) shard halves nothing but avoids a per-hop transpose.
+    fn = make_ring_ft_attention(
+        mesh, scale=scale, causal=causal, inject=inject,
+        strategy=strategy, threshold=threshold, qk_shape=qk_shape,
+        pv_shape=pv_shape, in_dtype=in_dtype, interpret=interpret,
+        inject_coords=inject_coords)
+    dnum = mesh.shape["x"]
     with telemetry.trace_span("ring_ft_attention"):
-        out, _, _, det, flags, unc, dev_det, dev_unc = jax.jit(fn)(
-            q, k, jnp.swapaxes(v, 0, 1))
-    result = FtAttentionResult(out, det[0, 0], flags[0, 0], unc[0, 0])
+        out, det, flags, unc, dev_det, dev_unc = jax.jit(fn)(q, k, v)
+    result = FtAttentionResult(out, det, flags, unc)
     if telemetry.enabled():
         telemetry.record_mesh_attention(
             "ring_ft_attention", result, strategy=strategy,
@@ -406,5 +450,5 @@ def make_ring_ft_attention_diff(
                     _backward, with_bwd_counts)
 
 
-__all__ = ["make_ring_mesh", "make_ring_ft_attention_diff",
-           "ring_ft_attention"]
+__all__ = ["make_ring_ft_attention", "make_ring_ft_attention_diff",
+           "make_ring_mesh", "ring_ft_attention"]

@@ -138,7 +138,9 @@ def _infer_kind(doc: dict, ctx: dict, source: Optional[str]) -> str:
     metric = doc.get("metric")
     # serve before smoke: a `--serve --smoke` artifact carries both
     # context flags, and the serve identity is the meaningful one.
-    if metric == "serve_goodput_rps" or ctx.get("serve"):
+    # Both serve workloads land here (gemm requests/s, block tokens/s).
+    if metric in ("serve_goodput_rps", "serve_block_goodput_tps") \
+            or ctx.get("serve") or ctx.get("workload") == "block":
         return "serve"
     if metric == "bench_smoke" or ctx.get("smoke"):
         return "smoke"
@@ -286,6 +288,27 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
             s = _measurement(lint.get(key), higher_is_better=False)
             if s:
                 entry["measurements"][f"lint.{key}"] = s
+    # Transformer-block serving measurements (serve_block.*): the block
+    # workload's goodput plane — tokens-correct/sec, latency, and the
+    # KV-cache verify hit rate — so `cli trend` gates them
+    # longitudinally. Like lint.*, added OUTSIDE extract_measurements:
+    # that function mirrors compare.extract_stages exactly (test-pinned)
+    # and block-serving facts are not an A/B-comparable GEMM stage.
+    if ctx.get("workload") == "block":
+        for key, hib in (("goodput_tps", True), ("throughput_tps", True),
+                         ("tokens_correct", True),
+                         ("p50_latency_seconds", False),
+                         ("p99_latency_seconds", False)):
+            s = _measurement(ctx.get(key), higher_is_better=hib)
+            if s:
+                entry["measurements"][f"serve_block.{key}"] = s
+        kv = ctx.get("kv")
+        if isinstance(kv, dict):
+            s = _measurement(kv.get("verify_hit_rate"),
+                             higher_is_better=True)
+            if s:
+                entry["measurements"]["serve_block.kv_verify_hit_rate"] \
+                    = s
 
     if entry["kind"] == "multichip":
         entry["metric"] = entry["metric"] or "multichip_ok"

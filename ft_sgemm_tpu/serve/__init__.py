@@ -11,7 +11,10 @@ a detected-and-corrected SDC costs the serving path nothing):
   fold onto a small padded bucket set aligned with the autotuner's cache
   buckets, so every bucket hits a tuner-cached tile and one prewarmed
   executable. Oversized requests get the named
-  :class:`~ft_sgemm_tpu.serve.buckets.BucketOverflowError`.
+  :class:`~ft_sgemm_tpu.serve.buckets.BucketOverflowError`. Ragged
+  SEQUENCES bucket the same way (:class:`~ft_sgemm_tpu.serve.buckets.
+  BlockBucket` — padded (L_q, L_k) under the identical power-of-two
+  rule).
 - :mod:`.engine` — the async continuous-batching dispatch queue: per-
   bucket accumulation, flush on batch-full or max-wait, AOT-prewarmed
   executables (zero compile spans in steady state — timeline-pinned),
@@ -20,27 +23,46 @@ a detected-and-corrected SDC costs the serving path nothing):
   uncorrectable one retries only the affected bucket's batch — never the
   whole queue — bounded, backed off, and recorded as telemetry ladder
   events.
+- :mod:`.blocks` — transformer-block serving (the paper's real
+  customer): ragged prefill/decode attention requests through the FT
+  attention executors, per-request fault attribution through
+  QK/softmax/PV, and the decode path's ABFT-checked paged KV cache.
+- :mod:`.kv_cache` — the checked store itself: every page carries two
+  appended checksum rows (plain + weighted column sums), verified on
+  read, single-element corruption corrected IN PLACE, wider corruption
+  recovered by the engine's bounded page-scoped restore ladder.
 - :mod:`.loadgen` — the load-generator bench (``bench.py --serve``,
   ``cli serve-bench``): configurable arrival process with SDC injection,
   reporting p50/p99 latency (from the telemetry histogram machinery),
-  throughput, and goodput-under-injection.
-- :mod:`.tracing` — request-scoped trace IDs, minted per
-  :class:`~ft_sgemm_tpu.serve.engine.ServeRequest` and propagated
-  through enqueue -> flush -> execute -> detection -> retry, so one
-  grep joins a user request to the tile/device that corrupted it. The
+  throughput, and goodput-under-injection — requests-correct/sec for
+  the GEMM workload, tokens-correct/sec for the block workload
+  (``--workload=gemm|block``).
+- :mod:`.tracing` — request-scoped trace IDs, minted per request and
+  propagated through enqueue -> flush -> execute -> detection (in
+  flight AND stored-state ``kv_page`` findings) -> retry, so one grep
+  joins a user request to the tile/device/page that corrupted it. The
   live plane (``--monitor-port=``, ``cli top``) is
   :mod:`ft_sgemm_tpu.telemetry.monitor`.
 
 CLI: ``python -m ft_sgemm_tpu.cli serve [--dry-run] [--monitor-port=N]``
-and ``python -m ft_sgemm_tpu.cli serve-bench [--smoke]``.
+and ``python -m ft_sgemm_tpu.cli serve-bench [--smoke]
+[--workload=gemm|block]``.
 """
 
 from __future__ import annotations
 
+from ft_sgemm_tpu.serve.blocks import (
+    BlockEngine,
+    BlockRequest,
+    BlockResult,
+)
 from ft_sgemm_tpu.serve.buckets import (
+    BlockBucket,
     Bucket,
     BucketOverflowError,
+    default_block_bucket_set,
     default_bucket_set,
+    select_block_bucket,
     select_bucket,
 )
 from ft_sgemm_tpu.serve.engine import (
@@ -49,8 +71,13 @@ from ft_sgemm_tpu.serve.engine import (
     ServeRequest,
     ServeResult,
 )
+from ft_sgemm_tpu.serve.kv_cache import KVPageFault, PagedKVCache
 from ft_sgemm_tpu.serve.loadgen import (
+    BlockLoadSpec,
     LoadSpec,
+    block_smoke_spec,
+    run_block_load,
+    run_block_serve_bench,
     run_load,
     run_serve_bench,
     smoke_spec,
@@ -62,18 +89,30 @@ from ft_sgemm_tpu.serve.tracing import (
 )
 
 __all__ = [
+    "BlockBucket",
+    "BlockEngine",
+    "BlockLoadSpec",
+    "BlockRequest",
+    "BlockResult",
     "Bucket",
     "BucketOverflowError",
+    "KVPageFault",
     "LoadSpec",
+    "PagedKVCache",
     "ServeEngine",
     "ServeRequest",
     "ServeResult",
     "VARIANTS",
+    "block_smoke_spec",
     "current_trace_id",
+    "default_block_bucket_set",
     "default_bucket_set",
     "new_trace_id",
+    "run_block_load",
+    "run_block_serve_bench",
     "run_load",
     "run_serve_bench",
+    "select_block_bucket",
     "select_bucket",
     "smoke_spec",
     "trace_scope",

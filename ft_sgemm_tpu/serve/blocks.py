@@ -1,0 +1,940 @@
+"""Transformer-block serving: ragged prefill/decode attention requests.
+
+The serving plane (PRs 8–10) batches bare ``(M, N, K)`` GEMMs; the
+workload that actually reaches users is attention — ROADMAP item 3's
+"the paper's real customer". This module extends the engine contract to
+TRANSFORMER-BLOCK requests while keeping every serving discipline the
+GEMM plane established:
+
+- **Bucketing.** Ragged sequences fold onto a small padded
+  :class:`~ft_sgemm_tpu.serve.buckets.BlockBucket` set under the same
+  tuner-aligned power-of-two rule GEMM shapes use, so each block bucket
+  dispatches exactly ONE AOT-compiled executable per injection variant
+  and steady-state serving records ZERO compile spans (the PR-8
+  warm-path pin, same timeline accounting).
+- **Executors.** The compiled executors are the existing FT attention
+  factories — :func:`ft_sgemm_tpu.ops.attention.make_ft_attention`
+  single-device, :func:`ft_sgemm_tpu.parallel.ring_attention.
+  make_ring_ft_attention` when a ring mesh is live — so both GEMMs of
+  every request run through the fused-ABFT kernels and the softmax
+  stage keeps its decomposed invariant + dual-recompute checks. Fault
+  attribution flows through QK/softmax/PV into ONE ``serve_block``
+  event per request carrying the request's ``trace_id`` (the PR-9
+  ``serve_gemm``-style join); ring-path events additionally carry
+  per-ring-position device blame (``record_mesh_attention``,
+  ``inject_coords`` localizes the self-test fault to one device).
+- **Causal padding is exact.** Everything runs ``causal=True`` with
+  END-anchored positions: prefill pads queries and keys together
+  (``lq == lk``), so real query row ``i`` attends exactly keys
+  ``0..i`` and padded keys are masked by construction; decode places
+  its single real query at row ``len - 1 - (lk - lq)``, which the
+  decode buckets' ``lq = lk/2`` rule keeps in range — zero-padding
+  never leaks probability mass (the GEMM plane's "padding is exact"
+  property, recovered for softmax by geometry instead of masks).
+- **Stored state is checked.** The decode path reads every cached
+  K/V page through the ABFT-checked
+  :class:`~ft_sgemm_tpu.serve.kv_cache.PagedKVCache`: corruption in
+  *state* — not just in flight — is detected on read, attributed to
+  ``(seq, layer, head, page)`` in a ``kv_page`` fault event joined to
+  the request's trace, corrected in place when localizable, and
+  otherwise recovered by a bounded page-scoped restore/re-verify
+  ladder that mirrors the PR-8 bucket-scoped retry ladder (never the
+  whole queue).
+
+Goodput for this workload is **tokens-correct-per-second**: a prefill
+contributes its sequence length, a decode one token, and only verified-
+or-clean results count (``serve/loadgen.py::run_block_load``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ft_sgemm_tpu.serve.buckets import BlockBucket, select_block_bucket
+from ft_sgemm_tpu.serve.engine import (
+    VARIANTS,
+    _Future,
+    _as_recorder,
+    _device_label,
+)
+from ft_sgemm_tpu.serve.kv_cache import PagedKVCache
+from ft_sgemm_tpu.serve.tracing import new_trace_id, trace_scope
+from ft_sgemm_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    histogram_percentiles,
+)
+
+# The two block-request phases — mirrored as literals in
+# contracts.BLOCK_PHASES and telemetry's AXIS_LABELS["block_phase"]
+# (the lint axis-drift pass cross-checks the spellings).
+PHASES = ("prefill", "decode")
+
+_REQ_IDS = itertools.count(1)
+_SEQ_IDS = itertools.count(1)
+
+
+def new_sequence_id() -> int:
+    """Mint a fresh serving-sequence identity (one conversation)."""
+    return next(_SEQ_IDS)
+
+
+@dataclasses.dataclass
+class BlockRequest:
+    """One transformer-block request.
+
+    ``phase="prefill"``: ``q``/``k``/``v`` are the full ragged sequence
+    (``(L, d)``, ``(L, d)``, ``(L, dv)``); the engine runs causal
+    attention over it AND writes K/V into the checked KV cache under
+    ``(seq_id, layer, head)``. ``phase="decode"``: single new-token rows
+    (``(1, d)`` / ``(1, dv)``); the engine appends them, reads the whole
+    cached prefix back THROUGH the page checksums, and attends the new
+    query over it. ``variant`` selects the prewarmed in-flight injection
+    variant (same vocabulary as the GEMM engine); stored-state faults
+    are injected separately via :meth:`BlockEngine.corrupt_kv`.
+
+    Decodes for one sequence must be submitted sequentially (wait for
+    the previous decode's future): the cache length at submit time picks
+    the bucket.
+    """
+
+    phase: str
+    q: np.ndarray
+    k: np.ndarray
+    v: np.ndarray
+    seq_id: int = dataclasses.field(default_factory=new_sequence_id)
+    layer: int = 0
+    head: int = 0
+    in_dtype: str = "float32"
+    variant: str = "clean"
+    request_id: int = dataclasses.field(
+        default_factory=lambda: next(_REQ_IDS))
+    trace_id: str = dataclasses.field(default_factory=new_trace_id)
+
+    def __post_init__(self):
+        if self.phase not in PHASES:
+            raise ValueError(
+                f"BlockRequest.phase={self.phase!r} must be one of"
+                f" {PHASES}")
+        if self.variant not in VARIANTS:
+            raise ValueError(
+                f"BlockRequest.variant={self.variant!r} must be one of"
+                f" {VARIANTS} (one executable per (bucket, variant))")
+        self.q = np.asarray(self.q, np.float32)
+        self.k = np.asarray(self.k, np.float32)
+        self.v = np.asarray(self.v, np.float32)
+        if self.q.ndim != 2 or self.k.ndim != 2 or self.v.ndim != 2:
+            raise ValueError("BlockRequest q/k/v must be 2-D")
+        if self.q.shape[1] != self.k.shape[1]:
+            raise ValueError(
+                f"BlockRequest head-dim mismatch: q {self.q.shape} vs"
+                f" k {self.k.shape}")
+        if self.k.shape[0] != self.v.shape[0]:
+            raise ValueError(
+                f"BlockRequest k/v row mismatch: {self.k.shape[0]} !="
+                f" {self.v.shape[0]}")
+        if self.phase == "prefill":
+            if self.q.shape[0] != self.k.shape[0]:
+                raise ValueError(
+                    "prefill needs q and k/v over the SAME sequence"
+                    f" ({self.q.shape[0]} != {self.k.shape[0]})")
+        elif self.q.shape[0] != 1 or self.k.shape[0] != 1:
+            raise ValueError("decode carries exactly ONE new token row")
+
+    @property
+    def tokens(self) -> int:
+        """Output tokens this request produces (prefill: L, decode: 1)."""
+        return self.q.shape[0]
+
+
+@dataclasses.dataclass
+class BlockResult:
+    """What a block request's future resolves to."""
+
+    request_id: int
+    bucket_key: str
+    phase: str
+    seq_id: int
+    out: np.ndarray               # (tokens, dv), sliced to true rows
+    detections: int               # corrected in-flight GEMM faults
+    softmax_flags: int            # final softmax-stage flags (0 when ok)
+    uncorrectable: int            # final in-flight uncorrectable count
+    retries: int                  # in-flight bucket-scoped retries
+    kv_faults: int                # stored-state faults detected on read
+    kv_corrected: int             # ... corrected in place (free)
+    kv_restores: int              # ... recovered by page restore
+    kv_ok: bool                   # stored state verified (or no reads)
+    tokens: int
+    ok: bool                      # verified-or-corrected end to end
+    corrected: bool               # ok with any fault corrected en route
+    latency_seconds: float
+    trace_id: Optional[str] = None
+    devices: Optional[list] = None  # ring-path per-device blame entries
+
+
+@dataclasses.dataclass
+class _Entry:
+    request: BlockRequest
+    bucket: BlockBucket
+    future: _Future
+    t_enqueue: float
+
+
+class BlockEngine:
+    """Shape-bucketed continuous-batching transformer-block server.
+
+    Lifecycle mirrors :class:`~ft_sgemm_tpu.serve.engine.ServeEngine`::
+
+        engine = BlockEngine(default_block_bucket_set((128, 256)))
+        engine.start(); engine.prewarm()
+        fut = engine.submit(BlockRequest("prefill", q, k, v))
+        res = fut.result(timeout=300)      # BlockResult
+        engine.drain(); engine.close()
+
+    ``ring=True`` builds the ``inject`` variant's PREFILL executors
+    through :func:`~ft_sgemm_tpu.parallel.ring_attention.
+    make_ring_ft_attention` over all local devices, with
+    ``inject_coords`` pinning the self-test fault to one ring position —
+    injected in-flight faults then carry per-device blame entries in
+    their ``serve_block`` events. Decode (single new query) and the
+    clean/adversarial variants stay single-device.
+
+    ``kv_checksums=False`` disables the stored-state checksums; the
+    compiled executors are byte-identical either way (the cache is
+    host-side numpy — pinned in ``tests/test_serve_blocks.py``).
+    """
+
+    def __init__(self, buckets: Sequence[BlockBucket], *,
+                 threshold="static",
+                 max_batch: int = 4, max_wait: float = 0.05,
+                 max_retries: int = 2, retry_backoff: float = 0.01,
+                 kv_page_size: int = 32, kv_checksums: bool = True,
+                 kv_threshold: Optional[float] = None,
+                 ring: bool = False,
+                 inject_coords: Optional[tuple] = (1,),
+                 timeline=None, registry=None, monitor=None):
+        if not buckets:
+            raise ValueError("BlockEngine needs at least one bucket")
+        dims = {(b.d, b.dv, b.in_dtype) for b in buckets}
+        if len(dims) != 1:
+            raise ValueError(
+                "BlockEngine buckets must share (d, dv, in_dtype): one"
+                f" engine serves one model geometry, got {sorted(dims)}")
+        self.buckets: Tuple[BlockBucket, ...] = tuple(buckets)
+        self.d, self.dv, self.in_dtype = next(iter(dims))
+        self.threshold = threshold
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait)
+        self.max_retries = int(max_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.ring = bool(ring)
+        self.inject_coords = tuple(inject_coords) if inject_coords else None
+        self._mesh = None
+        self._tl = _as_recorder(timeline)
+        self.monitor = monitor
+        kv_kw = {} if kv_threshold is None else {"threshold": kv_threshold}
+        self.kv = PagedKVCache(self.d, self.dv, page_size=kv_page_size,
+                               checksums=kv_checksums, **kv_kw)
+        # Authoritative per-stream source rows — the stand-in for
+        # upstream re-materialization (re-running prefill from the
+        # prompt) that the page-restore ladder draws on. Dispatcher-
+        # thread-only after submit.
+        self._source: Dict[tuple, dict] = {}
+        from ft_sgemm_tpu import telemetry
+
+        self.registry = registry if registry is not None \
+            else telemetry.get_registry()
+
+        self._cond = threading.Condition()
+        self._pending: Dict[str, list] = {b.key: [] for b in self.buckets}
+        self._by_key = {b.key: b for b in self.buckets}
+        self._outstanding = 0
+        self._draining = False
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+        self._compile_lock = threading.Lock()
+        self._compiled: Dict[Tuple[str, str], object] = {}
+        self._prewarmed = False
+        self._t_first: Optional[float] = None
+
+        self._stats_lock = threading.Lock()
+        self._counts = {
+            "requests": 0, "completed": 0, "batches": 0,
+            "corrected_free": 0, "retries": 0, "whole_queue_retries": 0,
+            "uncorrectable_exhausted": 0, "rejected": 0,
+            "tokens_ok": 0, "tokens_total": 0,
+            "prefill": 0, "decode": 0,
+        }
+        self._per_bucket: Dict[str, dict] = {
+            b.key: {"requests": 0, "batches": 0, "retries": 0}
+            for b in self.buckets}
+
+    # -- executors: one AOT executable per (bucket, variant) ----------------
+
+    def _attn_shapes(self, bucket: BlockBucket):
+        """Explicit kernel tiles per bucket (no auto-shrink, tuner off —
+        the bucket IS the shape contract). QK contracts over the head
+        dim (one 128-granule); PV contracts over L_k and keeps ``bk``
+        at one granule for lk <= 512 so its K grid is >= 2 steps on the
+        256+ buckets — the depth the adversarial same-column schedule
+        needs to produce a genuine uncorrectable interval (the GEMM
+        engine's ``_bucket_tile`` rule, applied to the PV product)."""
+        from ft_sgemm_tpu.configs import KernelShape
+
+        bm = min(bucket.lq, 512)
+        bn = min(bucket.lk, 512)
+        qk = KernelShape(f"blkqk{bm}x{bn}", bm, bn, 128, (0,) * 7)
+        pvk = 128 if bucket.lk <= 512 else 512
+        pv = KernelShape(f"blkpv{bm}x{pvk}", bm, 128, pvk, (0,) * 7)
+        return qk, pv
+
+    def _variant_spec(self, variant: str):
+        from ft_sgemm_tpu.injection import InjectionSpec
+
+        if variant == "clean":
+            return InjectionSpec.none()
+        if variant == "inject":
+            # Reference-like correctable SDCs: rotating columns, every
+            # K step, corrected in-kernel by both attention GEMMs.
+            return InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+        # Adversarial: same-column faults — uncorrectable through the
+        # PV product's >= 2-step K grid on lk >= 256 buckets (the QK
+        # product's single head-dim step degenerates to a corrected
+        # single fault, which is fine: one uncorrectable source drives
+        # the retry ladder).
+        return InjectionSpec(enabled=True, every=1, magnitude=10000.0,
+                             col_stride=0)
+
+    def _use_ring(self, bucket: BlockBucket, variant: str) -> bool:
+        """Ring executors serve the INJECT variant's prefill buckets
+        (lq == lk, dims divide the ring) when ring mode is on — the
+        configuration that buys per-device fault attribution."""
+        if not self.ring or variant != "inject":
+            return False
+        if bucket.lq != bucket.lk:
+            return False
+        mesh = self._ring_mesh()
+        if mesh is None:
+            return False
+        dnum = mesh.shape["x"]
+        return bucket.lq % dnum == 0 and bucket.lk % dnum == 0
+
+    def _ring_mesh(self):
+        if self._mesh is None and self.ring:
+            from ft_sgemm_tpu.parallel.ring import make_ring_mesh
+
+            try:
+                self._mesh = make_ring_mesh()
+            except Exception:  # noqa: BLE001 — <2 devices: stay local
+                self._mesh = None
+                self.ring = False
+        return self._mesh
+
+    def _executor_fn(self, bucket: BlockBucket, variant: str):
+        """The python callable ``fn(q, k, v)`` the AOT executable is
+        compiled from. Returns raw ``(out, det, flags, unc)`` (+ ring
+        device counters when sharded) so the compiled signature is a
+        plain array tuple."""
+        qk_shape, pv_shape = self._attn_shapes(bucket)
+        spec = self._variant_spec(variant)
+        if self._use_ring(bucket, variant):
+            from ft_sgemm_tpu.configs import KernelShape
+            from ft_sgemm_tpu.parallel.ring_attention import (
+                make_ring_ft_attention)
+
+            # Shard-local tiles: each hop's GEMMs see (lq/D, lk/D)
+            # blocks — one 128-granule tile bounds the padding (and the
+            # interpret-mode cost of the CPU smoke).
+            tile = KernelShape("blkring", 128, 128, 128, (0,) * 7)
+            return make_ring_ft_attention(
+                self._ring_mesh(), causal=True, inject=spec,
+                strategy=bucket.strategy, threshold=self.threshold,
+                qk_shape=tile, pv_shape=tile, in_dtype=bucket.in_dtype,
+                inject_coords=self.inject_coords)
+        from ft_sgemm_tpu.ops.attention import make_ft_attention
+
+        attn = make_ft_attention(
+            causal=True, strategy=bucket.strategy,
+            threshold=self.threshold, qk_shape=qk_shape,
+            pv_shape=pv_shape, in_dtype=bucket.in_dtype)
+
+        def fn(q, k, v):
+            res = attn(q, k, v, spec)
+            return (res.out, res.detections, res.softmax_flags,
+                    res.uncorrectable)
+
+        return fn
+
+    def lowered_executor_text(self, bucket: BlockBucket,
+                              variant: str = "clean") -> str:
+        """The executor's lowered HLO as text — the surface
+        ``tests/test_serve_blocks.py`` pins byte-identical across
+        ``kv_checksums`` on/off (stored-state checking must never touch
+        the compiled computation)."""
+        import jax
+
+        fn, avals = self._jit_fn(bucket, variant)
+        return jax.jit(fn).lower(*avals).as_text()
+
+    def _jit_fn(self, bucket: BlockBucket, variant: str):
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._executor_fn(bucket, variant)
+        avals = (jax.ShapeDtypeStruct((bucket.lq, self.d), jnp.float32),
+                 jax.ShapeDtypeStruct((bucket.lk, self.d), jnp.float32),
+                 jax.ShapeDtypeStruct((bucket.lk, self.dv), jnp.float32))
+        return fn, avals
+
+    def _get_compiled(self, bucket: BlockBucket, variant: str):
+        key = (bucket.key, variant)
+        compiled = self._compiled.get(key)
+        if compiled is not None:
+            return compiled
+        with self._compile_lock:
+            compiled = self._compiled.get(key)
+            if compiled is not None:
+                return compiled
+            import jax
+
+            fn, avals = self._jit_fn(bucket, variant)
+            with self._tl.span(f"compile[{bucket.key}:{variant}]",
+                               kind="compile"):
+                compiled = jax.jit(fn).lower(*avals).compile()
+            self._compiled[key] = compiled
+            return compiled
+
+    def prewarm(self, variants=VARIANTS) -> dict:
+        """AOT-compile every (bucket, variant) executor; everything
+        after the ``prewarm_done`` point is the steady state the
+        zero-compile-span pin measures (same contract as the GEMM
+        engine's prewarm)."""
+        t0 = time.monotonic()
+        compiled = 0
+        for bucket in self.buckets:
+            for variant in variants:
+                self._get_compiled(bucket, variant)
+                compiled += 1
+        self._prewarmed = True
+        seconds = round(time.monotonic() - t0, 3)
+        self._tl.point("serve_block", "prewarm_done", compiled=compiled,
+                       seconds=seconds)
+        return {"compiled": compiled, "buckets": len(self.buckets),
+                "seconds": seconds}
+
+    # -- queue (the GEMM engine's discipline, block-typed) ------------------
+
+    def start(self) -> "BlockEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="serve-block-dispatch")
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "BlockEngine":
+        return self.start()
+
+    def __exit__(self, *exc):
+        if not any(exc):
+            self.drain()
+        self.close()
+        return False
+
+    def request_length(self, request: BlockRequest) -> int:
+        """The token count the request's bucket is selected on: prefill
+        length, or cached-prefix length + the new token for decode."""
+        if request.phase == "prefill":
+            return request.q.shape[0]
+        return self.kv.length(request.seq_id, request.layer,
+                              request.head) + 1
+
+    def submit(self, request: BlockRequest) -> _Future:
+        length = self.request_length(request)
+        try:
+            bucket = select_block_bucket(self.buckets, length,
+                                         request.phase,
+                                         in_dtype=request.in_dtype)
+        except Exception:
+            with self._stats_lock:
+                self._counts["rejected"] += 1
+            self.registry.counter("serve_block_rejected").inc()
+            raise
+        fut = _Future()
+        entry = _Entry(request, bucket, fut, time.monotonic())
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("BlockEngine is closed")
+            self._pending[bucket.key].append(entry)
+            self._outstanding += 1
+            if self._t_first is None:
+                self._t_first = time.monotonic()
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._counts["requests"] += 1
+            self._counts[request.phase] += 1
+            self._per_bucket[bucket.key]["requests"] += 1
+        self.registry.counter("serve_block_requests", bucket=bucket.key,
+                              block_phase=request.phase).inc()
+        self._tl.point("serve_block", "enqueue",
+                       trace_id=request.trace_id,
+                       request_id=request.request_id,
+                       bucket=bucket.key, block_phase=request.phase)
+        return fut
+
+    def _ready_keys(self, now: float) -> list:
+        out = []
+        for key, q in self._pending.items():
+            if not q:
+                continue
+            if (len(q) >= self.max_batch or self._draining or self._stop
+                    or now - q[0].t_enqueue >= self.max_wait):
+                out.append(key)
+        return out
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        waits = [self.max_wait - (now - q[0].t_enqueue)
+                 for q in self._pending.values() if q]
+        return max(0.0, min(waits)) if waits else None
+
+    def _dispatch_loop(self):
+        while True:
+            batches = []
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    ready = self._ready_keys(now)
+                    if ready:
+                        break
+                    if self._stop:
+                        return
+                    timeout = self._next_deadline(now)
+                    self._cond.wait(0.1 if timeout is None else timeout)
+                for key in ready:
+                    q = self._pending[key]
+                    take = q[:self.max_batch]
+                    del q[:len(take)]
+                    batches.append((self._by_key[key], take))
+            for bucket, entries in batches:
+                self._execute_batch(bucket, entries)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            try:
+                while self._outstanding > 0:
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"drain timed out with {self._outstanding}"
+                            " block requests outstanding")
+                    self._cond.wait(0.05)
+            finally:
+                self._draining = False
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        leftovers = []
+        with self._cond:
+            for q in self._pending.values():
+                leftovers.extend(q)
+                q.clear()
+            self._outstanding -= len(leftovers)
+        for entry in leftovers:
+            entry.future._reject(RuntimeError(
+                "BlockEngine closed with request still queued"))
+
+    # -- stored-state fault injection (the loadgen/test hook) ---------------
+
+    def corrupt_kv(self, seq_id: int, layer: int = 0, head: int = 0, *,
+                   page: Optional[int] = None, row: int = 0, cols=(0,),
+                   magnitude: float = 1000.0, which: str = "k",
+                   target: str = "data") -> int:
+        """Corrupt one stored page between decode steps (delegates to
+        :meth:`PagedKVCache.corrupt`; ``page=None`` targets the last
+        written page). Returns the corrupted page index."""
+        if page is None:
+            length = self.kv.length(seq_id, layer, head)
+            if length == 0:
+                raise ValueError(f"sequence {seq_id} has no cached state")
+            page = (length - 1) // self.kv.page_size
+        self.kv.corrupt(seq_id, layer, head, page, row=row, cols=cols,
+                        magnitude=magnitude, which=which, target=target)
+        return page
+
+    # -- execution ----------------------------------------------------------
+
+    def _execute_batch(self, bucket: BlockBucket, entries):
+        with self._stats_lock:
+            self._counts["batches"] += 1
+            self._per_bucket[bucket.key]["batches"] += 1
+        self.registry.counter("serve_block_batches",
+                              bucket=bucket.key).inc()
+        trace_ids = [e.request.trace_id for e in entries]
+        with self._tl.span(f"serve_block[{bucket.key}]", kind="stage",
+                           trace_ids=trace_ids) as info:
+            det_total = unc_total = 0
+            for entry in entries:
+                det, unc = self._execute_one(bucket, entry)
+                det_total += det
+                unc_total += unc
+            info["value"] = {"batch": len(entries),
+                             "detections": det_total,
+                             "uncorrectable_final": unc_total,
+                             "trace_ids": trace_ids}
+
+    def _append_source(self, key: tuple, k_rows, v_rows) -> None:
+        src = self._source.setdefault(
+            key, {"k": np.zeros((0, self.d), np.float32),
+                  "v": np.zeros((0, self.dv), np.float32)})
+        src["k"] = np.concatenate([src["k"], np.asarray(k_rows,
+                                                        np.float32)])
+        src["v"] = np.concatenate([src["v"], np.asarray(v_rows,
+                                                        np.float32)])
+
+    def _emit_kv_fault(self, fault, request, bucket) -> None:
+        """One stored-state finding -> kv_page event + timeline point +
+        monitor ring, all joined by the request's trace_id."""
+        from ft_sgemm_tpu import telemetry
+
+        outcome = "corrected" if fault.corrected else "uncorrectable"
+        coords = fault.coords()
+        extra = dict(coords)
+        extra.update(trace_id=request.trace_id,
+                     request_id=request.request_id, bucket=bucket.key,
+                     block_phase=request.phase)
+        self.registry.counter("kv_page_faults").inc()
+        if fault.corrected:
+            self.registry.counter("kv_page_corrected").inc()
+        telemetry.record_kv_page(
+            outcome, layer=f"L{fault.layer}H{fault.head}",
+            detected=1, corrected=1 if fault.corrected else 0,
+            uncorrectable=0 if fault.corrected else 1,
+            tiles=[[fault.page,
+                    fault.row if fault.row is not None else -1]],
+            extra=extra)
+        self._tl.point("kv_page", outcome, trace_id=request.trace_id,
+                       **coords)
+        if self.monitor is not None:
+            self.monitor.observe_retry(
+                {"outcome": outcome, "op": "kv_page",
+                 "detected": 1,
+                 "uncorrectable": 0 if fault.corrected else 1,
+                 "ts": time.time(), "extra": extra})
+
+    def _read_kv_verified(self, request: BlockRequest,
+                          bucket: BlockBucket):
+        """Read the request's cached stream through the page checksums,
+        with the bounded page-scoped restore/re-verify ladder. Returns
+        ``(K, V, info)``; ``info["ok"]`` False means a page stayed
+        unverified after the ladder was exhausted."""
+        from ft_sgemm_tpu import telemetry
+
+        key = (request.seq_id, request.layer, request.head)
+        info = {"faults": 0, "corrected": 0, "restores": 0,
+                "attempts": 0, "ok": True}
+        attempts = 0
+        while True:
+            self.registry.counter("kv_page_reads").inc()
+            K, V, faults = self.kv.read(*key)
+            for fault in faults:
+                info["faults"] += 1
+                if fault.corrected:
+                    info["corrected"] += 1
+                self._emit_kv_fault(fault, request, bucket)
+            bad = [f for f in faults if not f.corrected]
+            self._set_kv_gauge()
+            if not bad:
+                return K, V, info
+            if attempts >= self.max_retries:
+                info["ok"] = False
+                return K, V, info
+            attempts += 1
+            info["attempts"] = attempts
+            src = self._source.get(key)
+            for fault in bad:
+                if src is None:
+                    info["ok"] = False
+                    return K, V, info
+                sl = self.kv.page_slice(fault.page)
+                self.kv.restore(request.seq_id, request.layer,
+                                request.head, fault.page,
+                                src["k"][sl], src["v"][sl])
+                info["restores"] += 1
+                self.registry.counter("kv_page_restores").inc()
+                # The ladder event: page-scoped, bounded, joined to the
+                # request — the stored-state mirror of the bucket-scoped
+                # GEMM retry.
+                telemetry.record_step_event(
+                    "retry", op="kv_page", uncorrectable=1,
+                    extra={"trace_id": request.trace_id,
+                           "request_id": request.request_id,
+                           "bucket": bucket.key, "page": fault.page,
+                           "seq_id": fault.seq_id, "layer": fault.layer,
+                           "head": fault.head, "attempt": attempts})
+                self._tl.point("kv_page", "restore",
+                               trace_id=request.trace_id,
+                               seq_id=fault.seq_id, page=fault.page,
+                               layer=fault.layer, head=fault.head,
+                               attempt=attempts)
+
+    def _set_kv_gauge(self) -> None:
+        rate = self.kv.stats().get("verify_hit_rate")
+        if rate is not None:
+            self.registry.gauge("kv_verify_hit_rate").set(rate)
+
+    def _pad_operands(self, bucket: BlockBucket, request: BlockRequest,
+                      K: Optional[np.ndarray], V: Optional[np.ndarray]):
+        """Zero-pad to the bucket's executor shape. Prefill packs the
+        sequence at the TOP (rows 0..L-1; causal lq == lk masks padded
+        keys for every real query). Decode places the single real query
+        at row ``len - 1 - (lk - lq)`` so its end-anchored causal
+        position equals the last key — it attends exactly the ``len``
+        real keys and none of the padding."""
+        qp = np.zeros((bucket.lq, self.d), np.float32)
+        kp = np.zeros((bucket.lk, self.d), np.float32)
+        vp = np.zeros((bucket.lk, self.dv), np.float32)
+        if request.phase == "prefill":
+            length = request.q.shape[0]
+            qp[:length] = request.q
+            kp[:length] = request.k
+            vp[:length] = request.v
+            return qp, kp, vp, slice(0, length)
+        length = K.shape[0]
+        row = length - 1 - (bucket.lk - bucket.lq)
+        qp[row] = request.q[0]
+        kp[:length] = K
+        vp[:length] = V
+        return qp, kp, vp, slice(row, row + 1)
+
+    def _run_executor(self, bucket, variant, qp, kp, vp):
+        """One executor call, normalized to ``(out, det, flags, unc,
+        dev_entries)`` with host ints."""
+        compiled = self._get_compiled(bucket, variant)
+        res = compiled(qp, kp, vp)
+        dev_det = dev_unc = None
+        if len(res) == 6:  # ring executor: trailing per-device counters
+            out, det, flags, unc, dev_det, dev_unc = res
+        else:
+            out, det, flags, unc = res
+        return (out, int(np.asarray(det)), int(np.asarray(flags)),
+                int(np.asarray(unc)), dev_det, dev_unc)
+
+    def _execute_one(self, bucket: BlockBucket,
+                     entry: _Entry) -> Tuple[int, int]:
+        from ft_sgemm_tpu import telemetry
+
+        request = entry.request
+        with trace_scope(request.trace_id):
+            return self._execute_one_traced(bucket, entry, telemetry)
+
+    def _execute_one_traced(self, bucket: BlockBucket, entry: _Entry,
+                            telemetry) -> Tuple[int, int]:
+        request = entry.request
+        trace_id = request.trace_id
+        key = (request.seq_id, request.layer, request.head)
+        K = V = None
+        kv_info = {"faults": 0, "corrected": 0, "restores": 0, "ok": True}
+        if request.phase == "decode":
+            # New token enters the checked store FIRST (its page is
+            # resealed on write), then the whole prefix reads back
+            # through the checksums.
+            self.kv.append(*key, request.k, request.v)
+            self.registry.counter("kv_page_writes").inc()
+            self._append_source(key, request.k, request.v)
+            K, V, kv_info = self._read_kv_verified(request, bucket)
+            length = K.shape[0]
+            if not (bucket.fits_decode(length)):
+                # The submit-time length raced a concurrent decode of
+                # the same sequence (callers should sequence them);
+                # re-route honestly — a compile here is RECORDED.
+                bucket = select_block_bucket(self.buckets, length,
+                                             "decode",
+                                             in_dtype=request.in_dtype)
+        qp, kp, vp, out_slice = self._pad_operands(bucket, request, K, V)
+        variant = request.variant
+        retries = 0
+        out = det = flags = unc = None
+        dev_det = dev_unc = None
+        while True:
+            out, det, flags, unc, dev_det, dev_unc = self._run_executor(
+                bucket, variant, qp, kp, vp)
+            # Softmax flags are detect-only (no redundancy to correct
+            # from): a flagged step re-runs, exactly like an
+            # uncorrectable GEMM interval.
+            if (unc == 0 and flags == 0) or retries >= self.max_retries:
+                break
+            retries += 1
+            backoff = self.retry_backoff * (2 ** (retries - 1))
+            with self._stats_lock:
+                self._counts["retries"] += 1
+                self._per_bucket[bucket.key]["retries"] += 1
+            self.registry.counter("serve_block_retries",
+                                  bucket=bucket.key).inc()
+            retry_extra = {"trace_id": trace_id, "bucket": bucket.key,
+                           "request_id": request.request_id,
+                           "block_phase": request.phase,
+                           "attempt": retries,
+                           "softmax_flags": flags,
+                           "backoff_seconds": round(backoff, 6)}
+            telemetry.record_step_event(
+                "retry", op="serve_block", uncorrectable=unc,
+                extra=retry_extra)
+            self._tl.point("serve_block", "retry", trace_id=trace_id,
+                           bucket=bucket.key, attempt=retries,
+                           uncorrectable=unc, softmax_flags=flags)
+            if self.monitor is not None:
+                self.monitor.observe_retry(
+                    {"outcome": "retry", "op": "serve_block",
+                     "uncorrectable": unc, "ts": time.time(),
+                     "extra": retry_extra})
+            if backoff > 0:
+                time.sleep(backoff)
+            # Transient-SDC model: the retry re-executes clean.
+            variant = "clean"
+        kv_ok = bool(kv_info["ok"])
+        ok = unc == 0 and flags == 0 and kv_ok
+        corrected = ok and (det > 0 or kv_info["corrected"] > 0
+                            or kv_info["restores"] > 0)
+        if corrected:
+            with self._stats_lock:
+                self._counts["corrected_free"] += 1
+            self.registry.counter("serve_block_corrected_free",
+                                  bucket=bucket.key).inc()
+        if not ok:
+            with self._stats_lock:
+                self._counts["uncorrectable_exhausted"] += 1
+            self.registry.counter("serve_block_uncorrectable_exhausted",
+                                  bucket=bucket.key).inc()
+            exhausted_extra = {"trace_id": trace_id, "bucket": bucket.key,
+                               "request_id": request.request_id,
+                               "block_phase": request.phase,
+                               "attempts": retries,
+                               "kv_ok": kv_ok}
+            telemetry.record_step_event(
+                "exhausted", op="serve_block", uncorrectable=unc,
+                extra=exhausted_extra)
+            self._tl.point("serve_block", "exhausted", trace_id=trace_id,
+                           bucket=bucket.key, attempts=retries,
+                           uncorrectable=unc)
+            if self.monitor is not None:
+                self.monitor.observe_retry(
+                    {"outcome": "exhausted", "op": "serve_block",
+                     "uncorrectable": unc, "ts": time.time(),
+                     "extra": exhausted_extra})
+        if request.phase == "prefill" and ok:
+            # Verified prefill state enters the checked store: every
+            # page seals its checksum rows as it is written.
+            self.kv.append(*key, request.k, request.v)
+            self.registry.counter("kv_page_writes").inc()
+            self._append_source(key, request.k, request.v)
+        latency = time.monotonic() - entry.t_enqueue
+        tokens = request.tokens
+        with self._stats_lock:
+            self._counts["tokens_total"] += tokens
+            if ok:
+                self._counts["tokens_ok"] += tokens
+            tokens_ok = self._counts["tokens_ok"]
+        if ok:
+            self.registry.counter("serve_block_tokens").inc(tokens)
+        if self._t_first is not None:
+            elapsed = max(time.monotonic() - self._t_first, 1e-9)
+            self.registry.gauge("serve_block_tokens_per_second").set(
+                round(tokens_ok / elapsed, 3))
+        for labels in ({}, {"bucket": bucket.key}):
+            self.registry.histogram("serve_block_latency_seconds",
+                                    buckets=LATENCY_BUCKETS,
+                                    **labels).observe(latency)
+        request_extra = {
+            "trace_id": trace_id,
+            "request_id": request.request_id,
+            "bucket": bucket.key,
+            "block_phase": request.phase,
+            "seq_id": request.seq_id,
+            "layer": request.layer,
+            "head": request.head,
+            "variant": request.variant,
+            "retries": retries,
+            "tokens": tokens,
+            "kv_faults": kv_info["faults"],
+            "kv_corrected": kv_info["corrected"],
+            "kv_restores": kv_info["restores"],
+            "latency_seconds": round(latency, 6)}
+        devices = None
+        if telemetry.enabled():
+            from ft_sgemm_tpu.ops.attention import FtAttentionResult
+
+            res_like = FtAttentionResult(out, np.int32(det),
+                                         np.int32(flags), np.int32(unc))
+            if dev_det is not None:
+                ev = telemetry.record_mesh_attention(
+                    "serve_block", res_like, strategy=bucket.strategy,
+                    dev_detections=dev_det, dev_uncorrectable=dev_unc,
+                    axes=("x",), extra=dict(request_extra))
+                devices = ev.devices if ev is not None else None
+            else:
+                telemetry.record_attention(
+                    "serve_block", res_like, strategy=bucket.strategy,
+                    layer=bucket.key, extra=dict(request_extra))
+        if self.monitor is not None:
+            self.monitor.observe_request({
+                "outcome": ("uncorrectable" if not ok else
+                            "corrected" if corrected else "clean"),
+                "op": "serve_block", "detected": det,
+                "corrected": det if corrected else 0,
+                "uncorrectable": unc, "strategy": bucket.strategy,
+                "layer": bucket.key, "tiles": None,
+                "device": _device_label(out), "ts": time.time(),
+                "extra": dict(request_extra, ok=ok)})
+        out_rows = np.asarray(out)[out_slice, :self.dv]
+        result = BlockResult(
+            request_id=request.request_id, bucket_key=bucket.key,
+            phase=request.phase, seq_id=request.seq_id, out=out_rows,
+            detections=det, softmax_flags=flags, uncorrectable=unc,
+            retries=retries, kv_faults=kv_info["faults"],
+            kv_corrected=kv_info["corrected"],
+            kv_restores=kv_info["restores"], kv_ok=kv_ok,
+            tokens=tokens, ok=ok, corrected=corrected,
+            latency_seconds=latency, trace_id=trace_id, devices=devices)
+        with self._stats_lock:
+            self._counts["completed"] += 1
+        entry.future._resolve(result)
+        with self._cond:
+            self._outstanding -= 1
+            self._cond.notify_all()
+        return det, unc
+
+    # -- stats --------------------------------------------------------------
+
+    def latency_percentiles(self, quantiles=(0.5, 0.99)) -> dict:
+        hist = self.registry.histogram("serve_block_latency_seconds",
+                                       buckets=LATENCY_BUCKETS)
+        return histogram_percentiles(hist.value, quantiles=quantiles)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            counts = dict(self._counts)
+            per_bucket = {k: dict(v) for k, v in self._per_bucket.items()}
+        out = dict(counts)
+        out["per_bucket"] = per_bucket
+        out["prewarmed"] = self._prewarmed
+        out["latency"] = self.latency_percentiles()
+        out["kv"] = self.kv.stats()
+        out["ring"] = self.ring
+        return out
+
+
+__all__ = ["BlockEngine", "BlockRequest", "BlockResult", "PHASES",
+           "new_sequence_id"]

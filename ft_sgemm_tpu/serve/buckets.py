@@ -45,12 +45,17 @@ class BucketOverflowError(ValueError):
     map it to a clean client-facing rejection instead of a 500."""
 
 
-def _pow2_dim(v: int) -> int:
-    """Next power of two >= v, floored at 128 (tuner.mnk_bucket's rule)."""
+def pow2_dim(v: int) -> int:
+    """Next power of two >= v, floored at 128 (tuner.mnk_bucket's rule —
+    the ONE padding rule every serving bucket family shares, GEMM mnk
+    and transformer-block sequence dims alike)."""
     b = 128
     while b < v:
         b *= 2
     return b
+
+
+_pow2_dim = pow2_dim  # original (pre-block) spelling, kept for callers
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,5 +160,136 @@ def select_bucket(buckets: Iterable[Bucket], m: int, n: int, k: int,
     return min(fitting, key=lambda b: (b.volume, b.key))
 
 
-__all__ = ["Bucket", "BucketOverflowError", "default_bucket_set",
-           "select_bucket"]
+# ---------------------------------------------------------------------------
+# Transformer-block buckets: ragged sequences onto padded (L_q, L_k)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockBucket:
+    """One padded transformer-block serving target: attention requests
+    routed here run ONE compiled executor at exactly ``(lq, d) x (lk, d)
+    x (lk, dv)`` under ``strategy``. Sequence dims follow the SAME
+    tuner-aligned power-of-two-at-128 rule GEMM buckets use
+    (:func:`pow2_dim`); head dims ``d``/``dv`` are fixed per bucket set
+    (the model's geometry, not a ragged axis).
+
+    Decode buckets keep ``lq < lk`` (a single new query over a long
+    cached prefix). Causal masking is end-anchored by placing the real
+    query row at ``lq - 1 - (lk - len)``, which requires
+    ``len > lk - lq`` — :meth:`fits_decode` enforces it, and
+    :func:`default_block_bucket_set` builds decode rungs with
+    ``lq = lk / 2`` (floored at 128) so the smallest fitting rung always
+    satisfies it.
+    """
+
+    lq: int
+    lk: int
+    d: int
+    dv: int
+    in_dtype: str = "float32"
+    strategy: str = "weighted"
+
+    def __post_init__(self):
+        for field in ("lq", "lk"):
+            v = getattr(self, field)
+            if not isinstance(v, int) or v <= 0 or v != pow2_dim(v):
+                raise ValueError(
+                    f"BlockBucket.{field}={v!r} must be a power of two"
+                    " >= 128 (tuner-cache bucket alignment)")
+        for field in ("d", "dv"):
+            v = getattr(self, field)
+            if not isinstance(v, int) or v <= 0:
+                raise ValueError(
+                    f"BlockBucket.{field}={v!r} must be a positive int")
+        if self.lq > self.lk:
+            raise ValueError(
+                f"BlockBucket lq={self.lq} > lk={self.lk}: causal serving"
+                " never has more queries than keys")
+        canon = check_kernel_legality(
+            strategy=self.strategy, encode="vpu", in_dtype=self.in_dtype)
+        object.__setattr__(self, "in_dtype", canon)
+
+    @property
+    def key(self) -> str:
+        """Stable bucket identity: padded seq dims, head dims, dtype,
+        strategy."""
+        return (f"L{self.lq}xK{self.lk}xD{self.d}v{self.dv}"
+                f"|{self.in_dtype}|{self.strategy}")
+
+    @property
+    def volume(self) -> int:
+        # Padded attention work ~ lq*lk*(d + dv): both GEMMs' FLOP scale.
+        return self.lq * self.lk * (self.d + self.dv)
+
+    def fits_prefill(self, length: int) -> bool:
+        return length <= self.lq and length <= self.lk
+
+    def fits_decode(self, length: int) -> bool:
+        """One query over ``length`` cached keys: needs the keys to fit
+        AND the end-anchored causal placement to exist (see class
+        docstring)."""
+        return length <= self.lk and length > self.lk - self.lq
+
+
+def default_block_bucket_set(seq_sizes: Sequence[int] = (128, 256, 512),
+                             d: int = 64, dv: Optional[int] = None,
+                             in_dtype: str = "float32",
+                             strategy: Optional[str] = None
+                             ) -> Tuple[BlockBucket, ...]:
+    """The block-bucket ladder: per padded sequence rung ``s``, one
+    PREFILL bucket ``(s, s)`` and one DECODE bucket ``(max(128, s/2),
+    s)`` (deduped where they coincide). The half-lq decode rule makes
+    the smallest fitting rung always satisfy the end-anchored causal
+    placement (``len > lk - lq`` holds whenever ``len > lk/2``, which
+    the power-of-two ladder guarantees for the smallest ``lk >= len``).
+    """
+    dtype = canonical_in_dtype(in_dtype)
+    if strategy is None:
+        strategy = DEFAULT_STRATEGY[dtype]
+    dv = d if dv is None else dv
+    out = []
+    for s in sorted(set(int(v) for v in seq_sizes)):
+        if s != pow2_dim(s):
+            raise ValueError(
+                f"default_block_bucket_set sizes must be powers of two"
+                f" >= 128 (tuner-cache bucket alignment), got {s}")
+        for lq in (s, max(128, s // 2)):
+            b = BlockBucket(lq, s, d, dv, in_dtype=dtype,
+                            strategy=strategy)
+            if b not in out:
+                out.append(b)
+    if not out:
+        raise ValueError("default_block_bucket_set needs at least one"
+                         " size")
+    return tuple(out)
+
+
+def select_block_bucket(buckets: Iterable[BlockBucket], length: int,
+                        phase: str, in_dtype: str = "float32"
+                        ) -> BlockBucket:
+    """The smallest configured block bucket that fits a ``length``-token
+    request of the given phase (``"prefill"`` routes on
+    :meth:`BlockBucket.fits_prefill`, ``"decode"`` on
+    :meth:`~BlockBucket.fits_decode`). Raises
+    :class:`BucketOverflowError` when nothing fits — same refusal
+    contract as :func:`select_bucket`."""
+    dtype = canonical_in_dtype(in_dtype)
+    fits = (BlockBucket.fits_prefill if phase == "prefill"
+            else BlockBucket.fits_decode)
+    fitting = [b for b in buckets
+               if b.in_dtype == dtype and fits(b, length)]
+    if not fitting:
+        same = [b for b in buckets if b.in_dtype == dtype]
+        largest = (max(same, key=lambda b: b.volume).key
+                   if same else "none configured for this dtype")
+        raise BucketOverflowError(
+            f"{phase} request of {length} tokens ({dtype}) exceeds every"
+            f" configured block bucket (largest: {largest}); reject or"
+            " deploy a larger bucket set")
+    return min(fitting, key=lambda b: (b.volume, b.key))
+
+
+__all__ = ["BlockBucket", "Bucket", "BucketOverflowError",
+           "default_block_bucket_set", "default_bucket_set", "pow2_dim",
+           "select_block_bucket", "select_bucket"]

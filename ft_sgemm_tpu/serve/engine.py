@@ -37,6 +37,13 @@ enabled each request emits one ``serve_gemm`` event carrying
 ``serve_latency_seconds`` histogram (``registry.LATENCY_BUCKETS``), whose
 :func:`~ft_sgemm_tpu.telemetry.registry.histogram_percentiles` estimates
 are the ONLY p50/p99 implementation the serving layer has.
+
+:mod:`ft_sgemm_tpu.serve.blocks` extends this engine contract from
+(M, N, K) GEMM requests to transformer-block requests (ragged
+prefill/decode attention over an ABFT-checked KV cache), reusing the
+queue/future/timeline machinery here — the ``_Future`` /
+``_NullRecorder`` / ``_as_recorder`` / ``_device_label`` helpers are
+shared plumbing, not engine-private.
 """
 
 from __future__ import annotations

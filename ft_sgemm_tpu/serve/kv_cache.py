@@ -1,0 +1,386 @@
+"""ABFT-checked paged KV cache: checksum rows carried by STORED state.
+
+The serving plane's fault story so far covers work *in flight*: every
+GEMM/attention accumulator is checksummed inside its kernel, so an SDC
+that strikes during a call is detected (and usually corrected) before
+the result leaves the op. Decode traffic adds a second exposure window
+the kernels cannot see: the KV cache. A key/value row written during
+prefill may sit in memory for thousands of decode steps before it is
+read again, and a bit flip in that *stored* state poisons every
+subsequent token silently — no kernel checksum ever observes it.
+
+This module extends the paper's core economics (arXiv 2305.01024:
+detect-and-correct in the same pass, so a corrected SDC is free) from
+products to state, the way the attention-ABFT literature prescribes for
+transformer stacks (arXiv 2507.16676 carries checksums through
+QK/softmax/PV; the cache is the stage between the two):
+
+- **Pages.** Each ``(sequence, layer, head)`` stream is stored as fixed
+  ``page_size``-row pages, K and V separately. Page granularity bounds
+  both the verify cost per read and the blast radius of a restore.
+- **Checksum rows appended on write.** Every page tensor carries TWO
+  extra rows (``contracts.KV_PAGE_CHECKSUM_ROWS``) derived whenever the
+  page's data changes: row ``p`` is the plain column sum ``1ᵀP`` and row
+  ``p+1`` the weighted column sum ``wᵀP`` with ``w_i = i + 1`` — the
+  classic ABFT row-locator pair, the same plain/weighted trick the
+  ``weighted`` kernel strategy uses for in-flight products.
+- **Verify on read.** A read recomputes both sums and compares against
+  the stored rows. A clean page costs two vector reductions. A single
+  corrupted element is *located* (column from the plain residual, row
+  from the weighted/plain ratio) and corrected IN PLACE — a stored-state
+  SDC repaired for free, no upstream recompute. A corrupted checksum row
+  itself (data intact) is rebuilt in place. Anything wider — multiple
+  columns, a non-integral row locator — is reported ``uncorrectable``
+  with full ``(layer, head, page)`` blame coordinates, and the caller
+  (the block engine's bounded page-scoped retry ladder) restores the
+  page from its authoritative source and re-verifies.
+- **Clean path untouched.** ``checksums=False`` stores bare pages and
+  skips verification entirely. Checksumming is HOST-side numpy over the
+  cache's own arrays: it never enters a traced computation, so the
+  compiled attention executors are byte-identical with checksums on or
+  off (pinned in ``tests/test_serve_blocks.py``).
+
+``corrupt()`` is the stored-state analog of the kernels'
+``InjectionSpec`` — the self-test hook load generators and tests use to
+flip elements of a page *between* decode steps, modeling the SDC that
+strikes memory rather than a MAC array.
+
+Thread-safety: one lock guards all page state (reads verify-and-repair,
+so even reads mutate). The block engine calls from its dispatcher
+thread while load generators inject corruption from producer threads.
+
+Stdlib + numpy only — no jax import, ever: cache state and its
+verification live on host by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Rows appended to every page tensor: [plain colsum, weighted colsum].
+# Mirrored as a literal in contracts.KV_PAGE_CHECKSUM_ROWS (the
+# lint-checked declaration); keep the two in sync.
+CHECKSUM_ROWS = 2
+
+# Clean-path recompute noise for f32 sums over <= page_size unit-scale
+# rows is ulp-scale (< 1e-5 observed at page_size 64); 1e-3 sits orders
+# above it and far below any fault that could skew attention output.
+DEFAULT_THRESHOLD = 1e-3
+
+
+@dataclasses.dataclass(frozen=True)
+class KVPageFault:
+    """One page-verification finding: the blame coordinates a fault
+    event carries (``seq_id``/``layer``/``head``/``page`` name the page;
+    ``which`` says K or V; ``row``/``col`` localize a corrected single
+    element, None when localization failed)."""
+
+    seq_id: int
+    layer: int
+    head: int
+    page: int
+    which: str                  # "k" | "v"
+    corrected: bool
+    residual: float
+    row: Optional[int] = None
+    col: Optional[int] = None
+
+    def coords(self) -> dict:
+        """The event/extra payload spelling of the blame coordinates."""
+        return {"seq_id": self.seq_id, "layer": self.layer,
+                "head": self.head, "page": self.page, "which": self.which,
+                "row": self.row, "col": self.col,
+                "residual": self.residual}
+
+
+@dataclasses.dataclass
+class _PageStream:
+    """All pages of one (seq, layer, head) stream for one of K/V."""
+
+    width: int
+    pages: List[np.ndarray] = dataclasses.field(default_factory=list)
+    rows: int = 0  # total valid rows across pages
+
+
+class PagedKVCache:
+    """Paged KV store whose pages carry their own checksum rows.
+
+    ``head_dim`` is K's row width, ``value_dim`` V's (defaults to
+    ``head_dim``). Pages hold ``page_size`` rows; with checksums on,
+    each page tensor is ``(page_size + CHECKSUM_ROWS, width)`` and the
+    trailing rows hold the plain/weighted column sums of the data rows
+    (zero padding rows contribute nothing, so partial pages verify
+    exactly like full ones).
+    """
+
+    def __init__(self, head_dim: int, value_dim: Optional[int] = None, *,
+                 page_size: int = 32, checksums: bool = True,
+                 threshold: float = DEFAULT_THRESHOLD):
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1")
+        self.head_dim = int(head_dim)
+        self.value_dim = int(value_dim if value_dim is not None
+                             else head_dim)
+        self.page_size = int(page_size)
+        self.checksums = bool(checksums)
+        self.threshold = float(threshold)
+        # Row-locator weights, fixed per cache (i + 1 so row 0 is
+        # distinguishable from "no corruption").
+        self._w = (np.arange(1, self.page_size + 1, dtype=np.float32)
+                   [:, None])
+        self._lock = threading.Lock()
+        self._streams: Dict[tuple, Dict[str, _PageStream]] = {}
+        self._counts = {
+            "writes": 0, "reads": 0, "pages_verified": 0,
+            "faults_detected": 0, "corrected_in_place": 0,
+            "checksum_rows_rebuilt": 0, "uncorrectable": 0,
+            "restores": 0,
+        }
+
+    # -- layout helpers -----------------------------------------------------
+
+    def _page_rows(self) -> int:
+        return self.page_size + (CHECKSUM_ROWS if self.checksums else 0)
+
+    def _new_page(self, width: int) -> np.ndarray:
+        return np.zeros((self._page_rows(), width), np.float32)
+
+    def _reseal(self, page: np.ndarray) -> None:
+        """Recompute and store the page's checksum rows from its data."""
+        if not self.checksums:
+            return
+        data = page[:self.page_size]
+        page[self.page_size] = data.sum(axis=0, dtype=np.float32)
+        page[self.page_size + 1] = (self._w * data).sum(
+            axis=0, dtype=np.float32)
+
+    def _stream(self, seq_id: int, layer: int, head: int,
+                which: str) -> _PageStream:
+        key = (int(seq_id), int(layer), int(head))
+        entry = self._streams.setdefault(key, {
+            "k": _PageStream(self.head_dim),
+            "v": _PageStream(self.value_dim)})
+        return entry[which]
+
+    # -- write path ---------------------------------------------------------
+
+    def append(self, seq_id: int, layer: int, head: int,
+               k_rows, v_rows) -> int:
+        """Append K/V rows (shape ``(n, head_dim)`` / ``(n, value_dim)``)
+        to the stream, page-packing and resealing every touched page's
+        checksum rows. Returns the stream's new total row count."""
+        k_rows = np.asarray(k_rows, np.float32)
+        v_rows = np.asarray(v_rows, np.float32)
+        if k_rows.ndim != 2 or k_rows.shape[1] != self.head_dim:
+            raise ValueError(
+                f"k_rows shape {k_rows.shape} != (n, {self.head_dim})")
+        if v_rows.ndim != 2 or v_rows.shape[1] != self.value_dim:
+            raise ValueError(
+                f"v_rows shape {v_rows.shape} != (n, {self.value_dim})")
+        if k_rows.shape[0] != v_rows.shape[0]:
+            raise ValueError("k_rows and v_rows must append together "
+                             f"({k_rows.shape[0]} != {v_rows.shape[0]})")
+        with self._lock:
+            for which, rows in (("k", k_rows), ("v", v_rows)):
+                stream = self._stream(seq_id, layer, head, which)
+                cursor = 0
+                while cursor < rows.shape[0]:
+                    slot = stream.rows % self.page_size
+                    if slot == 0 and stream.rows == len(
+                            stream.pages) * self.page_size:
+                        stream.pages.append(self._new_page(stream.width))
+                    page = stream.pages[-1]
+                    take = min(self.page_size - slot,
+                               rows.shape[0] - cursor)
+                    fresh = rows[cursor:cursor + take]
+                    page[slot:slot + take] = fresh
+                    if self.checksums:
+                        # Checksums update INCREMENTALLY from the rows
+                        # being written — never re-derived from stored
+                        # data, which would silently launder corruption
+                        # already sitting in the page (the write path
+                        # must preserve, not erase, the evidence a later
+                        # read needs).
+                        page[self.page_size] += fresh.sum(
+                            axis=0, dtype=np.float32)
+                        page[self.page_size + 1] += (
+                            self._w[slot:slot + take] * fresh).sum(
+                                axis=0, dtype=np.float32)
+                    stream.rows += take
+                    cursor += take
+            self._counts["writes"] += 1
+            return self._stream(seq_id, layer, head, "k").rows
+
+    def length(self, seq_id: int, layer: int, head: int) -> int:
+        with self._lock:
+            key = (int(seq_id), int(layer), int(head))
+            entry = self._streams.get(key)
+            return entry["k"].rows if entry else 0
+
+    def drop(self, seq_id: int) -> None:
+        """Free every stream of one sequence (end-of-conversation)."""
+        with self._lock:
+            for key in [k for k in self._streams if k[0] == int(seq_id)]:
+                del self._streams[key]
+
+    # -- verify / read path -------------------------------------------------
+
+    def _verify_page(self, page: np.ndarray, rows_valid: int,
+                     seq_id, layer, head, idx, which
+                     ) -> Optional[KVPageFault]:
+        """Verify one page; correct a localizable single-element fault or
+        a corrupted checksum row in place. Returns the fault record (or
+        None for a clean page)."""
+        data = page[:self.page_size]
+        c0 = data.sum(axis=0, dtype=np.float32)
+        c1 = (self._w * data).sum(axis=0, dtype=np.float32)
+        r0 = page[self.page_size] - c0
+        r1 = page[self.page_size + 1] - c1
+        tol = self.threshold
+        bad0 = np.abs(r0) > tol
+        bad1 = np.abs(r1) > tol
+        self._counts["pages_verified"] += 1
+        if not bad0.any() and not bad1.any():
+            return None
+        self._counts["faults_detected"] += 1
+        residual = float(max(np.abs(r0).max(), np.abs(r1).max()))
+        fault = dict(seq_id=int(seq_id), layer=int(layer), head=int(head),
+                     page=int(idx), which=which, residual=residual)
+        cols0 = np.flatnonzero(bad0)
+        if cols0.size == 0 or (bad1 & ~bad0).any():
+            # Plain row consistent but weighted row flags (or vice-versa
+            # mixed): the CHECKSUM rows themselves took the hit — the
+            # data still matches at least one independent sum, so the
+            # cheap repair is to reseal from data.
+            if cols0.size == 0:
+                self._reseal(page)
+                self._counts["checksum_rows_rebuilt"] += 1
+                return KVPageFault(corrected=True, **fault)
+        if cols0.size == 1 and not (bad1 & ~bad0).any():
+            c = int(cols0[0])
+            if abs(r0[c]) > 0 and bad1[c]:
+                ratio = float(r1[c]) / float(r0[c])
+                r = int(round(ratio)) - 1
+                if (abs(ratio - round(ratio)) < 0.05
+                        and 0 <= r < rows_valid):
+                    # Single element located: subtract the delta the
+                    # residual measures (stored - recomputed = -delta).
+                    data[r, c] += r0[c]
+                    self._reseal(page)
+                    self._counts["corrected_in_place"] += 1
+                    return KVPageFault(corrected=True, row=r, col=c,
+                                       **fault)
+            elif not bad1[c]:
+                # Plain checksum row corrupted at one column, weighted
+                # row agrees with data: rebuild the checksum rows.
+                self._reseal(page)
+                self._counts["checksum_rows_rebuilt"] += 1
+                return KVPageFault(corrected=True, col=c, **fault)
+        self._counts["uncorrectable"] += 1
+        return KVPageFault(corrected=False, **fault)
+
+    def read(self, seq_id: int, layer: int, head: int
+             ) -> Tuple[np.ndarray, np.ndarray, List[KVPageFault]]:
+        """Assemble the stream's full ``(K, V)`` matrices, verifying (and
+        where possible repairing) every page on the way. Returns
+        ``(K (n, head_dim), V (n, value_dim), faults)`` — ``faults``
+        lists every page whose checksums flagged, corrected or not; a
+        fault with ``corrected=False`` means the returned rows of that
+        page are UNVERIFIED and the caller must restore + re-read."""
+        with self._lock:
+            key = (int(seq_id), int(layer), int(head))
+            entry = self._streams.get(key)
+            self._counts["reads"] += 1
+            if entry is None:
+                return (np.zeros((0, self.head_dim), np.float32),
+                        np.zeros((0, self.value_dim), np.float32), [])
+            faults: List[KVPageFault] = []
+            outs = {}
+            for which in ("k", "v"):
+                stream = entry[which]
+                parts = []
+                for idx, page in enumerate(stream.pages):
+                    valid = min(self.page_size,
+                                stream.rows - idx * self.page_size)
+                    if self.checksums:
+                        f = self._verify_page(page, valid, seq_id, layer,
+                                              head, idx, which)
+                        if f is not None:
+                            faults.append(f)
+                    parts.append(page[:valid])
+                outs[which] = (np.concatenate(parts, axis=0) if parts
+                               else np.zeros((0, stream.width),
+                                             np.float32))
+            return outs["k"], outs["v"], faults
+
+    # -- fault injection + recovery ------------------------------------------
+
+    def corrupt(self, seq_id: int, layer: int, head: int, page: int, *,
+                row: int = 0, cols=(0,), magnitude: float = 1000.0,
+                which: str = "k", target: str = "data") -> None:
+        """Self-test hook (the stored-state ``InjectionSpec``): add
+        ``magnitude`` to the page's element(s) at ``(row, col)`` for each
+        col in ``cols`` WITHOUT resealing — modeling an SDC that strikes
+        memory after the write. ``target="checksum"`` corrupts the plain
+        checksum row instead of data. One col = the correctable single-
+        element case; several = the uncorrectable multi-column case."""
+        with self._lock:
+            stream = self._stream(seq_id, layer, head, which)
+            if not 0 <= page < len(stream.pages):
+                raise IndexError(
+                    f"page {page} out of range ({len(stream.pages)} pages)")
+            base = self.page_size if target == "checksum" else int(row)
+            for col in cols:
+                stream.pages[page][base, int(col)] += magnitude
+
+    def restore(self, seq_id: int, layer: int, head: int, page: int,
+                k_rows, v_rows) -> None:
+        """Rewrite ONE page from authoritative source rows (the page's
+        slice of the upstream K/V — re-materialized by the caller) and
+        reseal its checksums: the recovery arm of the block engine's
+        bounded page-scoped retry ladder."""
+        k_rows = np.asarray(k_rows, np.float32)
+        v_rows = np.asarray(v_rows, np.float32)
+        with self._lock:
+            for which, rows in (("k", k_rows), ("v", v_rows)):
+                stream = self._stream(seq_id, layer, head, which)
+                if not 0 <= page < len(stream.pages):
+                    raise IndexError(
+                        f"page {page} out of range "
+                        f"({len(stream.pages)} pages)")
+                fresh = self._new_page(stream.width)
+                fresh[:rows.shape[0]] = rows
+                self._reseal(fresh)
+                stream.pages[page] = fresh
+            self._counts["restores"] += 1
+
+    def page_slice(self, page: int) -> slice:
+        """The row range of ``page`` in the assembled stream — what the
+        caller slices out of its authoritative copy to feed
+        :meth:`restore`."""
+        return slice(page * self.page_size, (page + 1) * self.page_size)
+
+    # -- stats ----------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+            out["checksums"] = self.checksums
+            out["page_size"] = self.page_size
+            out["streams"] = len(self._streams)
+            out["pages"] = sum(len(e[w].pages)
+                               for e in self._streams.values()
+                               for w in ("k", "v"))
+            verified = out["pages_verified"]
+            out["verify_hit_rate"] = (
+                round(1.0 - out["faults_detected"] / verified, 6)
+                if verified else None)
+            return out
+
+
+__all__ = ["CHECKSUM_ROWS", "DEFAULT_THRESHOLD", "KVPageFault",
+           "PagedKVCache"]

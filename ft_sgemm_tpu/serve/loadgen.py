@@ -301,4 +301,424 @@ def run_serve_bench(*, smoke: bool = False,
     return stats
 
 
-__all__ = ["LoadSpec", "run_load", "run_serve_bench", "smoke_spec"]
+# ---------------------------------------------------------------------------
+# Transformer-block workload: ragged prefill/decode, tokens-correct/sec
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockLoadSpec:
+    """One transformer-block load scenario.
+
+    ``seq_lengths`` (+ optional ``seq_length_weights``) is the ragged
+    PREFILL length distribution — deliberately not bucket-aligned, so
+    padding and the causal-placement geometry are exercised.
+    ``decode_ratio`` is the prefill/decode mix knob: the target fraction
+    of requests that are decode steps (a decode only fires when some
+    sequence's previous request has resolved — decodes are sequential
+    per sequence — so the realized mix tracks the knob without blocking
+    the arrival loop). ``inject_rate`` / ``adversarial_rate`` drive the
+    IN-FLIGHT attention variants exactly like the GEMM spec;
+    ``kv_corrupt_rate`` is the per-decode probability that a STORED page
+    of the sequence is corrupted first (``kv_corrupt_elements=1`` is the
+    in-place-correctable single element, ``>1`` the multi-column
+    corruption only the page-restore ladder recovers).
+    """
+
+    num_requests: int = 24
+    decode_ratio: float = 0.6
+    seq_lengths: Tuple[int, ...] = (24, 48, 100, 180, 250)
+    seq_length_weights: Optional[Tuple[float, ...]] = None
+    d: int = 64
+    dv: int = 64
+    rate: float = 0.0
+    in_dtype: str = "float32"
+    inject_rate: float = 0.0
+    adversarial_rate: float = 0.0
+    kv_corrupt_rate: float = 0.0
+    kv_corrupt_elements: int = 1
+    # Alternate single-element and 3-element corruption across
+    # injections, so one run exercises BOTH recovery arms: in-place
+    # correction (free) and the page-restore ladder.
+    kv_corrupt_alternate: bool = False
+    kv_corrupt_magnitude: float = 1000.0
+    seed: int = 10
+    verify: bool = False
+    result_timeout: float = 600.0
+
+
+def block_smoke_spec() -> BlockLoadSpec:
+    """The CPU-runnable CI block scenario: a handful of ragged
+    sequences, in-flight SDCs on a quarter of requests, stored-page
+    corruption on half the decodes (mixing the correctable single
+    element with the restore-ladder multi-column case), everything
+    verified — enough traffic to pin tokens-correct goodput > 0 and
+    both fault planes detected in about a minute of interpret mode."""
+    return BlockLoadSpec(num_requests=14, decode_ratio=0.6,
+                         seq_lengths=(24, 60, 100, 150),
+                         inject_rate=0.25, adversarial_rate=0.1,
+                         kv_corrupt_rate=0.5,
+                         kv_corrupt_alternate=True, verify=True)
+
+
+def _block_variant(rng, spec, engine, length, phase) -> str:
+    from ft_sgemm_tpu.serve.buckets import select_block_bucket
+
+    u = float(rng.random())
+    if u < spec.adversarial_rate:
+        try:
+            bucket = select_block_bucket(engine.buckets, length, phase,
+                                         in_dtype=spec.in_dtype)
+            # The adversarial same-column schedule needs the PV
+            # product's K grid >= 2 steps (lk >= 256 at the serve
+            # tile); shallower buckets correct it — downgrade honestly.
+            if bucket.lk >= 256:
+                return "adversarial"
+        except BucketOverflowError:
+            pass
+        return "inject"
+    if u < spec.adversarial_rate + spec.inject_rate:
+        return "inject"
+    return "clean"
+
+
+def run_block_load(engine, spec: BlockLoadSpec, *,
+                   should_stop: Optional[Callable[[], bool]] = None,
+                   progress: Optional[Callable[[dict], None]] = None
+                   ) -> dict:
+    """Drive one transformer-block scenario and return the serving
+    stats dict — the block analog of :func:`run_load`, with goodput
+    measured in tokens-correct-per-second.
+
+    The generator keeps an authoritative host copy of every sequence's
+    K/V rows, so ``verify=True`` checks each result against the plain
+    XLA causal-attention oracle at the TRUE ragged shape — including
+    decodes whose stored pages were corrupted and recovered ("correct"
+    means numerically verified, not "no fault reported")."""
+    from ft_sgemm_tpu.ops.attention import attention_reference
+    from ft_sgemm_tpu.serve.blocks import BlockRequest
+
+    rng = np.random.default_rng(spec.seed)
+    t0 = time.monotonic()
+    sequences = []   # dicts: seq_id, k/v/q history, last future
+    submitted = []   # (request, future, seq record)
+    rejected = 0
+    corruptions = {"injected": 0, "elements": 0}
+    partial = False
+    for i in range(spec.num_requests):
+        if should_stop is not None and should_stop():
+            partial = True
+            break
+        def decodable_seqs(block: bool) -> list:
+            # Decodes are response-driven AND sequential per sequence: a
+            # sequence is decodable once its previous request resolved
+            # ok. ``block=True`` waits for the oldest in-flight one (a
+            # decode arrival cannot exist before its predecessor's
+            # response), keeping the realized mix near the knob even in
+            # the open-loop (rate=0) drive.
+            out = []
+            for s in sequences:
+                f = s["fut"]
+                if f is None:
+                    continue
+                if not f.done():
+                    if not block:
+                        continue
+                    try:
+                        f.result(timeout=spec.result_timeout)
+                    except TimeoutError:
+                        continue
+                    block = False  # one wait per arrival is plenty
+                if s["ok_so_far"] and not f.result(0).ok:
+                    s["ok_so_far"] = False  # dead: stop extending
+                if s["ok_so_far"]:
+                    out.append(s)
+            return out
+
+        decodable = []
+        if sequences and float(rng.random()) < spec.decode_ratio:
+            decodable = decodable_seqs(block=False) \
+                or decodable_seqs(block=True)
+        if decodable:
+            s = decodable[int(rng.integers(len(decodable)))]
+            if spec.kv_corrupt_rate > 0 and engine.kv.checksums \
+                    and float(rng.random()) < spec.kv_corrupt_rate:
+                length = engine.kv.length(s["seq_id"], 0, 0)
+                page = int(rng.integers(
+                    (length - 1) // engine.kv.page_size + 1))
+                valid = min(engine.kv.page_size,
+                            length - page * engine.kv.page_size)
+                n_cols = max(1, int(spec.kv_corrupt_elements))
+                if spec.kv_corrupt_alternate \
+                        and corruptions["injected"] % 2 == 1:
+                    n_cols = 3
+                cols = rng.choice(spec.d, size=min(n_cols, spec.d),
+                                  replace=False)
+                engine.corrupt_kv(
+                    s["seq_id"], page=page,
+                    row=int(rng.integers(valid)),
+                    cols=[int(c) for c in cols],
+                    magnitude=spec.kv_corrupt_magnitude)
+                corruptions["injected"] += 1
+                corruptions["elements"] += len(cols)
+            q = rng.standard_normal((1, spec.d)).astype(np.float32)
+            k = rng.standard_normal((1, spec.d)).astype(np.float32)
+            v = rng.standard_normal((1, spec.dv)).astype(np.float32)
+            length = s["k"].shape[0] + 1
+            req = BlockRequest("decode", q, k, v, seq_id=s["seq_id"],
+                               in_dtype=spec.in_dtype,
+                               variant=_block_variant(
+                                   rng, spec, engine, length, "decode"))
+        else:
+            lengths = np.asarray(spec.seq_lengths)
+            weights = spec.seq_length_weights
+            if weights is not None:
+                w = np.asarray(weights, np.float64)
+                length = int(rng.choice(lengths, p=w / w.sum()))
+            else:
+                length = int(lengths[int(rng.integers(len(lengths)))])
+            q = rng.standard_normal((length, spec.d)).astype(np.float32)
+            k = rng.standard_normal((length, spec.d)).astype(np.float32)
+            v = rng.standard_normal((length, spec.dv)).astype(np.float32)
+            s = {"seq_id": None, "k": np.zeros((0, spec.d), np.float32),
+                 "v": np.zeros((0, spec.dv), np.float32),
+                 "fut": None, "ok_so_far": True}
+            req = BlockRequest("prefill", q, k, v,
+                               in_dtype=spec.in_dtype,
+                               variant=_block_variant(
+                                   rng, spec, engine, length, "prefill"))
+            s["seq_id"] = req.seq_id
+            sequences.append(s)
+        try:
+            fut = engine.submit(req)
+        except BucketOverflowError:
+            rejected += 1
+            if req.phase == "prefill":
+                sequences.remove(s)
+            continue
+        s["fut"] = fut
+        s["k"] = np.concatenate([s["k"], req.k])
+        s["v"] = np.concatenate([s["v"], req.v])
+        # The key count AS OF this request: later decodes extend the
+        # history, and this request's oracle must not see their keys.
+        submitted.append((req, fut, s, s["k"].shape[0]))
+        if progress is not None and (i + 1) % 8 == 0:
+            progress({"submitted": i + 1})
+        if spec.rate > 0:
+            time.sleep(float(rng.exponential(1.0 / spec.rate)))
+    engine.drain(timeout=spec.result_timeout)
+    wall = time.monotonic() - t0
+
+    completed = correct = corrected = uncorrectable_final = 0
+    tokens_total = tokens_correct = 0
+    kv_faults = kv_corrected = kv_restores = 0
+    retries = 0
+    verify_failures = 0
+    variant_counts: dict = {}
+    phase_counts = {"prefill": 0, "decode": 0}
+    for req, fut, s, n_keys in submitted:
+        res = fut.result(timeout=spec.result_timeout)
+        completed += 1
+        retries += res.retries
+        tokens_total += res.tokens
+        kv_faults += res.kv_faults
+        kv_corrected += res.kv_corrected
+        kv_restores += res.kv_restores
+        variant_counts[req.variant] = variant_counts.get(req.variant,
+                                                         0) + 1
+        phase_counts[req.phase] += 1
+        if res.corrected:
+            corrected += 1
+        if not res.ok:
+            s["ok_so_far"] = False
+            uncorrectable_final += 1
+            continue
+        if spec.verify:
+            if req.phase == "prefill":
+                want = np.asarray(attention_reference(
+                    req.q, req.k, req.v, causal=True))
+            else:
+                want = np.asarray(attention_reference(
+                    req.q, s["k"][:n_keys], s["v"][:n_keys],
+                    causal=True))
+            if not np.allclose(res.out, want, rtol=1e-3, atol=1e-3):
+                verify_failures += 1
+                s["ok_so_far"] = False
+                continue
+        correct += 1
+        tokens_correct += res.tokens
+
+    eng = engine.stats()
+    lat = eng["latency"]
+    stats = {
+        "workload": "block",
+        "requests_submitted": len(submitted),
+        "requests_rejected": rejected,
+        "completed": completed,
+        "correct": correct,
+        "corrected_free": corrected,
+        "uncorrectable_final": uncorrectable_final,
+        "verify_failures": verify_failures,
+        "verified": bool(spec.verify),
+        "retries": retries,
+        "bucket_retries": eng["retries"],
+        "whole_queue_retries": eng["whole_queue_retries"],
+        "batches": eng["batches"],
+        "variants": variant_counts,
+        "phases": phase_counts,
+        "sequences": len(sequences),
+        "inject_rate": spec.inject_rate,
+        "adversarial_rate": spec.adversarial_rate,
+        "kv_corrupt_rate": spec.kv_corrupt_rate,
+        "kv_corruptions_injected": corruptions["injected"],
+        "kv_faults": kv_faults,
+        "kv_corrected_in_place": kv_corrected,
+        "kv_page_restores": kv_restores,
+        "kv": eng["kv"],
+        "tokens_total": tokens_total,
+        "tokens_correct": tokens_correct,
+        "wall_seconds": round(wall, 3),
+        "throughput_tps": (round(tokens_total / wall, 3)
+                           if wall > 0 else None),
+        "goodput_tps": (round(tokens_correct / wall, 3)
+                        if wall > 0 else None),
+        "throughput_rps": round(completed / wall, 3) if wall > 0 else None,
+        "p50_latency_seconds": lat.get("p50"),
+        "p99_latency_seconds": lat.get("p99"),
+        "max_latency_seconds": lat.get("max"),
+        "per_bucket": eng["per_bucket"],
+        "ring": eng["ring"],
+    }
+    if partial:
+        stats["partial"] = True
+    return stats
+
+
+def run_block_serve_bench(*, smoke: bool = False,
+                          seq_sizes: Optional[Sequence[int]] = None,
+                          d: int = 64, dv: Optional[int] = None,
+                          in_dtype: str = "float32",
+                          num_requests: Optional[int] = None,
+                          decode_ratio: Optional[float] = None,
+                          inject_rate: Optional[float] = None,
+                          adversarial_rate: Optional[float] = None,
+                          kv_corrupt_rate: Optional[float] = None,
+                          rate: Optional[float] = None,
+                          max_batch: int = 4, max_wait: float = 0.05,
+                          verify: Optional[bool] = None,
+                          kv_checksums: bool = True,
+                          kv_page_size: int = 32,
+                          ring="auto",
+                          inject_coords: Optional[tuple] = (1,),
+                          timeline=None,
+                          should_stop: Optional[Callable[[], bool]] = None,
+                          progress_out=None,
+                          monitor="auto",
+                          monitor_port: Optional[int] = None,
+                          slo=None) -> dict:
+    """The transformer-block serve-bench core shared by ``bench.py
+    --serve --workload=block`` and ``cli serve-bench --workload=block``:
+    build the block-bucket set, prewarm it, drive the ragged
+    prefill/decode load (in-flight injection AND stored-page
+    corruption), and return the artifact context dict — goodput in
+    tokens-correct-per-second, KV verify/fault/restore counters, p50/p99
+    latency, and the SLO/health snapshot.
+
+    ``ring="auto"`` (default) routes the inject variant's prefill
+    executors through ring attention with ``inject_coords`` when two or
+    more local devices exist — injected in-flight faults then carry
+    per-ring-position device blame; pass ``ring=False`` to pin
+    single-device.
+    """
+    from ft_sgemm_tpu.serve.blocks import BlockEngine
+    from ft_sgemm_tpu.serve.buckets import default_block_bucket_set
+
+    sizes = tuple(seq_sizes) if seq_sizes else (
+        (128, 256) if smoke else (128, 256, 512))
+    buckets = default_block_bucket_set(sizes, d=d, dv=dv,
+                                       in_dtype=in_dtype)
+    base = block_smoke_spec() if smoke else BlockLoadSpec(
+        inject_rate=0.2, adversarial_rate=0.05, kv_corrupt_rate=0.3,
+        verify=False)
+    spec = dataclasses.replace(
+        base,
+        d=d, dv=d if dv is None else int(dv),
+        in_dtype=in_dtype,
+        num_requests=base.num_requests if num_requests is None
+        else int(num_requests),
+        decode_ratio=base.decode_ratio if decode_ratio is None
+        else float(decode_ratio),
+        inject_rate=base.inject_rate if inject_rate is None
+        else float(inject_rate),
+        adversarial_rate=base.adversarial_rate if adversarial_rate is None
+        else float(adversarial_rate),
+        kv_corrupt_rate=base.kv_corrupt_rate if kv_corrupt_rate is None
+        else float(kv_corrupt_rate),
+        rate=base.rate if rate is None else float(rate),
+        verify=base.verify if verify is None else bool(verify),
+    )
+    largest = max(sizes)
+    lengths = tuple(v for v in spec.seq_lengths if v <= largest)
+    spec = dataclasses.replace(spec,
+                               seq_lengths=lengths or (largest // 2,))
+
+    if ring == "auto":
+        import jax
+
+        ring = jax.device_count() >= 2
+
+    def progress(p):
+        if timeline is not None:
+            timeline.point("serve_progress", "load", **p)
+        if progress_out is not None:
+            print(f"serve-block-bench: {p}", file=progress_out,
+                  flush=True)
+
+    mon = None
+    mon_server = None
+    if monitor == "auto":
+        from ft_sgemm_tpu.telemetry.monitor import Monitor
+
+        mon = Monitor(slo=slo)
+    elif monitor is not None:
+        mon = monitor
+    if mon is not None:
+        mon.attach()
+        if monitor_port is not None:
+            from ft_sgemm_tpu.telemetry.monitor import MonitorServer
+
+            mon_server = MonitorServer(mon, port=monitor_port).start()
+            progress({"monitor_url": mon_server.url})
+    try:
+        with BlockEngine(buckets, max_batch=max_batch, max_wait=max_wait,
+                         kv_checksums=kv_checksums,
+                         kv_page_size=kv_page_size, ring=bool(ring),
+                         inject_coords=inject_coords,
+                         timeline=timeline, monitor=mon) as engine:
+            t0 = time.monotonic()
+            prewarm = engine.prewarm()
+            progress({"prewarmed": prewarm["compiled"],
+                      "seconds": prewarm["seconds"]})
+            stats = run_block_load(engine, spec, should_stop=should_stop,
+                                   progress=progress)
+            stats["prewarm"] = prewarm
+            stats["buckets"] = [b.key for b in buckets]
+            stats["smoke"] = bool(smoke)
+            stats["kv_checksums"] = bool(kv_checksums)
+            stats["seconds_total"] = round(time.monotonic() - t0, 3)
+        if mon is not None:
+            stats["slo"] = mon.snapshot()
+            stats["device_health"] = stats["slo"]["device_health"]
+            if mon_server is not None:
+                stats["monitor_url"] = mon_server.url
+    finally:
+        if mon_server is not None:
+            mon_server.close()
+        if mon is not None:
+            mon.detach()
+    return stats
+
+
+__all__ = ["BlockLoadSpec", "LoadSpec", "block_smoke_spec",
+           "run_block_load", "run_block_serve_bench", "run_load",
+           "run_serve_bench", "smoke_spec"]

@@ -598,6 +598,40 @@ def record_mesh_attention(op: str, result, *, dev_detections=None,
     return ev
 
 
+def record_kv_page(outcome: str, *, op: str = "kv_page",
+                   layer: Optional[str] = None,
+                   device: Optional[str] = None,
+                   detected: int = 0, corrected: int = 0,
+                   uncorrectable: int = 0,
+                   residual: Optional[float] = None,
+                   tiles: Optional[list] = None,
+                   extra: Optional[dict] = None) -> Optional[FaultEvent]:
+    """Record one stored-state KV-page verification finding.
+
+    The serving plane's third fault stream (after per-call GEMM reports
+    and the recovery ladder): corruption detected in a CACHED page on
+    read — ``corrected`` when repaired in place (single element located
+    by the plain/weighted checksum-row pair, or a checksum row rebuilt),
+    ``uncorrectable`` when the page needs the engine's restore ladder.
+    ``tiles`` carries ``[page, row]`` blame coordinates and ``extra``
+    the full ``(seq_id, layer, head, page)`` spelling plus the request's
+    ``trace_id``, so one grep joins a decode request to the page that
+    corrupted under it. Host-side by construction (the cache never
+    touches a traced computation); never suppressed — like the ladder
+    stream, it is not a call report."""
+    if not _STATE.enabled:
+        return None
+    event = FaultEvent(
+        outcome=outcome, op=op, detected=int(detected),
+        corrected=int(corrected), uncorrectable=int(uncorrectable),
+        step=_STATE.step, layer=layer, device=device,
+        residual=residual, tiles=tiles, extra=extra, ts=time.time())
+    _STATE.registry.counter("kv_page_events", op=op,
+                            outcome=outcome).inc()
+    _emit(event)
+    return event
+
+
 def record_step_event(outcome: str, *, op: str = "resilient_step",
                       step: Optional[int] = None,
                       uncorrectable: int = 0,
@@ -644,6 +678,7 @@ __all__ = [
     "read_events",
     "record_attention",
     "record_gemm",
+    "record_kv_page",
     "record_mesh_attention",
     "record_mesh_gemm",
     "record_step_event",

@@ -52,6 +52,11 @@ AXIS_LABELS = {
     "strategy": ("rowcol", "global", "weighted", "fused"),
     "encode": ("vpu", "mxu"),
     "threshold_mode": ("static", "auto", "adaptive"),
+    # Transformer-block serving phase (rides ``extra["block_phase"]`` on
+    # serve_block events) — mirrors contracts.BLOCK_PHASES, the same
+    # import-free mirror discipline as the kernel axes above (the lint
+    # axis-drift pass cross-checks the two spellings).
+    "block_phase": ("prefill", "decode"),
 }
 
 

@@ -399,7 +399,11 @@ STATUSES = ("OK", "DEGRADED", "FAILING")
 # the telemetry-observer path must skip them (one event, one ring entry;
 # the monitor's record_step_event("alert") would otherwise echo back
 # through the observer it itself registered).
-_SERVE_OPS = ("serve_gemm", "serve", "monitor")
+# Ops the serving engines feed DIRECTLY (observe_request /
+# observe_retry) — the telemetry-observer path skips them so one
+# request never lands twice. serve_block and kv_page joined in PR 12
+# (the block engine mirrors the GEMM engine's direct feed).
+_SERVE_OPS = ("serve_gemm", "serve", "serve_block", "kv_page", "monitor")
 
 
 class Monitor:

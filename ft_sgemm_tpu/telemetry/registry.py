@@ -211,6 +211,33 @@ _METRIC_HELP = {
     "serve_uncorrectable_exhausted": "Requests still uncorrectable "
                                     "after bounded retries",
     "serve_latency_seconds": "End-to-end serve request latency",
+    "serve_block_requests": "Transformer-block requests accepted per "
+                            "bucket and phase",
+    "serve_block_batches": "Block-serving batches flushed per bucket",
+    "serve_block_retries": "Bucket-scoped block-serving retries "
+                           "(in-flight attention faults)",
+    "serve_block_rejected": "Block requests rejected (bucket overflow)",
+    "serve_block_corrected_free": "Block requests whose fault (in flight "
+                                  "or stored) was corrected en route",
+    "serve_block_uncorrectable_exhausted": "Block requests still "
+                                           "unverified after bounded "
+                                           "retries",
+    "serve_block_tokens": "Correct output tokens served "
+                          "(prefill length + one per decode)",
+    "serve_block_tokens_per_second": "Tokens-correct-per-second since "
+                                     "the first block request",
+    "serve_block_latency_seconds": "End-to-end block request latency",
+    "kv_page_reads": "KV-cache stream reads (each verifies every page)",
+    "kv_page_writes": "KV-cache appends (each reseals its page's "
+                      "checksum rows)",
+    "kv_page_faults": "Stored KV pages whose checksums flagged on read",
+    "kv_page_corrected": "KV-page faults corrected in place "
+                         "(single-element / checksum-row rebuild)",
+    "kv_page_restores": "KV pages restored from source by the "
+                        "page-scoped retry ladder",
+    "kv_page_events": "kv_page fault events recorded, by outcome",
+    "kv_verify_hit_rate": "Fraction of page verifications that came "
+                          "back clean (1 = no stored-state faults)",
     "slo_budget_remaining": "Fraction of the rolling-window SLO error "
                             "budget left (0 = exhausted)",
     "slo_burn_rate": "SLO violation rate over allowed rate (>=1 burns "

@@ -48,14 +48,16 @@ SPAN_KINDS = ("stage", "attempt", "compile")
 
 # Every record kind any emitter may write — the spans above plus the
 # point-event families (worker heartbeats, supervisor kill markers, the
-# serve engine's enqueue/retry/exhausted points, loadgen progress).
+# serve engine's enqueue/retry/exhausted points, the block engine's
+# ``serve_block`` request hops and ``kv_page`` stored-state findings,
+# loadgen progress).
 # This is the timeline half of the declared telemetry schema: the lint
 # telemetry-schema pass statically checks every ``span(kind=...)`` /
 # ``point(kind, ...)`` call site in the tree against this tuple, so an
 # emitter cannot invent a kind the readers (summarize_timeline,
 # traceview, wallclock) have never heard of.
 KINDS = ("stage", "attempt", "compile", "heartbeat", "kill", "serve",
-         "serve_progress")
+         "serve_block", "kv_page", "serve_progress")
 
 
 class TimelineRecorder:

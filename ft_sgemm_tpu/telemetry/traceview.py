@@ -61,8 +61,11 @@ PID = 1
 
 
 def _tid_for(kind: Optional[str], name: Optional[str]) -> int:
-    if isinstance(name, str) and name.startswith("serve["):
+    if isinstance(name, str) and (name.startswith("serve[")
+                                  or name.startswith("serve_block[")):
         return _TID["serve"]
+    if kind in ("serve_block", "kv_page"):
+        return _TID["serve"] if kind == "serve_block" else _TID["faults"]
     return _TID.get(kind or "", _TID["other"])
 
 
@@ -235,7 +238,8 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
         trace_id = (ev.get("extra") or {}).get("trace_id") \
             if isinstance(ev.get("extra"), dict) else None
         hop(trace_id, ts, _TID["faults"],
-            "detect" if ev.get("op") == "serve_gemm"
+            "detect" if ev.get("op") in ("serve_gemm", "serve_block")
+            else f"kv_{ev.get('outcome')}" if ev.get("op") == "kv_page"
             else str(ev.get("outcome")))
 
     flow_events = 0

@@ -1,0 +1,600 @@
+"""Transformer-block serving tests (ISSUE 12): ragged prefill/decode
+bucketing, the ABFT-checked paged KV cache's corruption semantics
+(detection on READ, page-level blame coordinates, in-place correction,
+bounded page-scoped restore), the in-flight attention retry ladder, the
+clean path's byte-identical HLO with checksums off, ring-path per-device
+fault attribution, and the ledger-driven headline resume satellite."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu.serve import (
+    BlockEngine,
+    BlockRequest,
+    BucketOverflowError,
+    PagedKVCache,
+    default_block_bucket_set,
+    select_block_bucket,
+)
+from ft_sgemm_tpu.serve.buckets import BlockBucket
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+D = 16  # head dims small: the kernels pad to their 128-granule tiles
+
+
+# ---------------------------------------------------------------------------
+# Block buckets: the tuner-aligned pow2 rule over sequence dims
+# ---------------------------------------------------------------------------
+
+
+def test_block_bucket_routing_prefill_and_decode():
+    buckets = default_block_bucket_set((128, 256, 512), d=D)
+    b = select_block_bucket(buckets, 100, "prefill")
+    assert (b.lq, b.lk) == (128, 128)
+    b = select_block_bucket(buckets, 200, "prefill")
+    assert (b.lq, b.lk) == (256, 256)
+    # Decode rides the half-lq rungs: the end-anchored causal placement
+    # needs len > lk - lq, which the smallest fitting rung satisfies.
+    assert select_block_bucket(buckets, 100, "decode").key.startswith(
+        "L128xK128")
+    b = select_block_bucket(buckets, 200, "decode")
+    assert (b.lq, b.lk) == (128, 256)
+    b = select_block_bucket(buckets, 400, "decode")
+    assert (b.lq, b.lk) == (256, 512)
+    with pytest.raises(BucketOverflowError):
+        select_block_bucket(buckets, 513, "prefill")
+
+
+def test_block_bucket_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        BlockBucket(100, 128, D, D)
+    with pytest.raises(ValueError, match="lq"):
+        BlockBucket(256, 128, D, D)
+    with pytest.raises(ValueError, match="powers of two"):
+        default_block_bucket_set((384,), d=D)
+    # int8 routes to the exact strategies by the same legality gate the
+    # GEMM buckets use.
+    b8 = default_block_bucket_set((128,), d=D, in_dtype="int8")
+    assert all(b.strategy == "rowcol" for b in b8)
+
+
+def test_decode_placement_boundary():
+    b = BlockBucket(128, 256, D, D)
+    assert not b.fits_decode(128)   # len == lk - lq: no valid q row
+    assert b.fits_decode(129)
+    assert b.fits_decode(256)
+    assert not b.fits_decode(257)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache: checksum rows, verify-on-read, recovery semantics
+# ---------------------------------------------------------------------------
+
+
+def _cache(rng, rows=20, page_size=8, checksums=True):
+    c = PagedKVCache(D, D, page_size=page_size, checksums=checksums)
+    k = rng.standard_normal((rows, D)).astype(np.float32)
+    v = rng.standard_normal((rows, D)).astype(np.float32)
+    c.append(7, 1, 2, k, v)
+    return c, k, v
+
+
+def test_kv_roundtrip_partial_pages(rng):
+    c, k, v = _cache(rng, rows=20, page_size=8)  # 2 full + 1 partial
+    K, V, faults = c.read(7, 1, 2)
+    assert faults == []
+    np.testing.assert_array_equal(K, k)
+    np.testing.assert_array_equal(V, v)
+    assert c.length(7, 1, 2) == 20
+    assert c.stats()["verify_hit_rate"] == 1.0
+
+
+def test_kv_single_element_corruption_corrected_in_place(rng):
+    c, k, v = _cache(rng)
+    c.corrupt(7, 1, 2, 1, row=3, cols=(5,), magnitude=800.0)
+    K, _, faults = c.read(7, 1, 2)
+    assert len(faults) == 1
+    f = faults[0]
+    # Full blame coordinates: stream, page, and the located element.
+    assert (f.seq_id, f.layer, f.head, f.page) == (7, 1, 2, 1)
+    assert (f.row, f.col) == (3, 5)
+    assert f.corrected and f.which == "k"
+    np.testing.assert_allclose(K, k, atol=1e-3)
+    # The repair is durable: the next read is clean.
+    assert c.read(7, 1, 2)[2] == []
+
+
+def test_kv_corrupted_checksum_row_rebuilt(rng):
+    c, k, _ = _cache(rng)
+    c.corrupt(7, 1, 2, 0, cols=(2,), magnitude=50.0, target="checksum")
+    K, _, faults = c.read(7, 1, 2)
+    assert len(faults) == 1 and faults[0].corrected
+    np.testing.assert_array_equal(K, k)  # data was never touched
+    assert c.stats()["checksum_rows_rebuilt"] == 1
+    assert c.read(7, 1, 2)[2] == []
+
+
+def test_kv_multi_column_corruption_is_uncorrectable_then_restored(rng):
+    c, k, v = _cache(rng)
+    c.corrupt(7, 1, 2, 0, row=2, cols=(1, 4, 9), magnitude=300.0)
+    _, _, faults = c.read(7, 1, 2)
+    assert len(faults) == 1 and not faults[0].corrected
+    assert faults[0].page == 0
+    # The restore arm: rewrite the page from authoritative source rows.
+    sl = c.page_slice(0)
+    c.restore(7, 1, 2, 0, k[sl], v[sl])
+    K, V, faults = c.read(7, 1, 2)
+    assert faults == []
+    np.testing.assert_array_equal(K, k)
+    assert c.stats()["restores"] == 1
+
+
+def test_kv_append_preserves_existing_corruption(rng):
+    """Regression pin: appending to a partially-filled CORRUPTED page
+    must not reseal the evidence away — checksum rows update from the
+    written rows only, so the next read still detects the earlier hit."""
+    c, k, v = _cache(rng, rows=20, page_size=8)  # last page holds 4 rows
+    c.corrupt(7, 1, 2, 2, row=1, cols=(3,), magnitude=500.0)
+    c.append(7, 1, 2, rng.standard_normal((2, D)).astype(np.float32),
+             rng.standard_normal((2, D)).astype(np.float32))
+    _, _, faults = c.read(7, 1, 2)
+    assert len(faults) == 1
+    assert faults[0].page == 2 and faults[0].corrected
+    assert (faults[0].row, faults[0].col) == (1, 3)
+
+
+def test_kv_checksums_off_skips_verification(rng):
+    c, k, v = _cache(rng, checksums=False)
+    c.corrupt(7, 1, 2, 0, row=0, cols=(0,), magnitude=999.0)
+    K, _, faults = c.read(7, 1, 2)
+    assert faults == []          # nothing verifies, nothing flags
+    assert abs(K[0, 0] - k[0, 0] - 999.0) < 1e-3
+    assert c.stats()["pages_verified"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Block engine: prefill/decode dispatch over the checked cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    """One prewarmed block engine shared by the dispatch tests, its
+    timeline streamed for the warm-path pin."""
+    tl_path = str(tmp_path_factory.mktemp("blocks")
+                  / "blocks.timeline.jsonl")
+    eng = BlockEngine(default_block_bucket_set((128, 256), d=D),
+                      max_batch=3, max_wait=0.05, retry_backoff=0.001,
+                      kv_page_size=16, timeline=tl_path)
+    eng.start()
+    eng.prewarm()
+    yield eng
+    eng.close()
+
+
+def _qkv(rng, n, d=D, dv=D):
+    return (rng.standard_normal((n, d)).astype(np.float32),
+            rng.standard_normal((n, d)).astype(np.float32),
+            rng.standard_normal((n, dv)).astype(np.float32))
+
+
+def _oracle(q, k, v):
+    from ft_sgemm_tpu.ops.attention import attention_reference
+
+    return np.asarray(attention_reference(q, k, v, causal=True))
+
+
+def test_prefill_matches_causal_oracle_and_stores_pages(engine, rng):
+    q, k, v = _qkv(rng, 100)
+    req = BlockRequest("prefill", q, k, v)
+    res = engine.submit(req).result(timeout=300)
+    assert res.ok and res.phase == "prefill" and res.tokens == 100
+    np.testing.assert_allclose(res.out, _oracle(q, k, v),
+                               rtol=1e-3, atol=1e-3)
+    assert engine.kv.length(req.seq_id, 0, 0) == 100
+    # Pages sealed: a verified read of the stored stream is clean.
+    K, V, faults = engine.kv.read(req.seq_id, 0, 0)
+    assert faults == [] and K.shape == (100, D)
+
+
+def test_decode_extends_sequence_and_matches_oracle(engine, rng):
+    q, k, v = _qkv(rng, 60)
+    pre = BlockRequest("prefill", q, k, v)
+    assert engine.submit(pre).result(timeout=300).ok
+    K, V = k, v
+    for _ in range(2):
+        q1, k1, v1 = _qkv(rng, 1)
+        res = engine.submit(
+            BlockRequest("decode", q1, k1, v1,
+                         seq_id=pre.seq_id)).result(timeout=300)
+        K, V = np.vstack([K, k1]), np.vstack([V, v1])
+        assert res.ok and res.tokens == 1
+        np.testing.assert_allclose(res.out, _oracle(q1, K, V),
+                                   rtol=1e-3, atol=1e-3)
+    assert engine.kv.length(pre.seq_id, 0, 0) == 62
+
+
+def test_decode_through_half_lq_bucket(engine, rng):
+    """A >128-key prefix routes decode to the (lq=128, lk=256) rung; the
+    end-anchored causal placement attends exactly the real keys."""
+    q, k, v = _qkv(rng, 150)
+    pre = BlockRequest("prefill", q, k, v)
+    assert engine.submit(pre).result(timeout=300).ok
+    q1, k1, v1 = _qkv(rng, 1)
+    res = engine.submit(BlockRequest(
+        "decode", q1, k1, v1, seq_id=pre.seq_id)).result(timeout=300)
+    assert res.bucket_key.startswith("L128xK256")
+    np.testing.assert_allclose(
+        res.out, _oracle(q1, np.vstack([k, k1]), np.vstack([v, v1])),
+        rtol=1e-3, atol=1e-3)
+
+
+def test_stored_corruption_detected_on_read_with_blame_and_trace(
+        engine, rng, tmp_path):
+    """THE stored-state acceptance pin: corruption injected into a page
+    BETWEEN decode steps is detected on the next read, blamed on
+    (seq, layer, head, page) in a kv_page event carrying the decode
+    request's trace_id, corrected in place, and the result verifies."""
+    from ft_sgemm_tpu import telemetry
+
+    q, k, v = _qkv(rng, 40)
+    pre = BlockRequest("prefill", q, k, v)
+    assert engine.submit(pre).result(timeout=300).ok
+    engine.corrupt_kv(pre.seq_id, page=1, row=4, cols=(2,),
+                      magnitude=700.0)
+    log = tmp_path / "kv_events.jsonl"
+    telemetry.configure(log, log_clean=True)
+    try:
+        q1, k1, v1 = _qkv(rng, 1)
+        req = BlockRequest("decode", q1, k1, v1, seq_id=pre.seq_id)
+        res = engine.submit(req).result(timeout=300)
+    finally:
+        telemetry.disable()
+    assert res.ok and res.kv_faults == 1 and res.kv_corrected == 1
+    assert res.corrected  # the stored-state SDC was free
+    np.testing.assert_allclose(
+        res.out, _oracle(q1, np.vstack([k, k1]), np.vstack([v, v1])),
+        rtol=1e-3, atol=1e-3)
+    events = [json.loads(line) for line in open(log)]
+    kv_events = [e for e in events if e.get("op") == "kv_page"]
+    assert len(kv_events) == 1
+    ev = kv_events[0]
+    assert ev["outcome"] == "corrected"
+    assert ev["extra"]["trace_id"] == req.trace_id
+    assert (ev["extra"]["seq_id"], ev["extra"]["page"]) == (pre.seq_id, 1)
+    assert ev["extra"]["layer"] == 0 and ev["extra"]["head"] == 0
+    assert ev["tiles"] == [[1, 4]]
+    # ...and the request's own serve_block event joins the same trace.
+    blk = [e for e in events if e.get("op") == "serve_block"
+           and e.get("extra", {}).get("trace_id") == req.trace_id]
+    assert blk and blk[0]["extra"]["block_phase"] == "decode"
+    assert blk[0]["extra"]["kv_corrected"] == 1
+
+
+def test_multi_element_corruption_page_restore_ladder(engine, rng,
+                                                      tmp_path):
+    """Wider-than-one-element corruption defeats in-place correction:
+    the bounded PAGE-scoped restore ladder recovers it — restore event,
+    retry ladder record, clean re-verify — never a whole-queue retry."""
+    from ft_sgemm_tpu import telemetry
+
+    q, k, v = _qkv(rng, 40)
+    pre = BlockRequest("prefill", q, k, v)
+    assert engine.submit(pre).result(timeout=300).ok
+    engine.corrupt_kv(pre.seq_id, page=0, row=2, cols=(1, 5, 9),
+                      magnitude=400.0)
+    log = tmp_path / "kv_restore_events.jsonl"
+    telemetry.configure(log, log_clean=True)
+    try:
+        q1, k1, v1 = _qkv(rng, 1)
+        req = BlockRequest("decode", q1, k1, v1, seq_id=pre.seq_id)
+        res = engine.submit(req).result(timeout=300)
+    finally:
+        telemetry.disable()
+    assert res.ok and res.kv_restores >= 1 and res.kv_ok
+    assert res.corrected
+    np.testing.assert_allclose(
+        res.out, _oracle(q1, np.vstack([k, k1]), np.vstack([v, v1])),
+        rtol=1e-3, atol=1e-3)
+    events = [json.loads(line) for line in open(log)]
+    uncorr = [e for e in events if e.get("op") == "kv_page"
+              and e["outcome"] == "uncorrectable"]
+    assert uncorr and uncorr[0]["extra"]["trace_id"] == req.trace_id
+    ladder = [e for e in events if e.get("op") == "kv_page"
+              and e["outcome"] == "retry"]
+    assert ladder and ladder[0]["extra"]["page"] == 0
+    assert engine.stats()["whole_queue_retries"] == 0
+
+
+def test_inflight_inject_corrected_free(engine, rng):
+    q, k, v = _qkv(rng, 100)
+    res = engine.submit(BlockRequest("prefill", q, k, v,
+                                     variant="inject")).result(300)
+    assert res.ok and res.detections > 0 and res.retries == 0
+    assert res.corrected
+    np.testing.assert_allclose(res.out, _oracle(q, k, v),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_adversarial_uses_bucket_scoped_retry(engine, rng):
+    """Same-column faults through the PV product's >=2-step K grid are
+    uncorrectable in flight: recovered by the bounded bucket-scoped
+    retry (clean re-execute), never the whole queue."""
+    before = engine.stats()
+    q, k, v = _qkv(rng, 200)  # lk 256 bucket: adversarial depth
+    res = engine.submit(BlockRequest("prefill", q, k, v,
+                                     variant="adversarial")).result(300)
+    assert res.ok and res.retries >= 1
+    np.testing.assert_allclose(res.out, _oracle(q, k, v),
+                               rtol=1e-3, atol=1e-3)
+    after = engine.stats()
+    assert after["retries"] > before["retries"]
+    assert after["whole_queue_retries"] == 0
+
+
+def test_clean_path_hlo_byte_identical_with_checksums_off():
+    """Acceptance pin: the KV checksums are host-side state — disabling
+    them changes NOTHING in the compiled executors (byte-identical
+    lowered HLO), the same zero-cost-off discipline as telemetry."""
+    buckets = default_block_bucket_set((128,), d=D)
+    eng_on = BlockEngine(buckets, kv_checksums=True)
+    eng_off = BlockEngine(buckets, kv_checksums=False)
+    try:
+        for variant in ("clean", "inject"):
+            on = eng_on.lowered_executor_text(buckets[0], variant)
+            off = eng_off.lowered_executor_text(buckets[0], variant)
+            assert on == off, f"HLO drift with checksums off ({variant})"
+    finally:
+        eng_on.close()
+        eng_off.close()
+
+
+def test_prewarmed_steady_state_records_zero_compile_spans(engine):
+    """Warm-path purity, block edition: every compile span precedes the
+    prewarm_done point; steady-state block serving compiles nothing."""
+    from ft_sgemm_tpu.telemetry import timeline as tl_mod
+
+    engine.drain(timeout=30.0)
+    records = tl_mod.read_timeline(engine._tl.path)
+    done = [r for r in records if r.get("name") == "prewarm_done"]
+    assert done, "prewarm_done point missing"
+    t_done = done[0]["t"]
+    post = [r for r in records if r["t"] > t_done]
+    assert not any(r.get("kind") == "compile" for r in post), \
+        "steady-state block serving dispatched a compile"
+    assert any(r.get("kind") == "stage"
+               and str(r.get("name", "")).startswith("serve_block[")
+               for r in post)
+
+
+def test_rejected_overflow_counts(engine, rng):
+    before = engine.stats()["rejected"]
+    q, k, v = _qkv(rng, 300)  # exceeds the 256 ladder
+    with pytest.raises(BucketOverflowError):
+        engine.submit(BlockRequest("prefill", q, k, v))
+    assert engine.stats()["rejected"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Ring path: per-device attribution of in-flight faults (8 vdev CPU)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_inject_attributes_device_and_joins_kv_trace(rng, tmp_path):
+    """The 8-vdev acceptance: in-flight attention faults (ring inject,
+    pinned to one ring position by inject_coords) AND stored KV-page
+    faults are EACH detected and attributed — (request, device) on the
+    serve_block event's devices list, (request, page) on the kv_page
+    event — all joined by request trace_ids."""
+    from ft_sgemm_tpu import telemetry
+
+    eng = BlockEngine(default_block_bucket_set((128,), d=D),
+                      max_batch=2, max_wait=0.02, retry_backoff=0.001,
+                      kv_page_size=16, ring=True, inject_coords=(2,))
+    eng.start()
+    log = tmp_path / "ring_events.jsonl"
+    telemetry.configure(log, log_clean=True)
+    try:
+        q, k, v = _qkv(rng, 64)
+        pre = BlockRequest("prefill", q, k, v, variant="inject")
+        res = eng.submit(pre).result(timeout=300)
+        assert res.ok and res.detections > 0
+        assert res.devices, "ring inject carried no device blame"
+        assert all(d["coords"] == [2] for d in res.devices)
+        np.testing.assert_allclose(res.out, _oracle(q, k, v),
+                                   rtol=1e-3, atol=1e-3)
+        eng.corrupt_kv(pre.seq_id, page=0, row=1, cols=(4,),
+                       magnitude=600.0)
+        q1, k1, v1 = _qkv(rng, 1)
+        dec = BlockRequest("decode", q1, k1, v1, seq_id=pre.seq_id)
+        res2 = eng.submit(dec).result(timeout=300)
+        assert res2.ok and res2.kv_faults == 1
+    finally:
+        telemetry.disable()
+        eng.close()
+    events = [json.loads(line) for line in open(log)]
+    ring_ev = [e for e in events if e.get("op") == "serve_block"
+               and e.get("devices")]
+    assert ring_ev, "no device-attributed serve_block event"
+    assert ring_ev[0]["extra"]["trace_id"] == pre.trace_id
+    assert ring_ev[0]["devices"][0]["coords"] == [2]
+    kv_ev = [e for e in events if e.get("op") == "kv_page"]
+    assert kv_ev and kv_ev[0]["extra"]["trace_id"] == dec.trace_id
+
+
+# ---------------------------------------------------------------------------
+# Ledger: serve_block measurements + the headline-resume satellite
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_ingests_block_serve_artifact():
+    from ft_sgemm_tpu.perf import ledger
+
+    art = {"metric": "serve_block_goodput_tps", "value": 1200.5,
+           "unit": "tokens/s", "vs_baseline": None,
+           "context": {"serve": True, "smoke": True, "workload": "block",
+                       "goodput_tps": 1200.5, "throughput_tps": 1300.0,
+                       "tokens_correct": 640,
+                       "p50_latency_seconds": 0.2,
+                       "p99_latency_seconds": 0.4,
+                       "kv": {"verify_hit_rate": 0.97}}}
+    entry = ledger.ingest(art, run_id="blk-1")
+    assert entry["kind"] == "serve"
+    m = entry["measurements"]
+    assert m["serve_block.goodput_tps"] == {
+        "value": 1200.5, "higher_is_better": True}
+    assert m["serve_block.kv_verify_hit_rate"]["value"] == 0.97
+    assert m["serve_block.p99_latency_seconds"]["higher_is_better"] \
+        is False
+    # Older/gemm rows stay untouched: no serve_block keys, still render.
+    gemm = ledger.ingest({"metric": "serve_goodput_rps", "value": 3.0,
+                          "unit": "requests/s",
+                          "context": {"serve": True}}, run_id="g-1")
+    assert not any(k.startswith("serve_block.")
+                   for k in gemm["measurements"])
+    text = ledger.format_history([entry, gemm])
+    assert "blk-1" in text and "g-1" in text
+
+
+def test_bench_ledger_fresh_values_identity_strict(tmp_path):
+    sys.path.insert(0, REPO)
+    import bench
+    from ft_sgemm_tpu.perf import ledger
+
+    art = {"metric": "abft_kernel_huge_gflops_4096", "value": 4100.0,
+           "unit": "GFLOPS", "vs_baseline": 1.02,
+           "context": {
+               "platform_used": "tpu", "device_kind": "TPU v4",
+               "xla_dot_gflops": 5000.0,
+               "abft_rowcol_gflops": 3900.0,
+               "run_report": {"manifest": {"git_rev": "abc1234"}}}}
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append(path, ledger.ingest(art, run_id="BENCH_x"))
+    fresh = bench._ledger_fresh_values("abc1234", "tpu", "TPU v4",
+                                       ledger_path=path)
+    assert fresh["ft_headline"]["value"] == 4100.0
+    assert fresh["xla_dot"]["value"] == 5000.0
+    assert fresh["ft_rowcol"]["value"] == 3900.0
+    assert fresh["ft_headline"]["run_id"] == "BENCH_x"
+    # Identity-strict: a different rev, platform, or device kind — or a
+    # serve/smoke row — never seeds a resume.
+    assert bench._ledger_fresh_values("other000", "tpu", "TPU v4",
+                                      ledger_path=path) == {}
+    assert bench._ledger_fresh_values("abc1234", "cpu", "TPU v4",
+                                      ledger_path=path) == {}
+    assert bench._ledger_fresh_values("abc1234", "tpu", "TPU v3",
+                                      ledger_path=path) == {}
+
+
+def test_bench_ledger_resume_stages_wiring(tmp_path, monkeypatch):
+    """The worker-side satellite: fresh ledger rungs seed the records
+    with the NAMED skipped_fresh_in_ledger reason (records + timeline
+    point), and already-done stages are left alone."""
+    sys.path.insert(0, REPO)
+    import bench
+    from ft_sgemm_tpu.perf import ledger
+
+    art = {"metric": "abft_kernel_huge_gflops_4096", "value": 4100.0,
+           "unit": "GFLOPS", "vs_baseline": None,
+           "context": {
+               "platform_used": "tpu", "device_kind": "TPU v4",
+               "xla_dot_gflops": 5000.0, "kernel_sgemm_huge_gflops": 4800.0,
+               "run_report": {"manifest": {"git_rev": "abc1234"}}}}
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.append(path, ledger.ingest(art, run_id="BENCH_x"))
+    monkeypatch.setenv("FT_SGEMM_LEDGER", path)
+    import ft_sgemm_tpu.perf.report as report
+
+    monkeypatch.setattr(report, "_git_rev", lambda *a, **k: "abc1234")
+
+    class Rec:
+        def __init__(self):
+            self.values = {"xla_dot": 5000.0}
+
+        def done(self, name):
+            return name in self.values
+
+        def ok(self, name, value):
+            self.values[name] = value
+
+    class TL:
+        points = []
+
+        def point(self, kind, name, **fields):
+            self.points.append((kind, name, fields))
+
+    rec, tl = Rec(), TL()
+    out = bench._ledger_resume_stages(
+        rec, tl, {"platform_used": "tpu", "device_kind": "TPU v4"})
+    assert sorted(out["stages"]) == ["ft_headline", "plain_huge"]
+    assert rec.values["ft_headline"] == {
+        "gflops": 4100.0, "strategy": "ledger:BENCH_x"}
+    assert rec.values["plain_huge"] == 4800.0
+    assert rec.values["xla_dot"] == 5000.0  # already done: untouched
+    assert rec.values["ledger_resume"]["reason"] \
+        == "skipped_fresh_in_ledger"
+    named = [p for p in tl.points
+             if p[2].get("note") == "skipped_fresh_in_ledger"]
+    assert {p[1] for p in named} == {"ft_headline", "plain_huge"}
+    # No match -> no-op.
+    rec2 = Rec()
+    assert bench._ledger_resume_stages(
+        rec2, TL(), {"platform_used": "cpu",
+                     "device_kind": "cpu"}) is None
+
+
+# ---------------------------------------------------------------------------
+# bench.py --serve --smoke --workload=block (subprocess acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serve_block_smoke_emits_tokens_goodput_artifact(tmp_path):
+    """Acceptance: the block smoke on CPU emits ONE non-null JSON line —
+    tokens-correct-per-second > 0 under nonzero in-flight injection AND
+    stored-page corruption, zero whole-queue retries, zero steady-state
+    compile spans, both KV recovery arms exercised, every completed
+    request verified correct."""
+    tl_path = str(tmp_path / "blk.timeline.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               FT_SGEMM_BENCH_TIMELINE=tl_path,
+               FT_SGEMM_TUNER_CACHE=str(tmp_path / "tuner_cache.json"),
+               FT_SGEMM_COMPILE_CACHE="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke", "--workload=block"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    art = json.loads(line)
+    assert art["metric"] == "serve_block_goodput_tps"
+    assert art["unit"] == "tokens/s"
+    assert art["value"] is not None and art["value"] > 0
+    ctx = art["context"]
+    assert ctx["workload"] == "block"
+    assert ctx["goodput_tps"] > 0 and ctx["tokens_correct"] > 0
+    assert ctx["whole_queue_retries"] == 0
+    assert ctx["uncorrectable_final"] == 0
+    assert ctx["correct"] == ctx["completed"] > 0
+    assert ctx["verified"] is True
+    assert ctx["steady_state_compile_spans"] == 0
+    assert ctx["phases"]["decode"] > 0
+    assert ctx["kv_corruptions_injected"] > 0
+    assert ctx["kv_faults"] > 0
+    assert ctx["kv_corrected_in_place"] + ctx["kv_page_restores"] > 0
+    assert ctx["p50_latency_seconds"] is not None
+    # A kv_page finding joins a decode request by trace_id in the
+    # streamed timeline (the stored-state half of the trace join).
+    records = [json.loads(l) for l in open(tl_path)]
+    kv_traces = {r.get("trace_id") for r in records
+                 if r.get("kind") == "kv_page"}
+    enq_traces = {r.get("trace_id") for r in records
+                  if r.get("kind") == "serve_block"
+                  and r.get("name") == "enqueue"}
+    assert kv_traces & enq_traces, "no kv_page/request trace join"
