@@ -202,6 +202,86 @@ def _load_ledger_mod():
     return _LEDGER_MOD
 
 
+_TREND_MOD = None
+
+
+def _load_trend_mod():
+    """perf/trend.py loaded standalone (stdlib-only by contract, same
+    file-path discipline as the timeline/ledger modules). None when
+    unloadable."""
+    global _TREND_MOD
+    if _TREND_MOD is not None:
+        return _TREND_MOD
+    try:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "ft_sgemm_tpu", "perf", "trend.py")
+        spec = importlib.util.spec_from_file_location("_ft_trend", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _TREND_MOD = mod
+    except Exception:  # noqa: BLE001 — observability must not kill the run
+        _TREND_MOD = None
+    return _TREND_MOD
+
+
+# Flat per-rung wall margin (seconds) used when the ledger holds no
+# history for a rung — the pre-ISSUE-13 behavior, kept as the floor.
+_RUNG_BUDGET_FLOOR = 30.0
+
+
+def _headline_rung_budgets(live, labels, default=_RUNG_BUDGET_FLOOR):
+    """Per-rung wall budgets from the run ledger's per-stage history.
+
+    For each ladder rung, predict its wall as ``mean + 2*std`` of the
+    ``stage[ft_headline[<label>]].seconds`` series on THIS platform
+    (``perf/trend.py::stage_wall_budget``), falling back to the
+    aggregate ``stage[ft_headline].seconds`` series, then to the flat
+    ``default`` — which also FLOORS every prediction, so a freak
+    0.2 s history can never admit a rung into a 1 s remainder. Best
+    effort by construction: no ledger / no history = the historical
+    flat margin.
+    """
+    out = {label: float(default) for label in labels}
+    path = os.environ.get("FT_SGEMM_LEDGER")
+    lmod, tmod = _load_ledger_mod(), _load_trend_mod()
+    if not path or not os.path.exists(path) or lmod is None \
+            or tmod is None:
+        return out
+    try:
+        entries = lmod.dedup_entries(lmod.read_ledger(path))
+        platform = (live.get("device_kind") or live.get("platform_used")
+                    or "?")
+        for label in labels:
+            for stage in (f"ft_headline[{label}]", "ft_headline"):
+                b = tmod.stage_wall_budget(entries, stage, platform)
+                if b is not None:
+                    out[label] = max(float(default), float(b))
+                    break
+    except Exception:  # noqa: BLE001 — budgeting is an accelerant only
+        pass
+    return out
+
+
+def _order_headline_ladder(ladder, rec):
+    """Highest-value-missing-rung-first ordering of the headline ladder.
+
+    The ladder list is already value-ordered (flagship first); rungs a
+    previous attempt (or the ledger resume) already banked under their
+    ``ft_headline[<label>]`` record move to the BACK, preserving value
+    order within each group — so the single highest-value rung still
+    missing always runs first against the warm compile cache, and a
+    banked rung is only consulted as a promotion fallback (ROADMAP
+    item 1: an attempt cannot die null while any rung is measurable or
+    banked).
+    """
+    missing = [r for r in ladder
+               if not rec.done(f"ft_headline[{r[0]}]")]
+    banked = [r for r in ladder if rec.done(f"ft_headline[{r[0]}]")]
+    return missing + banked
+
+
 _LINT_FACTS = False  # False = not yet run; None = unavailable
 
 
@@ -1722,13 +1802,39 @@ def _worker_stages(rec, tl=None):
             ladder.append(("weighted (in-kernel encode fallback, 2 checks)",
                            dict(strategy="weighted", check_every=nk // 2)))
         ladder.append(("rowcol", dict(strategy="rowcol")))
+        # ISSUE 13 satellite (ROADMAP item 1 slice): highest-value-
+        # missing-rung-first — rungs a previous attempt already banked
+        # move behind the still-missing ones (promotion fallback) — and
+        # each rung is budgeted from the ledger's per-stage wall history
+        # instead of the flat 30 s margin, so a rung that history says
+        # cannot finish is SKIPPED (named reason) in favor of a cheaper
+        # one rather than dying mid-measurement.
+        ladder = _order_headline_ladder(ladder, rec)
+        budgets = _headline_rung_budgets(live, [lb for lb, _ in ladder])
         with tl.span("ft_headline", kind="stage") as head_info:
             for label, kwargs in ladder:
-                if left() < 30:
-                    rec.fail("ft_headline",
-                             "skipped: worker deadline reached")
-                    break
                 rung = f"ft_headline[{label}]"
+                if rec.done(rung):
+                    # Banked by an earlier attempt sharing this records
+                    # file: promote without burning wall on re-measuring.
+                    val = rec.values[rung]
+                    if isinstance(val, (int, float)):
+                        rec.ok("ft_headline",
+                               {"gflops": float(val), "strategy": label})
+                        head_info["value"] = {"gflops": float(val),
+                                              "strategy": label,
+                                              "promoted_from": rung}
+                        break
+                    continue
+                need = budgets.get(label, _RUNG_BUDGET_FLOOR)
+                if left() < need:
+                    reason = (f"skipped: predicted ~{need:.0f}s wall"
+                              f" (ledger stage history) exceeds remaining"
+                              f" {left():.0f}s budget")
+                    rec.fail(rung, reason)
+                    tl.point("stage", rung, note="skipped_over_budget",
+                             predicted_seconds=round(need, 1))
+                    continue
 
                 def rung_fn(kwargs=kwargs):
                     # Factory inside the retry scope: a factory-time
@@ -1747,6 +1853,9 @@ def _worker_stages(rec, tl=None):
                         rung_info["value"] = val
                         _merge_phase_split(rung_info)
                 if val is not None:
+                    # Bank the rung ITSELF too: a relaunch resuming this
+                    # records file promotes it instead of re-measuring.
+                    rec.ok(rung, val)
                     rec.ok("ft_headline",
                            {"gflops": val, "strategy": label})
                     head_info["value"] = {"gflops": val, "strategy": label}
@@ -2377,6 +2486,10 @@ def serve_main(argv):
                     int(v) for v in f.split("=", 1)[1].split(",") if v)
             elif f.startswith("--monitor-port="):
                 kw["monitor_port"] = int(f.split("=", 1)[1])
+            elif f.startswith("--epilogue="):
+                # Fused-epilogue bucket set (configs.EpilogueSpec
+                # spelling, e.g. bias+relu) — GEMM workload only.
+                kw["epilogue"] = f.split("=", 1)[1]
         except ValueError as e:
             bad = f"{f}: {e}"
     block = workload == "block"
@@ -2391,6 +2504,8 @@ def serve_main(argv):
             if flag in kw:
                 bad = f"--{flag.replace('_', '-')}= needs" \
                     " --workload=block"
+    elif "epilogue" in kw:
+        bad = "--epilogue= needs --workload=gemm"
     if bad:
         print(json.dumps({"metric": metric, "value": None,
                           "unit": unit, "vs_baseline": None,

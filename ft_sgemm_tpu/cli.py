@@ -42,6 +42,9 @@ Usage:
         [--format=text|json] [--phases]
     python -m ft_sgemm_tpu.cli tune [SIZE | M N K] [--strategy=...] \
         [--encode=vpu|mxu] [--dtype=...] [--threshold=static|adaptive] \
+        [--pipe=N] [--grid-order=mn|nm] \
+        [--dim-semantics=parallel|arbitrary] [--cad=N] \
+        [--epilogue=SPEC] [--axis-tile-top=N] \
         [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
         [--dry-run] [--prewarm]
@@ -52,11 +55,11 @@ Usage:
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
     python -m ft_sgemm_tpu.cli serve [--workload=gemm|block] \
-        [--buckets=256,512] [--dtype=...] \
+        [--buckets=256,512] [--dtype=...] [--epilogue=SPEC] \
         [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] \
         [--monitor-port=N] [--dry-run]
     python -m ft_sgemm_tpu.cli serve-bench [--smoke] \
-        [--workload=gemm|block] [--buckets=...] \
+        [--workload=gemm|block] [--buckets=...] [--epilogue=SPEC] \
         [--requests=N] [--inject-rate=R] [--rate=RPS] \
         [--decode-ratio=R] [--kv-corrupt-rate=R] \
         [--monitor-port=N] [--out=ARTIFACT.json]
@@ -95,7 +98,18 @@ prune and prints the candidate table (no measurement, no cache write —
 runs anywhere, including CPU CI). On a non-TPU backend measurement falls
 back to Pallas interpret mode: the machinery is exercised end to end, and
 the entries land under the CPU device kind (they never serve a TPU).
-``tune-show`` prints the persisted entries.
+``tune-show`` prints the persisted entries (winning variant axes shown
+in ``{...}`` when non-default). ``tune`` searches the JOINT kernel-
+variant space by default — block tile x pipeline depth x grid traversal
+order x dimension semantics x detect/correct cadence — with per-axis
+prune reasons for everything not tried; ``--pipe=N`` /
+``--grid-order=mn|nm`` / ``--dim-semantics=parallel|arbitrary`` /
+``--cad=N`` pin one axis (the cache key then spells the pinned value
+instead of ``auto``), ``--epilogue=SPEC`` tunes for a fused epilogue
+(``bias``, ``relu``/``gelu``, ``qint8``/``qfp8`` quantize-rescale,
+``+``-joined — e.g. ``bias+relu``; the epilogue is workload-owned and
+keys the search, it is never enumerated), and ``--axis-tile-top=N``
+widens how many leading tiles explore the non-default axes.
 
 ``--telemetry=LOG.jsonl`` enables the fault-telemetry subsystem for the
 run (``ft_sgemm_tpu.telemetry``): every FT kernel call appends a
@@ -259,11 +273,15 @@ import numpy as np
 
 from ft_sgemm_tpu.configs import (
     DEFAULT_STRATEGY,
+    DIM_SEMANTICS,
     ENCODE_MODES,
+    GRID_ORDERS,
     IN_DTYPES,
     KERNEL_TABLE,
     PERF_ROW_IDS,
+    PIPELINE_DEPTHS,
     THRESHOLD_MODES,
+    EpilogueSpec,
     canonical_in_dtype,
     kernel_for_id,
 )
@@ -900,8 +918,49 @@ def run_tune(args, flags, out=None) -> int:
     budget = 8
     method = None
     reps, samples = 3, 3
+    variant_kw = {}
     for f in flags:
-        if f.startswith("--strategy="):
+        if f.startswith("--pipe="):
+            try:
+                variant_kw["pipeline_depth"] = int(f.split("=", 1)[1])
+            except ValueError:
+                print(f"--pipe must be an integer from {PIPELINE_DEPTHS},"
+                      f" got {f.split('=', 1)[1]!r}", file=sys.stderr)
+                return 2
+            if variant_kw["pipeline_depth"] not in PIPELINE_DEPTHS:
+                print(f"--pipe must be one of {PIPELINE_DEPTHS}, got"
+                      f" {variant_kw['pipeline_depth']}", file=sys.stderr)
+                return 2
+        elif f.startswith("--grid-order="):
+            variant_kw["grid_order"] = f.split("=", 1)[1]
+            if variant_kw["grid_order"] not in GRID_ORDERS:
+                print(f"--grid-order must be one of {GRID_ORDERS}, got"
+                      f" {variant_kw['grid_order']!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--dim-semantics="):
+            variant_kw["dim_semantics"] = f.split("=", 1)[1]
+            if variant_kw["dim_semantics"] not in DIM_SEMANTICS:
+                print(f"--dim-semantics must be one of {DIM_SEMANTICS},"
+                      f" got {variant_kw['dim_semantics']!r}",
+                      file=sys.stderr)
+                return 2
+        elif f.startswith("--cad="):
+            try:
+                variant_kw["check_every"] = int(f.split("=", 1)[1])
+            except ValueError:
+                print(f"--cad must be a positive integer (K-grid steps),"
+                      f" got {f.split('=', 1)[1]!r}", file=sys.stderr)
+                return 2
+        elif f.startswith("--epilogue="):
+            try:
+                variant_kw["epilogue"] = EpilogueSpec.parse(
+                    f.split("=", 1)[1]).spelling
+            except ValueError as e:
+                print(f"--epilogue: {e}", file=sys.stderr)
+                return 2
+        elif f.startswith("--axis-tile-top="):
+            variant_kw["axis_tile_top"] = int(f.split("=", 1)[1])
+        elif f.startswith("--strategy="):
             strategy = f.split("=", 1)[1]
             if strategy not in STRATEGIES:
                 print(f"--strategy must be one of {STRATEGIES}, got"
@@ -947,14 +1006,27 @@ def run_tune(args, flags, out=None) -> int:
     print_device_info()
 
     def progress(r):
+        v = r.variant
+        tags = []
+        if v is not None and not v.is_default:
+            if v.pipeline_depth != 2:
+                tags.append(f"pipe={v.pipeline_depth}")
+            if v.grid_order != "mn":
+                tags.append(f"grid={v.grid_order}")
+            if v.dim_semantics != "parallel":
+                tags.append(f"sem={v.dim_semantics}")
+            if v.check_every is not None:
+                tags.append(f"cad={v.check_every}")
+        row = (f"{str(tuple(r.block)):>18s}"
+               + (("{" + " ".join(tags) + "}") if tags else ""))
         if r.ok and r.gflops is not None:
-            print(f"  {str(tuple(r.block)):>18s}  {r.gflops:9.1f} GFLOPS"
+            print(f"  {row}  {r.gflops:9.1f} GFLOPS"
                   f"  [{r.method}]", file=out, flush=True)
         elif r.ok:
-            print(f"  {str(tuple(r.block)):>18s}  compiled ok"
+            print(f"  {row}  compiled ok"
                   f"  (grid-step score {r.score:.0f})", file=out, flush=True)
         else:
-            print(f"  {str(tuple(r.block)):>18s}  FAILED: {r.error}",
+            print(f"  {row}  FAILED: {r.error}",
                   file=out, flush=True)
 
     try:
@@ -962,7 +1034,8 @@ def run_tune(args, flags, out=None) -> int:
             m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
             threshold_mode=threshold_mode,
             inject="--inject" in flags, method=method, budget=budget,
-            reps=reps, samples=samples, dry_run=dry_run, progress=progress)
+            reps=reps, samples=samples, dry_run=dry_run, progress=progress,
+            **variant_kw)
     except ValueError as e:
         # Illegal (strategy, encode, dtype, threshold) combination: the
         # kernel factory's message says which constraint and why.
@@ -971,19 +1044,29 @@ def run_tune(args, flags, out=None) -> int:
     strat = report["strategy"]
     print(f"tune {m}x{n}x{k} strategy={strat} encode={report['encode']}"
           f" dtype={in_dtype} thr={report.get('threshold_mode', 'static')}"
+          f" epi={report.get('epilogue', 'none')}"
           f" method={report['method']} key={report['key']}", file=out)
     print(f"candidates: {len(report['feasible'])} feasible,"
           f" {len(report['pruned'])} pruned", file=out)
     if dry_run:
+        # Per-reason prune census first (the joint space prunes whole
+        # axis families — counts read better than 300 rows), then the
+        # VMEM-priced rows.
+        reasons = {}
+        for p in report["pruned"]:
+            head = p["reason"].split(" (")[0].split(" >")[0]
+            reasons[head] = reasons.get(head, 0) + 1
+        for head, count in sorted(reasons.items(),
+                                  key=lambda kv: -kv[1]):
+            print(f"  pruned x{count}: {head}", file=out)
         shown = 0
         for p in report["pruned"]:
             if "VMEM" in p["reason"]:
-                print(f"  pruned {str(tuple(p['block'])):>18s}:"
+                vtag = f" [{p['variant']}]" if p.get("variant") else ""
+                print(f"  pruned {str(tuple(p['block'])):>18s}{vtag}:"
                       f" {p['reason']}", file=out)
                 shown += 1
                 if shown >= 10:
-                    print(f"  ... ({len(report['pruned']) - shown} more"
-                          " pruned)", file=out)
                     break
         print("dry run: nothing measured, nothing written", file=out)
         return 0
@@ -992,10 +1075,26 @@ def run_tune(args, flags, out=None) -> int:
     if best is None:
         print("tune: no candidate measured successfully", file=sys.stderr)
         return 1
-    print(f"heuristic {tuple(heur['block'])}: "
+
+    def vtag(row):
+        v = row.get("variant") or {}
+        tags = []
+        if v.get("pipeline_depth", 2) != 2:
+            tags.append(f"pipe={v['pipeline_depth']}")
+        if v.get("grid_order", "mn") != "mn":
+            tags.append(f"grid={v['grid_order']}")
+        if v.get("dim_semantics", "parallel") != "parallel":
+            tags.append(f"sem={v['dim_semantics']}")
+        if v.get("check_every") is not None:
+            tags.append(f"cad={v['check_every']}")
+        if v.get("epilogue", "none") != "none":
+            tags.append(f"epi={v['epilogue']}")
+        return (" {" + " ".join(tags) + "}") if tags else ""
+
+    print(f"heuristic {tuple(heur['block'])}{vtag(heur)}: "
           + (f"{heur['gflops']:.1f} GFLOPS" if heur and heur.get("gflops")
              else "n/a"), file=out)
-    print(f"best      {tuple(best['block'])}: "
+    print(f"best      {tuple(best['block'])}{vtag(best)}: "
           + (f"{best['gflops']:.1f} GFLOPS" if best.get("gflops")
              else f"score {best['score']:.0f}"), file=out)
     print(f"cache written: {report.get('cache_path')}", file=out)
@@ -1092,7 +1191,22 @@ def run_tune_show(out=None) -> int:
             extra += f"  {gf:9.1f} GFLOPS"
         if gf and hgf:
             extra += f"  (heuristic {hgf:.1f}, x{gf / hgf:.3f})"
-        print(f"  {key}  ->  {tuple(rec['block'])}"
+        vrec = rec.get("variant")
+        vtags = []
+        if isinstance(vrec, dict):
+            # Non-default winning variant axes, compactly (schema 4).
+            if vrec.get("pipeline_depth", 2) != 2:
+                vtags.append(f"pipe={vrec['pipeline_depth']}")
+            if vrec.get("grid_order", "mn") != "mn":
+                vtags.append(f"grid={vrec['grid_order']}")
+            if vrec.get("dim_semantics", "parallel") != "parallel":
+                vtags.append(f"sem={vrec['dim_semantics']}")
+            if vrec.get("check_every") is not None:
+                vtags.append(f"cad={vrec['check_every']}")
+            if vrec.get("epilogue", "none") != "none":
+                vtags.append(f"epi={vrec['epilogue']}")
+        vextra = ("  {" + " ".join(vtags) + "}") if vtags else ""
+        print(f"  {key}  ->  {tuple(rec['block'])}{vextra}"
               f"  [{rec.get('method', '?')}]{extra}", file=out)
     return 0
 
@@ -1270,6 +1384,9 @@ def _parse_serve_flags(flags):
                 kw["in_dtype"] = canonical_in_dtype(f.split("=", 1)[1])
             elif f.startswith("--monitor-port="):
                 kw["monitor_port"] = int(f.split("=", 1)[1])
+            elif f.startswith("--epilogue="):
+                kw["epilogue"] = EpilogueSpec.parse(
+                    f.split("=", 1)[1]).spelling
         except ValueError as e:
             return None, None, f"{f}: {e}"
     if workload != "block":
@@ -1277,6 +1394,8 @@ def _parse_serve_flags(flags):
             if flag in kw:
                 return None, None, (f"--{flag.replace('_', '-')}= needs"
                                     " --workload=block")
+    elif "epilogue" in kw:
+        return None, None, "--epilogue= needs --workload=gemm"
     if sizes is not None:
         kw["seq_sizes" if workload == "block" else "bucket_sizes"] = sizes
     return workload, kw, None
@@ -1311,7 +1430,9 @@ def run_serve(flags, out=None) -> int:
             buckets = default_block_bucket_set(sizes, in_dtype=in_dtype)
         else:
             sizes = kw.pop("bucket_sizes", None) or (256, 512)
-            buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+            buckets = default_bucket_set(
+                sizes, in_dtype=in_dtype,
+                epilogue=kw.get("epilogue", "none"))
     except ValueError as e:
         print(f"ft_sgemm: serve: {e}", file=sys.stderr)
         return 2
@@ -1337,6 +1458,7 @@ def run_serve(flags, out=None) -> int:
             key = tuner.make_key(b.m, b.n, b.k, strategy=b.strategy,
                                  in_dtype=b.in_dtype,
                                  injection_enabled=False,
+                                 epi=b.epilogue,
                                  device="<device>")
             print(f"  bucket {b.key:<36s} variants={','.join(VARIANTS)}"
                   f"  tuner-key {key}", file=out)

@@ -18,9 +18,20 @@ Usage (mirrors main.py / gen.sh):
     python -m ft_sgemm_tpu.codegen.gen <shape> <if_abft> [M N K] [--out=DIR]
     python -m ft_sgemm_tpu.codegen.gen all            # the gen.sh loop
     python -m ft_sgemm_tpu.codegen.gen list           # the param table
+    python -m ft_sgemm_tpu.codegen.gen tuned          # tuner-cache winners
 
-``--dtype=bfloat16`` lowers the bf16 input variants (suffix ``_bfloat16``
-in the artifact name) — an axis the CUDA generator has no analog for.
+``--dtype=`` lowers any member of the kernel family's input-dtype axis
+(``configs.IN_DTYPES`` + the fp8 aliases) — an axis the CUDA generator
+has no analog for. Per-dtype legality routes through
+``configs.check_kernel_legality``: the FT variant runs each dtype's
+``DEFAULT_STRATEGY`` (int8 -> rowcol), and a (shape, dtype) pair the
+family cannot lower is SKIPPED with the named constraint, never a crash.
+
+``tuned`` dumps the lowered artifact for every persisted tuner-cache
+winner (``ft_sgemm_tpu.tuner.cache``) — tile AND variant axes
+(pipeline depth, grid order, dimension semantics, cadence, fused
+epilogue), the way the reference generator emitted its tuned family.
+Artifacts land as ``tuned_<bm>x<bn>x<bk>[_<variant tags>].txt``.
 """
 
 from __future__ import annotations
@@ -31,7 +42,17 @@ import sys
 import jax
 import jax.numpy as jnp
 
-from ft_sgemm_tpu.configs import SHAPES, SHAPE_ORDER
+from ft_sgemm_tpu.configs import (
+    DEFAULT_STRATEGY,
+    IN_DTYPES,
+    SHAPES,
+    SHAPE_ORDER,
+    KernelShape,
+    KernelVariant,
+    canonical_in_dtype,
+    canonical_variant,
+    check_kernel_legality,
+)
 from ft_sgemm_tpu.injection import InjectionSpec
 from ft_sgemm_tpu.ops.common import dtype_suffix
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
@@ -46,14 +67,51 @@ def variant_name(shape_name: str, if_abft: bool,
     return f"{'ft_' if if_abft else ''}sgemm_{shape_name}{dtype_suffix(in_dtype)}"
 
 
-def lower_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
-                  in_dtype: str = "float32"):
-    """Build + lower one kernel variant; returns (jaxpr text, lowered text)."""
+def strategy_for_dtype(in_dtype: str) -> str:
+    """The FT strategy the generator lowers for one dtype — the family's
+    own per-dtype default (``configs.DEFAULT_STRATEGY``: weighted for the
+    float dtypes, rowcol for int8's exact path)."""
+    return DEFAULT_STRATEGY[canonical_in_dtype(in_dtype)]
+
+
+def lower_variant(shape_name, if_abft: bool, m: int, n: int, k: int,
+                  in_dtype: str = "float32",
+                  variant: KernelVariant | None = None,
+                  strategy: str | None = None,
+                  encode: str = "vpu"):
+    """Build + lower one kernel variant; returns (jaxpr text, lowered text).
+
+    ``shape_name`` is a named shape or an explicit
+    :class:`~ft_sgemm_tpu.configs.KernelShape` (the ``tuned`` path);
+    ``variant`` pins the kernel-variant axes (None = defaults);
+    ``strategy`` overrides the per-dtype default FT strategy. Legality
+    routes through ``configs.check_kernel_legality`` — an illegal
+    (strategy, dtype) pair raises the family's own constraint error,
+    which ``main`` renders as a NAMED skip.
+    """
+    in_dtype = canonical_in_dtype(in_dtype)
+    var = canonical_variant(variant)
     if if_abft:
-        kfn = make_ft_sgemm(shape_name, in_dtype=in_dtype)
-        fn = lambda a, b, c: kfn(a, b, c, InjectionSpec.none()).c  # noqa: E731
+        strategy = strategy or strategy_for_dtype(in_dtype)
+        check_kernel_legality(strategy=strategy, encode=encode,
+                              in_dtype=in_dtype)
+        kfn = make_ft_sgemm(shape_name, in_dtype=in_dtype,
+                            strategy=strategy, encode=encode,
+                            variant=variant, tunable=False)
+        if var.epilogue_spec.bias:
+            bias = jnp.zeros((n,), jnp.float32)
+            fn = lambda a, b, c: kfn(  # noqa: E731
+                a, b, c, InjectionSpec.none(), bias=bias).c
+        else:
+            fn = lambda a, b, c: kfn(a, b, c, InjectionSpec.none()).c  # noqa: E731
     else:
-        fn = make_sgemm(shape_name, in_dtype=in_dtype)
+        kfn = make_sgemm(shape_name, in_dtype=in_dtype, variant=variant,
+                         tunable=False)
+        if var.epilogue_spec.bias:
+            bias = jnp.zeros((n,), jnp.float32)
+            fn = lambda a, b, c: kfn(a, b, c, bias=bias)  # noqa: E731
+        else:
+            fn = kfn
     # a/b enter as f32 and are cast inside fn — matches the CLI/user path.
     args = (
         jax.ShapeDtypeStruct((m, k), jnp.float32),
@@ -92,6 +150,91 @@ def dump_variant(shape_name: str, if_abft: bool, m: int, n: int, k: int,
         + "\n\n// ===== lowered (StableHLO) =====\n" + lowered
     )
     return path
+
+
+def _variant_tags(var: KernelVariant) -> str:
+    """Filename tags for a tuned winner's non-default variant axes, e.g.
+    ``_pipe3_nm_cad2_epi_bias_relu`` (empty for the default variant)."""
+    tags = []
+    if var.pipeline_depth != 2:
+        tags.append(f"pipe{var.pipeline_depth}")
+    if var.grid_order != "mn":
+        tags.append(var.grid_order)
+    if var.dim_semantics != "parallel":
+        tags.append(var.dim_semantics[:3])
+    if var.check_every is not None:
+        tags.append(f"cad{var.check_every}")
+    if var.epilogue != "none":
+        tags.append("epi_" + var.epilogue.replace("+", "_"))
+    return ("_" + "_".join(tags)) if tags else ""
+
+
+def dump_tuned(out_dir: pathlib.Path, cache_path=None, out=None):
+    """Dump the lowered artifact for every tuner-cache winner.
+
+    Iterates the persisted schema-4 entries (``tuner.cache``), rebuilds
+    each winner as an explicit tile + :class:`KernelVariant`, and lowers
+    the FT kernel it would dispatch — the generator's answer to "show me
+    the code the TUNED family runs", not just the shipped SHAPES table.
+    Entries whose key axes this build cannot lower (foreign device
+    kinds are fine — lowering is device-independent — but e.g. a stale
+    illegal combo) are skipped with the named reason. Returns the list
+    of written paths.
+    """
+    from ft_sgemm_tpu.tuner import cache as tuner_cache
+
+    out = sys.stdout if out is None else out
+    entries = tuner_cache.load_entries(cache_path)
+    written = []
+    if not entries:
+        print("no tuner-cache entries"
+              f" ({cache_path or tuner_cache.cache_path()})", file=out)
+        return written
+    for key, rec in sorted(entries.items()):
+        parts = dict(
+            p.split("=", 1) for p in key.split("|") if "=" in p)
+        fields = key.split("|")
+        in_dtype = fields[2] if len(fields) > 2 else "float32"
+        strategy = fields[3] if len(fields) > 3 else "weighted"
+        bm, bn, bk = rec["block"]
+        problem = rec.get("problem") or [bm, bn, bk]
+        try:
+            var = canonical_variant(rec.get("variant"))
+            tile = KernelShape(f"tuned_{bm}x{bn}x{bk}", bm, bn, bk,
+                               (0,) * 7)
+            if_abft = strategy != "plain"
+            jaxpr, lowered = lower_variant(
+                tile, if_abft, *problem, in_dtype=in_dtype, variant=var,
+                strategy=(None if not if_abft else strategy),
+                encode=parts.get("enc", "vpu"))
+        except (ValueError, KeyError) as e:
+            print(f"skip {key}: {e}", file=out)
+            continue
+        out_dir.mkdir(parents=True, exist_ok=True)
+        name = (f"tuned_{bm}x{bn}x{bk}{_variant_tags(var)}"
+                f"{dtype_suffix(in_dtype)}"
+                + ("" if strategy == "plain" else f"_{strategy}"))
+        path = out_dir / f"{name}.txt"
+        header = (
+            f"// {name}: TUNED Pallas kernel variant\n"
+            f"// cache key: {key}\n"
+            f"// problem (M,N,K)={tuple(problem)}"
+            f"  block tile (bm,bn,bk)=({bm},{bn},{bk})\n"
+            f"// variant: pipe={var.pipeline_depth}"
+            f" grid={var.grid_spelling} cad={var.cadence_spelling}"
+            f" epi={var.epilogue}"
+            f"  (key constraint: pipe={parts.get('pipe', 'auto')}"
+            f" grid={parts.get('grid', 'auto')})\n"
+            f"// in_dtype={in_dtype}  backend={jax.default_backend()}\n"
+        )
+        path.write_text(
+            header
+            + "\n// ===== jaxpr =====\n" + jaxpr
+            + "\n\n// ===== lowered (StableHLO) =====\n" + lowered
+        )
+        written.append(path)
+        print(f"wrote {path}", file=out)
+    return written
 
 
 def print_table(out=sys.stdout):
@@ -134,9 +277,11 @@ def main(argv=None) -> int:
             out_dir = pathlib.Path(tok.split("=", 1)[1])
         elif tok.startswith("--dtype="):
             in_dtype = tok.split("=", 1)[1]
-            if in_dtype not in ("float32", "bfloat16"):
-                print(f"--dtype must be float32 or bfloat16, got {in_dtype!r}",
-                      file=sys.stderr)
+            try:
+                in_dtype = canonical_in_dtype(in_dtype)
+            except ValueError:
+                print(f"--dtype must be one of {IN_DTYPES} (or an fp8"
+                      f" alias), got {in_dtype!r}", file=sys.stderr)
                 return 2
         elif tok.startswith("--"):
             print(f"unknown flag {tok!r} (--out=DIR, --dtype=DTYPE)",
@@ -151,12 +296,28 @@ def main(argv=None) -> int:
         if args[0] == "list":
             print_table()
             return 0
+        if args[0] == "tuned":
+            if len(args) > 1:
+                print(f"tuned takes no positional arguments, got"
+                      f" {args[1:]}", file=sys.stderr)
+                return 2
+            dump_tuned(out_dir)
+            return 0
         if args[0] == "all":
             m, n, k = _parse_mnk(args[1:], "all")
             for if_abft in (False, True):  # gen.sh order: plain 6, then ft 6
                 for name in SHAPE_ORDER:
-                    path = dump_variant(name, if_abft, m, n, k, out_dir,
-                                        in_dtype)
+                    try:
+                        path = dump_variant(name, if_abft, m, n, k,
+                                            out_dir, in_dtype)
+                    except ValueError as e:
+                        # Named skip, never a crash: the kernel family's
+                        # own legality constraint says WHY this (shape,
+                        # dtype) row cannot lower (the tuner's
+                        # prune-reason discipline).
+                        print(f"skip {variant_name(name, if_abft, in_dtype)}:"
+                              f" {e}")
+                        continue
                     print(f"wrote {path}")
             return 0
         shape_name = args[0]

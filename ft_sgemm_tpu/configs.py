@@ -140,6 +140,271 @@ THRESHOLD_MODES = ("static", "auto", "adaptive")
 # (exact — integer checksum residuals are identically zero on clean runs).
 IN_DTYPES = ("float32", "bfloat16", "float8_e4m3fn", "int8")
 
+# --- searched kernel-variant axes (DESIGN.md §16) ------------------------
+#
+# The tuner searches more than the block tile: these tuples declare the
+# pipeline/grid/epilogue axes of the full kernel variant descriptor
+# (:class:`KernelVariant`). Each is mirrored by ``contracts.VARIANT_AXES``
+# (the lint axis-drift pass cross-checks the two spellings) and appears in
+# the tuner cache key (``pipe=``/``grid=``/``cad=``/``epi=``, schema 4),
+# the telemetry label schema, and the CLI flag spellings.
+#
+# ``PIPELINE_DEPTHS``: K panels the Pallas pipeline holds per operand
+# stream. 2 is Mosaic's automatic double buffer (one (bm, bk) window, two
+# buffers — the historical assumption ops/vmem priced as "2x block
+# bytes"). 3 deepens the prefetch horizon by widening each buffered
+# window to TWO K panels (the kernel body unrolls two sub-panel dots per
+# grid step); Mosaic double-buffers the wider window, so 4 panels are
+# resident and the footprint model prices exactly that
+# (``estimate_vmem_bytes(pipeline_depth=...)``). When a native Mosaic
+# buffer-count knob lands, the realization can swap without changing the
+# axis contract.
+PIPELINE_DEPTHS = (2, 3)
+
+# ``GRID_ORDERS``: traversal order of the two PARALLEL grid dims — "mn"
+# (M-major, the historical order) or "nm" (N-major). K-major traversal is
+# NOT a legal member: every kernel in the family accumulates in the
+# resident output block across the K sweep (ops/sgemm.py's rationale), so
+# K must stay the innermost grid dim; the legal orders permute only the
+# output-tile walk (which changes HBM streaming locality: "mn" re-reads B
+# panels per row of output tiles, "nm" re-reads A panels per column).
+GRID_ORDERS = ("mn", "nm")
+
+# ``DIM_SEMANTICS``: the Mosaic dimension semantics of the two output
+# grid dims ("parallel" lets the compiler partition them across cores;
+# "arbitrary" forces sequential execution — occasionally a win when the
+# parallel partition fragments VMEM). The K dim is always "arbitrary"
+# (it carries the accumulation dependency) and is not part of the axis.
+DIM_SEMANTICS = ("parallel", "arbitrary")
+
+# Fused-epilogue axes: the detect-correct epilogue of every kernel can
+# fuse a bias add, an activation, and an int8/fp8 quantize-rescale —
+# applied strictly AFTER correction, so the ABFT checksums verify the
+# pre-epilogue accumulator (DESIGN.md §16; oracle-pinned in
+# tests/test_variants.py). Quantized outputs stay in f32 storage carrying
+# exactly representable target-grid values (round+clamp for int8, an
+# fp8 cast round-trip for fp8_e4m3fn): the serving layer's egress cast is
+# then value-exact, and the kernel's f32 output block / C aliasing is
+# untouched.
+EPILOGUE_ACTIVATIONS = ("none", "relu", "gelu")
+EPILOGUE_QUANTIZE = ("none", "int8", "float8_e4m3fn")
+
+# Spelling tokens for the quantize modes in the compact epilogue spelling
+# (EpilogueSpec.spelling / .parse): "qint8" / "qfp8".
+_EPI_QUANT_TOKENS = {"int8": "qint8", "float8_e4m3fn": "qfp8"}
+
+
+@dataclasses.dataclass(frozen=True)
+class EpilogueSpec:
+    """A fused-epilogue request: what the kernel applies to the corrected
+    ``alpha*acc + beta*C`` tile before writing it back.
+
+    ``bias`` adds a per-output-column bias row; ``activation`` is one of
+    :data:`EPILOGUE_ACTIVATIONS`; ``quantize`` one of
+    :data:`EPILOGUE_QUANTIZE` with ``scale`` the quantize-rescale
+    multiplier (output = round/clamp of ``x * scale`` onto the target
+    grid, in f32 storage). Order of application: bias -> activation ->
+    quantize — the standard serving epilogue shape.
+
+    The canonical compact spelling (:meth:`spelling` / :meth:`parse`) is
+    what rides the tuner cache key (``epi=``), telemetry extras, bucket
+    keys, and CLI flags: ``"none"`` for the identity, else ``+``-joined
+    tokens, e.g. ``"bias+relu"``, ``"bias+gelu+qint8"``,
+    ``"qfp8x0.5"`` (a non-unit scale is appended as ``x<scale>``).
+    """
+
+    bias: bool = False
+    activation: str = "none"
+    quantize: str = "none"
+    scale: float = 1.0
+
+    def __post_init__(self):
+        if self.activation not in EPILOGUE_ACTIVATIONS:
+            raise ValueError(
+                f"EpilogueSpec.activation={self.activation!r} must be one"
+                f" of {EPILOGUE_ACTIVATIONS}")
+        if self.quantize not in EPILOGUE_QUANTIZE:
+            raise ValueError(
+                f"EpilogueSpec.quantize={self.quantize!r} must be one of"
+                f" {EPILOGUE_QUANTIZE}")
+        if self.scale != 1.0 and self.quantize == "none":
+            raise ValueError(
+                "EpilogueSpec.scale is the quantize-rescale multiplier;"
+                " set quantize to use it")
+        if not self.scale > 0.0:
+            raise ValueError(
+                f"EpilogueSpec.scale={self.scale!r} must be positive")
+
+    @property
+    def is_identity(self) -> bool:
+        return (not self.bias and self.activation == "none"
+                and self.quantize == "none")
+
+    @property
+    def spelling(self) -> str:
+        if self.is_identity:
+            return "none"
+        parts = []
+        if self.bias:
+            parts.append("bias")
+        if self.activation != "none":
+            parts.append(self.activation)
+        if self.quantize != "none":
+            tok = _EPI_QUANT_TOKENS[self.quantize]
+            if self.scale != 1.0:
+                tok += f"x{self.scale:g}"
+            parts.append(tok)
+        return "+".join(parts)
+
+    @classmethod
+    def parse(cls, spec) -> "EpilogueSpec":
+        """An :class:`EpilogueSpec` from a spelling (or pass one through).
+
+        Accepts ``None`` / ``"none"`` (identity) and ``+``-joined tokens
+        (see :meth:`spelling`); raises a ValueError naming the legal
+        tokens for anything else — one parser for the CLI, the tuner key,
+        and the serve bucket field.
+        """
+        if spec is None:
+            return cls()
+        if isinstance(spec, cls):
+            return spec
+        if not isinstance(spec, str):
+            raise ValueError(
+                f"epilogue must be an EpilogueSpec or a spelling string,"
+                f" got {spec!r}")
+        s = spec.strip().lower()
+        if s in ("", "none"):
+            return cls()
+        bias = False
+        activation = "none"
+        quantize = "none"
+        scale = 1.0
+        quant_by_token = {v: k for k, v in _EPI_QUANT_TOKENS.items()}
+        for tok in s.split("+"):
+            if tok == "bias":
+                bias = True
+            elif tok in EPILOGUE_ACTIVATIONS and tok != "none":
+                activation = tok
+            else:
+                base, _, sc = tok.partition("x")
+                if base in quant_by_token:
+                    quantize = quant_by_token[base]
+                    if sc:
+                        try:
+                            scale = float(sc)
+                        except ValueError:
+                            raise ValueError(
+                                f"epilogue quantize scale {sc!r} in"
+                                f" {spec!r} is not a number") from None
+                else:
+                    raise ValueError(
+                        f"unknown epilogue token {tok!r} in {spec!r};"
+                        " legal tokens: bias, "
+                        + ", ".join(a for a in EPILOGUE_ACTIVATIONS
+                                    if a != "none")
+                        + ", " + ", ".join(sorted(quant_by_token))
+                        + " (optionally qint8x<scale>)")
+        return cls(bias=bias, activation=activation, quantize=quantize,
+                   scale=scale)
+
+
+DEFAULT_EPILOGUE = EpilogueSpec()
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelVariant:
+    """The full kernel variant descriptor the tuner searches end to end.
+
+    Everything beyond the block tile that parameterizes a kernel's
+    lowering: the pipeline depth (:data:`PIPELINE_DEPTHS`), the parallel-
+    dim traversal order (:data:`GRID_ORDERS`), the Mosaic dimension
+    semantics of the output dims (:data:`DIM_SEMANTICS`), the
+    detect/correct cadence (``check_every`` in K-grid steps; ``None`` =
+    the strategy's default — the reference's ~K/20 rule for rowcol/
+    global, a single deferred final check for weighted/fused), and the
+    fused epilogue (an :class:`EpilogueSpec` SPELLING, kept as a string
+    so the descriptor stays hashable/jit-static).
+
+    ``KernelVariant()`` is the exact historical behavior: dispatching
+    with it (or with ``variant=None``) emits byte-identical HLO to the
+    pre-variant build (pinned in tests/test_variants.py).
+    """
+
+    pipeline_depth: int = 2
+    grid_order: str = "mn"
+    dim_semantics: str = "parallel"
+    check_every: Optional[int] = None
+    epilogue: str = "none"
+
+    def __post_init__(self):
+        if self.pipeline_depth not in PIPELINE_DEPTHS:
+            raise ValueError(
+                f"KernelVariant.pipeline_depth={self.pipeline_depth!r}"
+                f" must be one of {PIPELINE_DEPTHS}")
+        if self.grid_order not in GRID_ORDERS:
+            raise ValueError(
+                f"KernelVariant.grid_order={self.grid_order!r} must be"
+                f" one of {GRID_ORDERS}")
+        if self.dim_semantics not in DIM_SEMANTICS:
+            raise ValueError(
+                f"KernelVariant.dim_semantics={self.dim_semantics!r}"
+                f" must be one of {DIM_SEMANTICS}")
+        if self.check_every is not None and (
+                not isinstance(self.check_every, int)
+                or self.check_every < 1):
+            raise ValueError(
+                f"KernelVariant.check_every={self.check_every!r} must be"
+                " a positive int (K-grid steps) or None for the"
+                " strategy default")
+        # Canonicalize the epilogue spelling through the one parser so
+        # "Bias+ReLU" and "bias+relu" key identically everywhere.
+        object.__setattr__(
+            self, "epilogue", EpilogueSpec.parse(self.epilogue).spelling)
+
+    @property
+    def is_default(self) -> bool:
+        return self == KernelVariant()
+
+    @property
+    def epilogue_spec(self) -> EpilogueSpec:
+        return EpilogueSpec.parse(self.epilogue)
+
+    @property
+    def grid_spelling(self) -> str:
+        """The combined ``grid=`` cache-key component:
+        ``<order>.<semantics>`` (e.g. ``mn.parallel``)."""
+        return f"{self.grid_order}.{self.dim_semantics}"
+
+    @property
+    def cadence_spelling(self) -> str:
+        """The ``cad=`` cache-key component: ``auto`` (strategy default)
+        or the explicit K-grid-step cadence."""
+        return "auto" if self.check_every is None else str(self.check_every)
+
+
+DEFAULT_VARIANT = KernelVariant()
+
+
+def canonical_variant(variant) -> KernelVariant:
+    """A :class:`KernelVariant` from None (the default), a variant, or a
+    dict of its fields (the tuner-cache record form)."""
+    if variant is None:
+        return DEFAULT_VARIANT
+    if isinstance(variant, KernelVariant):
+        return variant
+    if isinstance(variant, dict):
+        fields = {f.name for f in dataclasses.fields(KernelVariant)}
+        extra = set(variant) - fields
+        if extra:
+            raise ValueError(
+                f"unknown KernelVariant fields {sorted(extra)};"
+                f" legal: {sorted(fields)}")
+        return KernelVariant(**variant)
+    raise ValueError(
+        f"variant must be a KernelVariant, a field dict, or None,"
+        f" got {variant!r}")
+
 # Accepted spellings for the fp8 dtype (jax's canonical name is the
 # e4m3fn variant; papers and CLI flags commonly drop the suffix).
 _IN_DTYPE_ALIASES = {

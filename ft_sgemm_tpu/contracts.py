@@ -84,6 +84,34 @@ BLOCK_PHASES = ("prefill", "decode")
 # documents the layout).
 KV_PAGE_CHECKSUM_ROWS = 2
 
+# --- searched kernel-variant axes --------------------------------------
+#
+# The variant axes the tuner searches beyond the block tile (PR 13):
+# pipeline depth, grid traversal order, Mosaic dimension semantics of the
+# output dims, and the fused-epilogue activation/quantize families. Each
+# tuple here MIRRORS the runtime declaration in ``configs.py``
+# (PIPELINE_DEPTHS / GRID_ORDERS / DIM_SEMANTICS / EPILOGUE_ACTIVATIONS /
+# EPILOGUE_QUANTIZE) — the same import-free mirror discipline as
+# BLOCK_PHASES; the lint axis-drift pass cross-checks the two spellings,
+# the tuner-key components, the telemetry label schema, and the CLI flag
+# spellings against this table. The detect/correct cadence axis has no
+# closed value set (any positive K-grid-step count, or the strategy
+# default) so it appears only in the key-marker list below.
+VARIANT_AXES = {
+    "pipeline_depth": (2, 3),
+    "grid_order": ("mn", "nm"),
+    "dim_semantics": ("parallel", "arbitrary"),
+    "epilogue_activation": ("none", "relu", "gelu"),
+    "epilogue_quantize": ("none", "int8", "float8_e4m3fn"),
+}
+
+# The f-string markers the tuner cache key (schema 4) must carry for the
+# variant axes — cross-checked against ``tuner/cache.py::make_key`` by
+# the lint axis-drift pass exactly like the historical ``enc=``/``thr=``/
+# ``inj=`` components. ``cad=`` is the detect/correct cadence, ``epi=``
+# the epilogue spelling.
+TUNER_VARIANT_KEY_MARKERS = ("pipe=", "grid=", "cad=", "epi=")
+
 # --- kernel-axis declaration sources -----------------------------------
 #
 # The six places the kernel axes (strategy x encode x dtype x threshold

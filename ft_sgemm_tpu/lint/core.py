@@ -273,6 +273,9 @@ class Declarations:
         self.axis_sources = tuple(
             contracts.get("AXIS_DECLARATION_SOURCES", ()))
         self.block_phases = tuple(contracts.get("BLOCK_PHASES", ()))
+        self.variant_axes = dict(contracts.get("VARIANT_AXES", {}))
+        self.variant_key_markers = tuple(
+            contracts.get("TUNER_VARIANT_KEY_MARKERS", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -282,6 +285,17 @@ class Declarations:
         self.strategy_legality = dict(configs.get("STRATEGY_LEGALITY", {}))
         self.encode_legality = dict(configs.get("ENCODE_LEGALITY", {}))
         self.default_strategy = dict(configs.get("DEFAULT_STRATEGY", {}))
+        # Searched kernel-variant axes (PR 13): the runtime spellings the
+        # contracts.VARIANT_AXES mirror is checked against.
+        self.configs_variant_axes = {
+            "pipeline_depth": tuple(configs.get("PIPELINE_DEPTHS", ())),
+            "grid_order": tuple(configs.get("GRID_ORDERS", ())),
+            "dim_semantics": tuple(configs.get("DIM_SEMANTICS", ())),
+            "epilogue_activation": tuple(
+                configs.get("EPILOGUE_ACTIVATIONS", ())),
+            "epilogue_quantize": tuple(
+                configs.get("EPILOGUE_QUANTIZE", ())),
+        }
 
         self.vmem_variants = tuple(vmem.get("TEMP_TILE_FACTORS", {}))
         self.vmem_smem = tuple(vmem.get("_SMEM_SCRATCH_BYTES", {}))
@@ -468,11 +482,15 @@ def check_import_graph(repo: Repo, decls: Declarations):
 # --- pass 2: axis-drift -------------------------------------------------
 
 # Variable / keyword names whose string values ARE axis values.
+# grid_order / dim_semantics joined with the variant axes (PR 13);
+# "auto" is the tuner-key spelling for an unconstrained axis.
 AXIS_VAR_SETS = {
     "strategy": "strategies",
     "encode": "encode_modes",
     "threshold_mode": "threshold_modes",
     "in_dtype": "dtypes",
+    "grid_order": "grid_orders",
+    "dim_semantics": "dim_semantics",
 }
 
 
@@ -533,7 +551,8 @@ def _cli_doc_axes(doc: str):
 
     for lineno, line in enumerate(doc.splitlines(), 2):
         for m in re.finditer(
-                r"--(strategy|encode|threshold|dtype)=([A-Za-z0-9_.|]+)",
+                r"--(strategy|encode|threshold|dtype|grid-order"
+                r"|dim-semantics)=([A-Za-z0-9_.|]+)",
                 line):
             flag = m.group(1)
             for token in m.group(2).split("|"):
@@ -615,19 +634,35 @@ def check_axis_drift(repo: Repo, decls: Declarations):
     else:
         frags: List[str] = []
         strs: List[str] = []
-        for node in ast.walk(make_key):
-            frags.extend(fstring_fragments(node))
-            s = str_const(node)
-            if s is not None:
-                strs.append(s)
+        stmts = list(make_key.body)
+        if stmts and isinstance(stmts[0], ast.Expr) \
+                and str_const(stmts[0].value) is not None:
+            # The docstring DESCRIBES the key components; it must never
+            # satisfy the marker check in place of the key template
+            # itself (a removed f-string component would otherwise hide
+            # behind its own documentation).
+            stmts = stmts[1:]
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                frags.extend(fstring_fragments(node))
+                s = str_const(node)
+                if s is not None:
+                    strs.append(s)
         blob = "|".join(frags)
+        variant_markers = tuple(
+            (mk, mk.rstrip("=")) for mk in decls.variant_key_markers)
         for marker, axis in (("enc=", "encode"), ("thr=", "threshold"),
-                             ("inj=", "injection")):
+                             ("inj=", "injection"), *variant_markers):
             if marker not in blob:
                 f(TUNER_CACHE_PATH, make_key.lineno, "make_key",
                   f"cache key is missing the {axis} component"
                   f" ({marker!r} not in the key template) — two {axis}"
                   " modes' winners would silently collide")
+        if not decls.variant_key_markers:
+            f(CONTRACTS_PATH, 1, "TUNER_VARIANT_KEY_MARKERS",
+              "variant-axis key markers missing from contracts (the"
+              " schema-4 pipe=/grid=/cad=/epi= components must be"
+              " declared so this pass can cross-check make_key)")
         for s in strs:
             if s in ("plain",) or s in strategies or s in encodes:
                 continue
@@ -639,12 +674,56 @@ def check_axis_drift(repo: Repo, decls: Declarations):
             f(TUNER_CACHE_PATH, 1, "SCHEMA_VERSION",
               "tuner cache SCHEMA_VERSION missing or non-literal")
 
+    # (3b) the kernel-variant axes (PR 13): contracts.VARIANT_AXES must
+    # MIRROR the configs declarations exactly — one spelling, declared
+    # twice on purpose (runtime + import-free), drift is a finding both
+    # ways; and the vmem footprint model must actually price the
+    # pipeline axis.
+    if not decls.variant_axes:
+        f(CONTRACTS_PATH, 1, "VARIANT_AXES",
+          "kernel-variant axis declarations missing from contracts")
+    for axis, cfg_values in decls.configs_variant_axes.items():
+        want = tuple(decls.variant_axes.get(axis, ()))
+        if not cfg_values:
+            f(CONFIGS_PATH, 1, axis,
+              f"configs declaration for variant axis {axis!r} missing"
+              " or non-literal")
+        elif decls.variant_axes and cfg_values != want:
+            f(CONTRACTS_PATH, 1, f"VARIANT_AXES[{axis}]",
+              f"contracts mirror {want} != configs declaration"
+              f" {cfg_values}")
+    extra_axes = set(decls.variant_axes) - set(decls.configs_variant_axes)
+    if extra_axes:
+        f(CONTRACTS_PATH, 1, "VARIANT_AXES",
+          f"contracts declares variant axes {sorted(extra_axes)} that"
+          " have no configs counterpart")
+    vtree = repo.tree(VMEM_PATH)
+    if vtree is not None:
+        vnames = {n.id for n in ast.walk(vtree)
+                  if isinstance(n, ast.Name)}
+        vnames |= {n.arg for n in ast.walk(vtree)
+                   if isinstance(n, ast.arg)}
+        if "pipeline_depth" not in vnames:
+            f(VMEM_PATH, 1, "pipeline_depth",
+              "the VMEM footprint model no longer prices the pipeline"
+              " axis (no 'pipeline_depth' parameter) — depth-3 windows"
+              " would reach Mosaic unbudgeted")
+
     # (4) telemetry label schema mirrors configs (and, for the
     # block-serving phase axis, contracts.BLOCK_PHASES).
     mirror = {"strategy": decls.strategies, "encode": decls.encode_modes,
               "threshold_mode": decls.threshold_modes}
     if decls.block_phases:
         mirror["block_phase"] = decls.block_phases
+    # The closed variant axes carry telemetry label sets too (the
+    # composite epilogue SPELLING rides event extras; its per-axis value
+    # sets are what the label schema enumerates). pipeline_depth is
+    # integer-valued and deliberately not a label axis.
+    for axis in ("grid_order", "dim_semantics", "epilogue_activation",
+                 "epilogue_quantize"):
+        values = decls.configs_variant_axes.get(axis)
+        if values:
+            mirror[axis] = values
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -672,12 +751,16 @@ def check_axis_drift(repo: Repo, decls: Declarations):
     if cli_tree is not None:
         doc = ast.get_docstring(cli_tree) or ""
         alias_ok = dtypes | set(decls.dtype_aliases)
+        grid_orders = set(decls.configs_variant_axes.get("grid_order", ()))
+        dim_sems = set(decls.configs_variant_axes.get("dim_semantics", ()))
         for flag, token, line in _cli_doc_axes(doc):
             ok = {
                 "strategy": lambda t: t in strategies,
                 "encode": lambda t: t in encodes,
                 "threshold": lambda t: t in thresholds or t == "FLOAT",
                 "dtype": lambda t: t in alias_ok,
+                "grid-order": lambda t: t in grid_orders,
+                "dim-semantics": lambda t: t in dim_sems,
             }[flag](token)
             if not ok:
                 f(CLI_PATH, line, f"--{flag}={token}",
@@ -692,7 +775,15 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                      | set(decls.vmem_variants),
                      "encode": encodes,
                      "threshold_mode": thresholds,
-                     "in_dtype": dtypes | set(decls.dtype_aliases)}
+                     "in_dtype": dtypes | set(decls.dtype_aliases),
+                     # "auto" is the unconstrained tuner-key spelling of
+                     # every searched variant axis.
+                     "grid_order": set(
+                         decls.configs_variant_axes.get("grid_order", ()))
+                     | {"auto"},
+                     "dim_semantics": set(
+                         decls.configs_variant_axes.get(
+                             "dim_semantics", ())) | {"auto"}}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

@@ -371,3 +371,131 @@ def pad_to(x: jax.Array, row_mult: int, col_mult: int) -> jax.Array:
     if pr or pc:
         x = jnp.pad(x, ((0, pr), (0, pc)))
     return x
+
+
+def grid_and_maps(grid_order: str, gm: int, gn: int, nk: int):
+    """The Pallas grid tuple + BlockSpec index maps for one traversal
+    order (``configs.GRID_ORDERS``).
+
+    Returns ``(grid, a_map, b_map, c_map, row_map)`` where ``c_map`` also
+    serves the output/expected-checksum windows and ``row_map`` the
+    ``(8, bn)`` row operands (fused bias, precomputed expectations' pad
+    rows use ``c_map``). ``"mn"`` is the historical M-major walk —
+    byte-identical lowering; ``"nm"`` permutes the two PARALLEL dims
+    only (K stays innermost: the whole family accumulates in the
+    resident output block, so K-major traversal is illegal by design —
+    the "where legal" clause of the grid-order axis).
+    """
+    if grid_order == "nm":
+        return ((gn, gm, nk),
+                lambda j, i, kk: (i, kk),
+                lambda j, i, kk: (j, kk),
+                lambda j, i, kk: (i, j),
+                lambda j, i, kk: (0, j))
+    return ((gm, gn, nk),
+            lambda i, j, kk: (i, kk),
+            lambda i, j, kk: (j, kk),
+            lambda i, j, kk: (i, j),
+            lambda i, j, kk: (0, j))
+
+
+def grid_ij(swap_ij: bool):
+    """The (output-row-tile, output-col-tile) program ids under one grid
+    order — kernel bodies index their SMEM counter cells and the inject
+    ordinal with these, so the traversal permutation never changes WHERE
+    a tile's counters land."""
+    from jax.experimental import pallas as pl
+
+    if swap_ij:
+        return pl.program_id(1), pl.program_id(0)
+    return pl.program_id(0), pl.program_id(1)
+
+
+def sub_panels(a_blk, b_blk, unroll: int):
+    """Split one K window into ``unroll`` sub-panel operand pairs.
+
+    ``pipeline_depth`` d > 2 widens each buffered window to ``d - 1`` K
+    panels (configs.PIPELINE_DEPTHS); the kernel body then runs one MXU
+    dot per sub-panel so the dot granularity — and the compute the
+    pipeline can overlap against the wider prefetch — matches the
+    declared panel size. ``unroll == 1`` returns the window untouched
+    (the byte-identical default path)."""
+    if unroll <= 1:
+        return [(a_blk, b_blk)]
+    sub = a_blk.shape[1] // unroll
+    return [(a_blk[:, s * sub:(s + 1) * sub],
+             b_blk[:, s * sub:(s + 1) * sub]) for s in range(unroll)]
+
+
+def attach_bias(kernel, n_in: int):
+    """Adapter routing the fused-bias operand to a keyword.
+
+    Pallas passes refs positionally (inputs, outputs, scratch); the bias
+    rides as the LAST input operand so the kernel bodies' positional
+    signatures stay stable across epilogue configurations — this
+    re-routes input ref ``n_in - 1`` to the ``bias_ref`` keyword every
+    body accepts."""
+    def wrapped(*refs):
+        return kernel(*refs[:n_in - 1], *refs[n_in:],
+                      bias_ref=refs[n_in - 1])
+    return wrapped
+
+
+def pad_bias(bias, n: int, bn: int):
+    """The (8, N-padded) f32 fused-bias operand: row 0 carries the bias
+    (rows 1-7 are sublane padding so the window blocks legally at
+    (8, bn)); validated against the TRUE output width before padding."""
+    b = jnp.asarray(bias, jnp.float32).reshape(-1)
+    if b.shape[0] != n:
+        raise ValueError(
+            f"fused bias must have length N={n}, got {b.shape[0]}")
+    return pad_to(b[None, :], 8, bn)
+
+
+def epilogue_bias_row(bias_ref):
+    """The (1, bn) bias slice of the padded (8, bn) bias window (row 0
+    carries the bias; rows 1-7 are sublane padding), or None."""
+    return None if bias_ref is None else bias_ref[0:1, :]
+
+
+def apply_epilogue(x, epi, bias_row=None):
+    """The fused epilogue, applied to one corrected output tile in-kernel.
+
+    ``x`` is the post-detect/correct, post-``alpha/beta`` f32 tile;
+    ``epi`` an :class:`~ft_sgemm_tpu.configs.EpilogueSpec` (or None);
+    ``bias_row`` a ``(1, bn)``-broadcastable f32 bias slice (required
+    when ``epi.bias``). ONE implementation for every kernel body — and,
+    via the jnp/np module symmetry of its ops, for the host oracle twin
+    (:func:`ft_sgemm_tpu.ops.reference.epilogue_reference`) — so the
+    fused and reference epilogue numerics can never drift.
+
+    Identity specs return ``x`` unchanged (the same traced value: default
+    dispatch stays byte-identical HLO). Application order is
+    bias -> activation -> quantize; quantized values stay in f32 storage
+    on the exact target grid (round+clamp for int8, an fp8_e4m3 cast
+    round-trip for fp8), so the caller's egress cast is value-exact.
+
+    ABFT ordering contract (DESIGN.md §16): this runs strictly AFTER the
+    detect/correct pass of the same grid step — checksums verify the
+    pre-epilogue accumulator, and a nonlinear epilogue never launders a
+    miscorrection past the residual re-check.
+    """
+    if epi is None or epi.is_identity:
+        return x
+    if epi.bias:
+        if bias_row is None:
+            raise ValueError(
+                "apply_epilogue: epi.bias set but no bias_row operand")
+        x = x + bias_row
+    if epi.activation == "relu":
+        x = jnp.maximum(x, 0.0)
+    elif epi.activation == "gelu":
+        # tanh-approximated GELU (the serving standard): VPU-friendly —
+        # one transcendental per element, no erf lowering required.
+        x = 0.5 * x * (1.0 + jnp.tanh(
+            0.7978845608028654 * (x + 0.044715 * x * x * x)))
+    if epi.quantize == "int8":
+        x = jnp.clip(jnp.round(x * epi.scale), -128.0, 127.0)
+    elif epi.quantize == "float8_e4m3fn":
+        x = (x * epi.scale).astype(jnp.float8_e4m3fn).astype(jnp.float32)
+    return x

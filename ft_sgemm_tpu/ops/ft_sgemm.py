@@ -122,6 +122,7 @@ streams — clean residuals identically zero, exact correction).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 from typing import NamedTuple, Optional
@@ -134,12 +135,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ft_sgemm_tpu import telemetry
 from ft_sgemm_tpu.configs import (
+    DEFAULT_VARIANT,
     ENCODE_MODES,
     SHAPES,
     STRATEGIES,
     THRESHOLD_MODES,
+    EpilogueSpec,
     KernelShape,
+    KernelVariant,
     aug_rows as _aug_rows,
+    canonical_variant,
     check_kernel_legality as _check_kernel_legality,
     shape_for_dtype,
     vmem_limit_bytes,
@@ -148,13 +153,20 @@ from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import (
     CompilerParams as _CompilerParams,
     DEFAULT_THRESHOLD_MARGIN,
+    apply_epilogue as _apply_epilogue,
+    attach_bias as _attach_bias,
     dtype_suffix as _dtype_suffix,
+    epilogue_bias_row as _epilogue_bias_row,
     estimate_noise_floor_jnp as _estimate_noise_floor_jnp,
     gemm_cost_estimate as _gemm_cost_estimate,
+    grid_and_maps as _grid_and_maps,
+    grid_ij as _grid_ij,
+    pad_bias as _pad_bias,
     pad_to as _pad_to,
     resolve_in_dtype as _resolve_in_dtype,
     should_interpret as _should_interpret,
     shrink_block as _shrink_block,
+    sub_panels as _sub_panels,
     variance_bound_threshold as _variance_bound_threshold,
 )
 from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
@@ -506,6 +518,7 @@ def _ft_kernel_rowcol(
     r_exp_ref, c_exp_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn, multifault,
     exact=False, adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     # Optional scratch tail, in declaration order (_scratch_for): the
     # multifault weighted stream, the int32-exact accumulator (int8
@@ -524,8 +537,7 @@ def _ft_kernel_rowcol(
         idx += 1
     count_ref, unc_count_ref = rest[idx], rest[idx + 1]
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalars: per-call thresholds
     thr_m1 = inj_ref[5]     # weighted-moment re-check (multifault mode)
 
@@ -549,12 +561,14 @@ def _ft_kernel_rowcol(
     # MXU: main partial product. f32 accumulation for the float dtypes;
     # int8 inputs accumulate EXACTLY in int32 (preferred_element_type) —
     # clean checksum residuals are then identically zero mod 2^32.
-    acc_ref[:] += jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32 if exact else jnp.float32,
-        precision=prec,
-    )
+    # unroll > 1 (deep pipeline): one dot per K sub-panel of the window.
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        acc_ref[:] += jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32 if exact else jnp.float32,
+            precision=prec,
+        )
 
     # VPU: panel input checksums (replaces __shfl_xor butterflies) and
     # expected row/col sums of the accumulated product. Always the
@@ -616,11 +630,17 @@ def _ft_kernel_rowcol(
 
     @pl.when(k == nk - 1)
     def _epilogue():
+        # Fused epilogue strictly AFTER the detect/correct pass above
+        # (same-step pl.when blocks run in definition order): checksums
+        # verify the pre-epilogue accumulator.
         if exact:
-            out_ref[:] = (alpha * acc_ref[:].astype(jnp.float32)
-                          + beta * c_ref[:])
+            out_ref[:] = _apply_epilogue(
+                alpha * acc_ref[:].astype(jnp.float32) + beta * c_ref[:],
+                epi, _epilogue_bias_row(bias_ref))
         else:
-            out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+            out_ref[:] = _apply_epilogue(
+                alpha * out_ref[:] + beta * c_ref[:],
+                epi, _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
 
@@ -630,6 +650,7 @@ def _ft_kernel_rowcol_mxu(
     r_exp_ref, c_exp_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn, multifault, n_terms,
     adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """Rowcol with MXU-fused encode (``encode="mxu"`` — module docstring).
 
@@ -659,8 +680,7 @@ def _ft_kernel_rowcol_mxu(
     else:
         count_ref, unc_count_ref = rest
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalars: per-call thresholds
     thr_m1 = inj_ref[5]     # weighted-moment re-check (multifault mode)
 
@@ -678,15 +698,16 @@ def _ft_kernel_rowcol_mxu(
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    prod = jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )                             # (bm + aug_a, bn + aug_b)
-    out_ref[:] += prod[:bm, :bn]
-    c_exp_ref[:] += prod[bm:, :bn]
-    r_exp_ref[:] += prod[:bm, bn:]
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        prod = jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )                             # (bm + aug_a, bn + aug_b)
+        out_ref[:] += prod[:bm, :bn]
+        c_exp_ref[:] += prod[bm:, :bn]
+        r_exp_ref[:] += prod[:bm, bn:]
     if adaptive:
         _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
                             b_blk[:bn].astype(jnp.float32))
@@ -727,7 +748,9 @@ def _ft_kernel_rowcol_mxu(
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        out_ref[:] = _apply_epilogue(
+            alpha * out_ref[:] + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
 
@@ -737,6 +760,7 @@ def _ft_kernel_global_mxu(
     t_exp_ref, prev_ref, count_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn,
     adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """Global (scalar-checksum, detect-only) with MXU-fused encode.
 
@@ -749,8 +773,7 @@ def _ft_kernel_global_mxu(
     if adaptive:
         (mom_ref,) = rest
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalar (no moment re-checks here)
 
     @pl.when(k == 0)
@@ -766,14 +789,15 @@ def _ft_kernel_global_mxu(
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    prod = jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )                             # (bm + aug, bn + aug)
-    out_ref[:] += prod[:bm, :bn]
-    t_exp_ref[0] += jnp.sum(prod[bm:, bn:])
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        prod = jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )                             # (bm + aug, bn + aug)
+        out_ref[:] += prod[:bm, :bn]
+        t_exp_ref[0] += jnp.sum(prod[bm:, bn:])
     if adaptive:
         _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
                             b_blk[:bn].astype(jnp.float32))
@@ -796,7 +820,9 @@ def _ft_kernel_global_mxu(
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        out_ref[:] = _apply_epilogue(
+            alpha * out_ref[:] + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         # Detect-only strategy: every detection is by definition
         # uncorrected (FtSgemmResult docstring).
@@ -808,6 +834,7 @@ def _ft_kernel_global(
     t_exp_ref, prev_ref, count_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn,
     exact=False, adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """Scalar-checksum, detect-only variant (``ft_sgemm_huge_thread.cuh``)."""
     idx = 0
@@ -819,8 +846,7 @@ def _ft_kernel_global(
         mom_ref = rest[idx]
         idx += 1
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalar (no moment re-checks here)
 
     @pl.when(k == 0)
@@ -836,12 +862,13 @@ def _ft_kernel_global(
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    acc_ref[:] += jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32 if exact else jnp.float32,
-        precision=prec,
-    )
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        acc_ref[:] += jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32 if exact else jnp.float32,
+            precision=prec,
+        )
     enc_t = jnp.int32 if exact else jnp.float32
     s_b = jnp.sum(b_blk.astype(enc_t), axis=0, keepdims=True)  # (1, bk)
     # Total expected sum of this panel's product: sum_k s_a[k] * s_b[k].
@@ -874,10 +901,13 @@ def _ft_kernel_global(
     @pl.when(k == nk - 1)
     def _epilogue():
         if exact:
-            out_ref[:] = (alpha * acc_ref[:].astype(jnp.float32)
-                          + beta * c_ref[:])
+            out_ref[:] = _apply_epilogue(
+                alpha * acc_ref[:].astype(jnp.float32) + beta * c_ref[:],
+                epi, _epilogue_bias_row(bias_ref))
         else:
-            out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+            out_ref[:] = _apply_epilogue(
+                alpha * out_ref[:] + beta * c_ref[:],
+                epi, _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         # Detect-only strategy: every detection is by definition
         # uncorrected (FtSgemmResult docstring).
@@ -889,6 +919,7 @@ def _ft_kernel_weighted(
     c_exp_ref, cw_exp_ref, cw2_exp_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn,
     adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """Weighted-checksum variant with fault *localization*.
 
@@ -903,8 +934,7 @@ def _ft_kernel_weighted(
     else:
         count_ref, unc_count_ref = rest
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalars: per-call thresholds
     thr_m1 = inj_ref[5]     # weighted-moment re-check threshold
     thr_m2 = inj_ref[6]     # second-moment re-check threshold
@@ -927,12 +957,13 @@ def _ft_kernel_weighted(
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    out_ref[:] += jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        out_ref[:] += jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
     af = a_blk.astype(jnp.float32)
     bf = b_blk.astype(jnp.float32)
     s_a = jnp.sum(af, axis=0, keepdims=True)                 # (1, bk)
@@ -971,7 +1002,9 @@ def _ft_kernel_weighted(
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        out_ref[:] = _apply_epilogue(
+            alpha * out_ref[:] + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
 
@@ -980,6 +1013,7 @@ def _ft_kernel_weighted_precomp(
     inj_ref, a_ref, b_ref, c_ref, exp_ref, out_ref, det_ref, unc_ref,
     count_ref,
     *, alpha, beta, nk, prec, bm, bn,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """Weighted variant with PRECOMPUTED expected checksums (deferred check).
 
@@ -1005,8 +1039,7 @@ def _ft_kernel_weighted_precomp(
     column residual at the final check, localized by the weighted ratio.
     """
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalars: per-call thresholds
     thr_m1 = inj_ref[5]     # weighted-moment re-check threshold
     thr_m2 = inj_ref[6]     # second-moment re-check threshold
@@ -1018,12 +1051,13 @@ def _ft_kernel_weighted_precomp(
 
     _inject(out_ref, inj_ref, k, i, j, bm, bn)
 
-    out_ref[:] += jax.lax.dot_general(
-        a_ref[:], b_ref[:],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )
+    for a_sub, b_sub in _sub_panels(a_ref[:], b_ref[:], unroll):
+        out_ref[:] += jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
 
     @pl.when(k == nk - 1)
     def _detect_correct_epilogue():
@@ -1032,7 +1066,11 @@ def _ft_kernel_weighted_precomp(
             (threshold, thr_m1, thr_m2), bm, bn)
         count_ref[0] += n_hit
         unc_ref[i, j] = n_unc
-        out_ref[:] = alpha * corrected + beta * c_ref[:]
+        # Correction precedes the alpha/beta epilogue AND the fused
+        # epilogue: checksums verify the pre-epilogue accumulator.
+        out_ref[:] = _apply_epilogue(
+            alpha * corrected + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
 
 
@@ -1041,6 +1079,7 @@ def _ft_kernel_fused(
     exp_ref, *rest,
     alpha, beta, nk, prec, check_every, bm, bn, n_terms,
     adaptive=False, bk=None,
+    unroll=1, swap_ij=False, epi=None, bias_ref=None,
 ):
     """MXU-fused checksum variant (warp-level analog — module docstring).
 
@@ -1061,8 +1100,7 @@ def _ft_kernel_fused(
     else:
         count_ref, unc_count_ref = rest
     k = pl.program_id(2)
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i, j = _grid_ij(swap_ij)
     threshold = inj_ref[4]  # runtime scalars: per-call thresholds
     thr_m1 = inj_ref[5]     # weighted-moment re-check threshold
     thr_m2 = inj_ref[6]     # second-moment re-check threshold
@@ -1080,14 +1118,15 @@ def _ft_kernel_fused(
 
     a_blk = a_ref[:]
     b_blk = b_ref[:]
-    prod = jax.lax.dot_general(
-        a_blk, b_blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )                                   # (bm + aug, bn): C rows + moments
-    out_ref[:] += prod[:bm, :]
-    exp_ref[:] += prod[bm:, :]
+    for a_sub, b_sub in _sub_panels(a_blk, b_blk, unroll):
+        prod = jax.lax.dot_general(
+            a_sub, b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )                                   # (bm + aug, bn): C + moments
+        out_ref[:] += prod[:bm, :]
+        exp_ref[:] += prod[bm:, :]
     if adaptive:
         _accumulate_moments(mom_ref, a_blk[:bm].astype(jnp.float32),
                             b_blk.astype(jnp.float32))
@@ -1118,7 +1157,9 @@ def _ft_kernel_fused(
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        out_ref[:] = _apply_epilogue(
+            alpha * out_ref[:] + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
         det_ref[i, j] = count_ref[0]
         unc_ref[i, j] = unc_count_ref[0]
 
@@ -1277,21 +1318,29 @@ def resolve_kernel_strategy(strategy: str, encode: str) -> str:
     jax.jit,
     static_argnames=(
         "shape", "alpha", "beta", "precision", "check_every",
-        "strategy", "interpret", "multifault", "adaptive",
+        "strategy", "interpret", "multifault", "adaptive", "variant",
     ),
 )
 def _ft_sgemm_padded(
     a, b, c, inj,
     *, shape: KernelShape, alpha, beta, precision, threshold, check_every,
     strategy, interpret, multifault=False, adaptive=False, margin=None,
+    variant: KernelVariant = DEFAULT_VARIANT, bias=None,
 ):
     m, k = a.shape
     n, _ = b.shape
     bm, bn, bk = shape.block
-    nk = k // bk
+    unroll = variant.pipeline_depth - 1
+    kw = bk * unroll           # buffered K window (unroll panels/step)
+    nk = k // kw
     gm, gn = m // bm, n // bn
     prec = jax.lax.Precision(precision)
     check_every = max(1, check_every)
+    swap_ij = variant.grid_order == "nm"
+    epi = variant.epilogue_spec
+    epi = None if epi.is_identity else epi
+    grid, a_map, b_map, c_map, row_map = _grid_and_maps(
+        variant.grid_order, gm, gn, nk)
     # int8 inputs run the int32-exact accumulation bodies (rowcol/global
     # only — configs.check_kernel_legality gates the rest).
     exact = a.dtype == jnp.int8
@@ -1324,20 +1373,26 @@ def _ft_sgemm_padded(
     a_rows = bm  # A block / output block row count (augmented for MXU encode)
     b_rows = bn  # B block row count (augmented when B carries checksum rows)
     n_terms = 3 if a.dtype == jnp.bfloat16 else 1
+    # Variant axes every kernel body understands: the deep-pipeline
+    # sub-panel unroll, the grid-order program-id swap, and the fused
+    # epilogue (the bias operand, when fused, rides LAST so positional
+    # signatures stay stable — _attach_bias re-routes it).
+    vkw = dict(unroll=unroll, swap_ij=swap_ij, epi=epi)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # inj spec + thresholds (7,)
         None,  # A spec placed below once a_rows is final
         None,  # B spec placed below once b_rows is final
-        pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        pl.BlockSpec((bm, bn), c_map),
     ]
     operands = [inj, a, b, c]
     if precomp:
         kernel = functools.partial(
             _ft_kernel_weighted_precomp,
             alpha=alpha, beta=beta, nk=nk, prec=prec, bm=bm, bn=bn,
+            **vkw,
         )
         exp = _expected_col_checksums(a, b, bm, prec)
-        in_specs += [pl.BlockSpec((8, bn), lambda i, j, kk: (i, j))]
+        in_specs += [pl.BlockSpec((8, bn), c_map)]
         operands += [exp]
         scratch = [pltpu.SMEM((1,), jnp.int32)]
     elif strategy == "fused":
@@ -1348,7 +1403,8 @@ def _ft_sgemm_padded(
             _ft_kernel_fused,
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn, n_terms=n_terms,
-            adaptive=adaptive, bk=bk,
+            adaptive=adaptive, bk=kw,
+            **vkw,
         )
         scratch = [pltpu.VMEM((aug, bn), jnp.float32)]
         if adaptive:
@@ -1364,7 +1420,8 @@ def _ft_sgemm_padded(
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
             multifault=multifault, n_terms=n_terms,
-            adaptive=adaptive, bk=bk,
+            adaptive=adaptive, bk=kw,
+            **vkw,
         )
         scratch = [pltpu.VMEM((bm, aug), jnp.float32),   # r_exp term cols
                    pltpu.VMEM((aug, bn), jnp.float32)]   # c_exp moment rows
@@ -1380,7 +1437,8 @@ def _ft_sgemm_padded(
             _ft_kernel_global_mxu,
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
-            adaptive=adaptive, bk=bk,
+            adaptive=adaptive, bk=kw,
+            **vkw,
         )
         scratch = [pltpu.SMEM((1,), jnp.float32),
                    pltpu.SMEM((1,), jnp.float32), pltpu.SMEM((1,), jnp.int32)]
@@ -1394,20 +1452,25 @@ def _ft_sgemm_padded(
             _KERNELS[strategy],
             alpha=alpha, beta=beta, nk=nk, prec=prec,
             check_every=check_every, bm=bm, bn=bn,
-            adaptive=adaptive, bk=bk,
+            adaptive=adaptive, bk=kw,
             **extra,
+            **vkw,
         )
         scratch = _scratch_for(strategy, bm, bn, multifault,
                                exact=exact, adaptive=adaptive)
-    in_specs[1] = pl.BlockSpec((a_rows, bk), lambda i, j, kk: (i, kk))
-    in_specs[2] = pl.BlockSpec((b_rows, bk), lambda i, j, kk: (j, kk))
+    in_specs[1] = pl.BlockSpec((a_rows, kw), a_map)
+    in_specs[2] = pl.BlockSpec((b_rows, kw), b_map)
+    if epi is not None and epi.bias:
+        in_specs.append(pl.BlockSpec((8, bn), row_map))
+        operands.append(bias)
+        kernel = _attach_bias(kernel, n_in=len(operands))
 
     out, det, unc = pl.pallas_call(
         kernel,
-        grid=(gm, gn, nk),
+        grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((bm, bn), c_map),
             # Full-array SMEM blocks: each (i, j) program writes its own cell
             # (grid-blocked SMEM outputs must match the array shape).
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -1425,7 +1488,8 @@ def _ft_sgemm_padded(
         # copying a second (M, N) HBM array (pinned in tests).
         input_output_aliases={3: 0},
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=(variant.dim_semantics,
+                                 variant.dim_semantics, "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),
         ),
         cost_estimate=_gemm_cost_estimate(
@@ -1451,10 +1515,13 @@ def make_ft_sgemm(
     multifault: Optional[bool] = None,
     interpret: Optional[bool] = None,
     tunable: Optional[bool] = None,
+    variant: Optional[KernelVariant] = None,
+    epilogue=None,
 ):
     """Build the fused-ABFT SGEMM for one named shape.
 
-    Returns ``fn(a, b, c, inject=None) -> FtSgemmResult``. ``inject`` is an
+    Returns ``fn(a, b, c, inject=None, bias=None) -> FtSgemmResult``.
+    ``inject`` is an
     :class:`InjectionSpec` (default: no injection — the clean path the
     reference lacks). ``check_every`` is the detect/correct cadence in
     K-grid steps; default scales to ~20 checks per run like the reference's
@@ -1531,11 +1598,34 @@ def make_ft_sgemm(
       semantics are unchanged; the weighted strategy runs its in-kernel
       encode body (the precomp body has no encode pass to ride).
 
+    ``variant`` pins the full kernel-variant descriptor
+    (:class:`~ft_sgemm_tpu.configs.KernelVariant`): pipeline depth (the
+    deep-pipeline K-window unroll), grid traversal order, Mosaic
+    dimension semantics, detect/correct cadence, and the fused epilogue.
+    ``None`` (the default) dispatches the historical behavior —
+    byte-identical HLO — and lets a tuned winner's variant axes apply;
+    an explicit variant is respected verbatim (the tuner may still
+    serve a tile for that exact variant key). ``check_every`` and
+    ``variant.check_every`` name the same axis; the explicit
+    ``check_every`` argument wins when both are given. With a deep
+    pipeline the cadence (and the injection schedule) counts GRID steps,
+    each of which now consumes ``(pipeline_depth - 1)`` K panels.
+
+    ``epilogue`` (an :class:`~ft_sgemm_tpu.configs.EpilogueSpec` or a
+    spelling like ``"bias+relu"`` / ``"bias+gelu+qint8x0.5"``) fuses a
+    bias add, activation, and int8/fp8 quantize-rescale into the
+    detect-correct epilogue — applied strictly AFTER correction, so the
+    ABFT checksums verify the pre-epilogue accumulator and
+    detection/correction semantics are untouched (oracle-pinned under
+    injection in tests/test_variants.py). A fused bias is passed per
+    call: ``fn(a, b, c, inject, bias=v)`` with ``v`` of length N.
+
     ``tunable`` controls whether dispatch consults the autotuner's tile
     cache (``ft_sgemm_tpu.tuner``). Default ``None`` resolves to "named
     shapes only": a persisted winner for this call's
-    ``(device, M/N/K bucket, dtype, strategy, injection)`` key then
-    overrides the heuristic block choice; with no cache entry (or tuning
+    ``(device, M/N/K bucket, dtype, strategy, injection, variant)`` key
+    then overrides the heuristic block choice (and, for un-pinned
+    callers, the variant axes); with no cache entry (or tuning
     disabled) the dispatch path — and the emitted HLO — is untouched.
     Explicit ``KernelShape`` objects stay un-tuned by default (a tile
     sweep measures the tile its row label claims); the attention
@@ -1569,6 +1659,17 @@ def make_ft_sgemm(
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision,
                                             allow_low_precision=True)
     exact = in_dtype == jnp.int8
+    # Variant resolution: an explicit variant (or explicit check_every)
+    # pins those axes; everything left unpinned may be overridden by a
+    # tuned winner at dispatch. The epilogue is workload-owned: it is
+    # always concrete (default "none"), never searched per call.
+    pinned_variant = variant is not None
+    var = canonical_variant(variant)
+    if epilogue is not None:
+        var = dataclasses.replace(
+            var, epilogue=EpilogueSpec.parse(epilogue).spelling)
+    if check_every is None:
+        check_every = var.check_every
     named = isinstance(shape, str)
     tunable = named if tunable is None else bool(tunable)
     if named:
@@ -1578,7 +1679,8 @@ def make_ft_sgemm(
         # its row label claims.
         shape = shape_for_dtype(SHAPES[shape], True, in_dtype)
 
-    def fn(a, b, c, inject: Optional[InjectionSpec] = None) -> FtSgemmResult:
+    def fn(a, b, c, inject: Optional[InjectionSpec] = None,
+           bias=None) -> FtSgemmResult:
         inject = inject or InjectionSpec.none()
         a = jnp.asarray(a, in_dtype)
         b = jnp.asarray(b, in_dtype)
@@ -1587,32 +1689,52 @@ def make_ft_sgemm(
         # (placeholder; thresholds are computed after the tile resolves,
         # since the re-check scales depend on bm — see below)
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+        eff_var = var
+        ce_req = check_every   # cadence constraint (None = strategy auto)
         if tunable:
             # Cache-backed dispatch: a persisted tuned winner for this
-            # exact (device, size bucket, dtype, strategy, injection) key
-            # overrides the heuristic tile. Pure host-side lookup — a miss
-            # (or tuning disabled) leaves eff, and therefore the traced
-            # computation, bit-for-bit unchanged.
+            # exact (device, size bucket, dtype, strategy, injection,
+            # variant) key overrides the heuristic tile — and, where the
+            # caller pinned nothing, the variant axes. Pure host-side
+            # lookup — a miss (or tuning disabled) leaves eff/eff_var,
+            # and therefore the traced computation, bit-for-bit
+            # unchanged.
             from ft_sgemm_tpu import tuner as _tuner
 
-            tuned = _tuner.lookup_tile(
+            tuned, tuned_var = _tuner.lookup_winner(
                 m, n, a.shape[1],
                 strategy=("weighted" if strategy == "fused" else strategy),
                 encode=encode, in_dtype=in_dtype,
                 injection_enabled=inject.enabled,
-                threshold_mode=("adaptive" if adaptive else "static"))
+                threshold_mode=("adaptive" if adaptive else "static"),
+                variant=var if pinned_variant else None,
+                cadence=check_every, epilogue=var.epilogue)
             if tuned is not None:
                 eff = tuned
+            if tuned_var is not None and not pinned_variant:
+                # The winner's searched pipeline/grid/cadence apply; the
+                # epilogue stays the caller's (it is part of the key, so
+                # the spellings already agree), and an explicit
+                # check_every argument keeps priority over the winner's
+                # cadence.
+                eff_var = dataclasses.replace(
+                    tuned_var, epilogue=var.epilogue)
+                if check_every is None:
+                    ce_req = tuned_var.check_every
+
+        unroll = eff_var.pipeline_depth - 1
 
         def resolve_cadence(e):
             """nk and the effective check cadence at tile ``e``.
 
             One resolver for the VMEM-fit variant choice AND the final
             kernel parameters, so the fitted body is the body that runs.
+            ``nk`` counts GRID steps: with a deep pipeline each step
+            consumes ``unroll`` K panels of ``e.bk``.
             """
-            nk_ = -(-a.shape[1] // e.bk)
-            if check_every is not None:
-                ce_ = check_every
+            nk_ = -(-a.shape[1] // (e.bk * unroll))
+            if ce_req is not None:
+                ce_ = ce_req
             elif strategy in ("weighted", "fused"):
                 ce_ = nk_  # single final check: localization absorbs
                 # the whole fault backlog
@@ -1649,17 +1771,19 @@ def make_ft_sgemm(
         # the real kernel fits — the tuner's pre-filter makes the same
         # call, scripts/tune_tiles.py).
         nk0, ce0 = resolve_cadence(eff)
-        variant = kernel_strategy
+        fit_variant = kernel_strategy
         if kernel_strategy == "weighted" and ce0 >= nk0 and not adaptive:
             # Adaptive mode always runs the in-kernel encode body: its
             # moment statistics ride the encode pass (_ft_sgemm_padded).
-            variant = "weighted_precomp"
+            fit_variant = "weighted_precomp"
         limit = vmem_limit_bytes()
         itemsize = jnp.dtype(in_dtype).itemsize
+        depth = eff_var.pipeline_depth
         eff = _fit_block_to_vmem(
-            eff, variant, limit=limit, in_itemsize=itemsize,
-            allow_shrink=named, adaptive=adaptive, exact=exact)
-        if variant == "weighted_precomp":
+            eff, fit_variant, limit=limit, in_itemsize=itemsize,
+            allow_shrink=named, adaptive=adaptive, exact=exact,
+            pipeline_depth=depth)
+        if fit_variant == "weighted_precomp":
             nk1, ce1 = resolve_cadence(eff)
             if ce1 < nk1:
                 # A bk shrink deepened the K grid past an explicit
@@ -1667,10 +1791,12 @@ def make_ft_sgemm(
                 # encode body will run after all — re-fit against it.
                 eff = _fit_block_to_vmem(
                     eff, "weighted", limit=limit, in_itemsize=itemsize,
-                    allow_shrink=named, adaptive=adaptive, exact=exact)
+                    allow_shrink=named, adaptive=adaptive, exact=exact,
+                    pipeline_depth=depth)
         bm, bn, bk = eff.block
-        ap = _pad_to(a, bm, bk)
-        bp = _pad_to(b, bn, bk)
+        kwin = bk * unroll      # K consumed per grid step
+        ap = _pad_to(a, bm, kwin)
+        bp = _pad_to(b, bn, kwin)
         cp = _pad_to(c, bm, bn)
         _, ce = resolve_cadence(eff)
         if strategy != "rowcol" or exact:
@@ -1712,6 +1838,22 @@ def make_ft_sgemm(
             # higher moments' noise is negligible and a single scale keeps
             # the adversarial-schedule reports maximally sensitive.
             thr = thr_m1 = thr_m2 = jnp.float32(threshold)
+        bias_op = None
+        if eff_var.epilogue_spec.bias:
+            if bias is None:
+                raise ValueError(
+                    f"{op_name}: epilogue {eff_var.epilogue!r} fuses a"
+                    f" bias — pass fn(a, b, c, inject, bias=v) with v of"
+                    f" length N={n}")
+            bias_op = _pad_bias(bias, n, bn)
+        elif bias is not None:
+            raise ValueError(
+                f"{op_name}: bias given but epilogue"
+                f" {eff_var.epilogue!r} does not fuse one")
+        # The padded wrapper reads the variant's lowering axes only
+        # (pipe/grid/semantics/epilogue); the cadence already resolved
+        # into check_every — normalize it out of the jit key.
+        padded_var = dataclasses.replace(eff_var, check_every=None)
         with telemetry.trace_span(op_name):
             out, det, unc = _ft_sgemm_padded(
                 ap, bp, cp, jnp.asarray(inject.as_operand()),
@@ -1720,6 +1862,7 @@ def make_ft_sgemm(
                 strategy=kernel_strategy, multifault=mf,
                 adaptive=adaptive, margin=margin,
                 interpret=_should_interpret(interpret),
+                variant=padded_var, bias=bias_op,
             )
         result = FtSgemmResult(out[:m, :n], det, unc)
         if telemetry.enabled():
@@ -1742,23 +1885,34 @@ def make_ft_sgemm(
                     pass
             else:
                 thr_rec = thr
+            # A non-identity epilogue transforms the output away from
+            # alpha*A@B.T + beta*C, so the host residual measurement
+            # would be meaningless — drop the operands there.
             telemetry.record_gemm(
                 op_name, result, strategy=strategy, encode=encode,
                 threshold=thr_rec, threshold_mode=threshold_mode,
-                variance=variance, operands=(a, b, c), alpha=alpha,
-                beta=beta)
+                variance=variance,
+                operands=((a, b, c) if eff_var.epilogue_spec.is_identity
+                          else None),
+                alpha=alpha, beta=beta,
+                epilogue=(eff_var.epilogue
+                          if eff_var.epilogue != "none" else None))
         return result
 
     op_name = (f"ft_sgemm_{shape.name}_{strategy}"
                + ("_mxu" if encode == "mxu" and strategy != "fused" else "")
                + ("_adaptive" if adaptive else "")
-               + _dtype_suffix(in_dtype))
+               + _dtype_suffix(in_dtype)
+               + (("_epi_" + var.epilogue.replace("+", "_"))
+                  if var.epilogue != "none" else ""))
     fn.__name__ = op_name
     fn.shape_config = shape
     fn.strategy = strategy
     fn.encode = encode
     fn.in_dtype = in_dtype
     fn.threshold_mode = threshold_mode
+    fn.variant = var
+    fn.epilogue = var.epilogue
     return fn
 
 
@@ -1769,7 +1923,9 @@ def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
              threshold_margin: float = DEFAULT_THRESHOLD_MARGIN,
              check_every: Optional[int] = None, precision: str = "highest",
              in_dtype: str = "float32", multifault: Optional[bool] = None,
-             interpret: Optional[bool] = None) -> FtSgemmResult:
+             interpret: Optional[bool] = None,
+             variant: Optional[KernelVariant] = None,
+             epilogue=None, bias=None) -> FtSgemmResult:
     """One-shot fused-ABFT SGEMM (see :func:`make_ft_sgemm`)."""
     return make_ft_sgemm(
         shape, alpha=alpha, beta=beta, strategy=strategy, encode=encode,
@@ -1777,4 +1933,5 @@ def ft_sgemm(a, b, c, shape: KernelShape | str = "huge", *, alpha=1.0,
         threshold_margin=threshold_margin, check_every=check_every,
         precision=precision, in_dtype=in_dtype,
         multifault=multifault, interpret=interpret,
-    )(a, b, c, inject)
+        variant=variant, epilogue=epilogue,
+    )(a, b, c, inject, bias=bias)

@@ -60,6 +60,46 @@ def sgemm_reference(a, b, c, alpha=1.0, beta=-1.5, *, precision="highest",
                                 in_dtype=dt.name)
 
 
+def epilogue_reference(x, epilogue, bias=None):
+    """Host-numpy twin of the in-kernel fused epilogue
+    (:func:`ft_sgemm_tpu.ops.common.apply_epilogue`): bias ->
+    activation -> quantize on an already-computed f32 output.
+
+    ``epilogue`` is an :class:`~ft_sgemm_tpu.configs.EpilogueSpec` or a
+    spelling string; ``bias`` a length-N (or (1, N)) vector when the spec
+    fuses one. The serving verifier and the oracle tests compose this
+    with :func:`sgemm_reference` / :func:`cpu_gemm` to check
+    epilogue-fused kernels end to end.
+    """
+    import numpy as np
+
+    from ft_sgemm_tpu.configs import EpilogueSpec
+
+    epi = EpilogueSpec.parse(epilogue)
+    x = np.asarray(x, np.float32)
+    if epi.is_identity:
+        return x
+    if epi.bias:
+        if bias is None:
+            raise ValueError(
+                "epilogue_reference: spec fuses a bias but none given")
+        x = x + np.asarray(bias, np.float32).reshape(1, -1)
+    if epi.activation == "relu":
+        x = np.maximum(x, 0.0)
+    elif epi.activation == "gelu":
+        x = 0.5 * x * (1.0 + np.tanh(
+            0.7978845608028654 * (x + 0.044715 * x * x * x)))
+    if epi.quantize == "int8":
+        # np.round rounds half-to-even, matching jnp.round in-kernel.
+        x = np.clip(np.round(x * epi.scale), -128.0, 127.0)
+    elif epi.quantize == "float8_e4m3fn":
+        import ml_dtypes
+
+        x = (x * epi.scale).astype(ml_dtypes.float8_e4m3fn)
+        x = x.astype(np.float32)
+    return x.astype(np.float32)
+
+
 def cpu_gemm(alpha, beta, a, b, c):
     """Naive O(n^3)-semantics reference on host numpy (reference
     ``utils.cu:79-89``, row-major ``C = alpha*A@B + beta*C``). Kept as the

@@ -37,24 +37,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ft_sgemm_tpu.configs import (
+    DEFAULT_VARIANT,
     SHAPES,
+    EpilogueSpec,
     KernelShape,
+    KernelVariant,
+    canonical_variant,
     shape_for_dtype,
     vmem_limit_bytes,
 )
 from ft_sgemm_tpu.ops.common import (
     CompilerParams as _CompilerParams,
+    apply_epilogue as _apply_epilogue,
+    attach_bias as _attach_bias,
     dtype_suffix as _dtype_suffix,
+    epilogue_bias_row as _epilogue_bias_row,
     gemm_cost_estimate as _gemm_cost_estimate,
+    grid_and_maps as _grid_and_maps,
+    pad_bias as _pad_bias,
     pad_to as _pad_to,
     resolve_in_dtype as _resolve_in_dtype,
     should_interpret as _should_interpret,
     shrink_block as _shrink_block,
+    sub_panels as _sub_panels,
 )
 from ft_sgemm_tpu.ops.vmem import fit_block_to_vmem as _fit_block_to_vmem
 
 
-def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec):
+def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec,
+                   unroll=1, epi=None, bias_ref=None):
     """One (i, j, k) grid step: acc += A_blk @ B_blk.T; epilogue at k==nk-1.
 
     The accumulator IS the f32 output block: Mosaic keeps the (i, j) output
@@ -62,6 +73,11 @@ def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec):
     not depend on k) and writes it back to HBM once, so accumulating in
     place is free — and saves a bm*bn*4-byte scratch buffer, VMEM that
     instead buys larger tiles (the bf16 flagship's limiting resource).
+
+    ``unroll`` > 1 is the deep-pipeline realization (``configs.
+    PIPELINE_DEPTHS``): the K window holds ``unroll`` panels and the body
+    runs one dot per sub-panel. ``epi``/``bias_ref`` fuse the optional
+    bias/activation/quantize epilogue into the final write-back.
     """
     k = pl.program_id(2)
 
@@ -69,42 +85,62 @@ def _matmul_kernel(a_ref, b_ref, c_ref, out_ref, *, alpha, beta, nk, prec):
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    out_ref[:] += jax.lax.dot_general(
-        a_ref[:],
-        b_ref[:],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )
+    for a_sub, b_sub in _sub_panels(a_ref[:], b_ref[:], unroll):
+        out_ref[:] += jax.lax.dot_general(
+            a_sub,
+            b_sub,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
 
     @pl.when(k == nk - 1)
     def _epilogue():
-        out_ref[:] = alpha * out_ref[:] + beta * c_ref[:]
+        out_ref[:] = _apply_epilogue(
+            alpha * out_ref[:] + beta * c_ref[:], epi,
+            _epilogue_bias_row(bias_ref))
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("shape", "alpha", "beta", "precision", "interpret"),
+    static_argnames=("shape", "alpha", "beta", "precision", "interpret",
+                     "variant"),
 )
-def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interpret):
+def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision,
+                  interpret, variant: KernelVariant = DEFAULT_VARIANT,
+                  bias=None):
     m, k = a.shape
     n, _ = b.shape
     bm, bn, bk = shape.block
-    nk = k // bk
-    grid = (m // bm, n // bn, nk)
+    unroll = variant.pipeline_depth - 1
+    kw = bk * unroll            # the buffered K window (unroll panels)
+    nk = k // kw
     prec = jax.lax.Precision(precision)
+    epi = variant.epilogue_spec
+    epi = None if epi.is_identity else epi
+    grid, a_map, b_map, c_map, row_map = _grid_and_maps(
+        variant.grid_order, m // bm, n // bn, nk)
+
+    kernel = functools.partial(
+        _matmul_kernel, alpha=alpha, beta=beta, nk=nk, prec=prec,
+        unroll=unroll, epi=epi,
+    )
+    in_specs = [
+        pl.BlockSpec((bm, kw), a_map),
+        pl.BlockSpec((bn, kw), b_map),
+        pl.BlockSpec((bm, bn), c_map),
+    ]
+    operands = [a, b, c]
+    if epi is not None and epi.bias:
+        in_specs.append(pl.BlockSpec((8, bn), row_map))
+        operands.append(bias)
+        kernel = _attach_bias(kernel, n_in=4)
 
     return pl.pallas_call(
-        functools.partial(
-            _matmul_kernel, alpha=alpha, beta=beta, nk=nk, prec=prec
-        ),
+        kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), c_map),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         # The C operand aliases the output: the beta*C epilogue reads each
         # C tile in the same grid step that retires its output tile, so
@@ -112,12 +148,13 @@ def _sgemm_padded(a, b, c, *, shape: KernelShape, alpha, beta, precision, interp
         # copying a second (M, N) HBM array (pinned in tests).
         input_output_aliases={2: 0},
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=(variant.dim_semantics,
+                                 variant.dim_semantics, "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes(),
         ),
         cost_estimate=_gemm_cost_estimate(m, n, k, a.dtype.itemsize),
         interpret=interpret,
-    )(a, b, c)
+    )(*operands)
 
 
 def make_sgemm(
@@ -129,11 +166,14 @@ def make_sgemm(
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     tunable: Optional[bool] = None,
+    variant: Optional[KernelVariant] = None,
+    epilogue=None,
 ):
     """Build the plain SGEMM for one named shape.
 
-    Returns ``fn(a, b, c) -> C`` with ``C = alpha*A@B.T + beta*C``; inputs of
-    any (M, K)/(N, K)/(M, N) shapes — zero-padded up to the block tile, which
+    Returns ``fn(a, b, c, bias=None) -> C`` with
+    ``C = epilogue(alpha*A@B.T + beta*C)``; inputs of any
+    (M, K)/(N, K)/(M, N) shapes — zero-padded up to the block tile, which
     leaves results exact (padded rows/cols are sliced off).
 
     ``in_dtype="bfloat16"`` feeds A/B to the MXU in bf16 (full-rate path);
@@ -141,12 +181,30 @@ def make_sgemm(
     (XLA splits f32 operands into bf16 passes per the precision level; bf16
     operands are already single-pass).
 
+    ``variant`` pins the kernel-variant axes (:class:`~ft_sgemm_tpu
+    .configs.KernelVariant`: pipeline depth, grid traversal order,
+    dimension semantics, fused epilogue — the cadence axis is FT-only);
+    ``None`` (the default) dispatches the historical behavior,
+    byte-identical HLO, and lets a tuned winner's variant apply.
+    ``epilogue`` (an :class:`~ft_sgemm_tpu.configs.EpilogueSpec` or a
+    spelling like ``"bias+relu"``) fuses bias/activation/quantize into the
+    final write-back; a fused bias is passed per call
+    (``fn(a, b, c, bias=v)``, v of length N).
+
     ``tunable`` (default: named shapes only) lets a persisted autotuner
-    winner (``ft_sgemm_tpu.tuner``) override the heuristic tile; a cache
-    miss or disabled tuning leaves dispatch — and the emitted HLO —
-    untouched (same contract as :func:`make_ft_sgemm`).
+    winner (``ft_sgemm_tpu.tuner``) override the heuristic tile AND (when
+    the caller left ``variant=None``) the variant axes; a cache miss or
+    disabled tuning leaves dispatch — and the emitted HLO — untouched
+    (same contract as :func:`make_ft_sgemm`).
     """
     in_dtype, precision = _resolve_in_dtype(in_dtype, precision)
+    pinned = variant is not None
+    var = canonical_variant(variant)
+    if epilogue is not None:
+        import dataclasses as _dc
+
+        var = _dc.replace(var,
+                          epilogue=EpilogueSpec.parse(epilogue).spelling)
     named = isinstance(shape, str)
     tunable = named if tunable is None else bool(tunable)
     if named:
@@ -156,40 +214,62 @@ def make_sgemm(
         # its row label claims.
         shape = shape_for_dtype(SHAPES[shape], False, in_dtype)
 
-    def fn(a, b, c):
+    def fn(a, b, c, bias=None):
         a = jnp.asarray(a, in_dtype)
         b = jnp.asarray(b, in_dtype)
         c = jnp.asarray(c, jnp.float32)
         m, n = c.shape
         eff = _shrink_block(shape, m, n, a.shape[1]) if named else shape
+        eff_var = var
         if tunable:
             # Cache-backed dispatch (see make_ft_sgemm): a persisted tuned
-            # winner overrides the heuristic tile; a miss changes nothing.
+            # winner overrides the heuristic tile (and, for un-pinned
+            # callers, the variant axes); a miss changes nothing.
             from ft_sgemm_tpu import tuner as _tuner
 
-            tuned = _tuner.lookup_tile(
+            tuned, tuned_var = _tuner.lookup_winner(
                 m, n, a.shape[1], strategy=None, in_dtype=in_dtype,
-                injection_enabled=False)
+                injection_enabled=False,
+                variant=var if pinned else None,
+                epilogue=var.epilogue)
             if tuned is not None:
                 eff = tuned
+            if tuned_var is not None and not pinned:
+                eff_var = tuned_var
         # Trace-time scoped-VMEM guard (ops/vmem.py): auto-shrink named
         # shapes over the Mosaic budget; warn for explicit ones.
         eff = _fit_block_to_vmem(
             eff, None, limit=vmem_limit_bytes(),
-            in_itemsize=jnp.dtype(in_dtype).itemsize, allow_shrink=named)
-        ap = _pad_to(a, eff.bm, eff.bk)
-        bp = _pad_to(b, eff.bn, eff.bk)
+            in_itemsize=jnp.dtype(in_dtype).itemsize, allow_shrink=named,
+            pipeline_depth=eff_var.pipeline_depth)
+        kw = eff.bk * (eff_var.pipeline_depth - 1)
+        ap = _pad_to(a, eff.bm, kw)
+        bp = _pad_to(b, eff.bn, kw)
         cp = _pad_to(c, eff.bm, eff.bn)
+        bias_op = None
+        if eff_var.epilogue_spec.bias:
+            if bias is None:
+                raise ValueError(
+                    f"{fn.__name__}: epilogue {eff_var.epilogue!r} fuses"
+                    " a bias — pass fn(a, b, c, bias=v) with v of"
+                    f" length N={n}")
+            bias_op = _pad_bias(bias, n, eff.bn)
+        elif bias is not None:
+            raise ValueError(
+                f"{fn.__name__}: bias given but epilogue"
+                f" {eff_var.epilogue!r} does not fuse one")
         out = _sgemm_padded(
             ap, bp, cp,
             shape=eff, alpha=alpha, beta=beta,
             precision=precision, interpret=_should_interpret(interpret),
+            variant=eff_var, bias=bias_op,
         )
         return out[:m, :n]
 
     fn.__name__ = f"sgemm_{shape.name}" + _dtype_suffix(in_dtype)
     fn.shape_config = shape
     fn.in_dtype = in_dtype
+    fn.variant = var
     return fn
 
 

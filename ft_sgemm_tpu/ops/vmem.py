@@ -107,7 +107,8 @@ def fused_aug_rows(in_itemsize: int) -> int:
 
 def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
                         in_itemsize: int = 4, multifault: bool = True,
-                        adaptive: bool = False, exact: bool = False) -> int:
+                        adaptive: bool = False, exact: bool = False,
+                        pipeline_depth: int = 2) -> int:
     """Predicted scoped-VMEM bytes for one kernel variant at ``shape``.
 
     ``variant`` is a :data:`TEMP_TILE_FACTORS` key. ``in_itemsize`` is the
@@ -118,19 +119,44 @@ def estimate_vmem_bytes(shape: KernelShape, variant: str, *,
     holds); ``exact`` adds the int8 path's separate (bm, bn) int32
     accumulator block — the one low-precision term that actually moves
     the estimate.
+
+    ``pipeline_depth`` (``configs.PIPELINE_DEPTHS``) prices the searched
+    pipeline axis: depth 2 is Mosaic's automatic double buffer — two
+    (rows, bk) panels resident per input stream, the historical "2x
+    block bytes" assumption. Depth d > 2 widens each buffered window to
+    ``d - 1`` K panels (the realization ops/ft_sgemm unrolls in-body),
+    and Mosaic still double-buffers the wider window, so ``2 * (d - 1)``
+    panels are resident per stream — the model prices exactly that real
+    footprint, not the nominal depth. Output/C windows are K-invariant
+    and unaffected.
+
+    The detect/correct CADENCE axis is priced through ``variant``, not a
+    parameter here: an intermediate cadence on the weighted strategy
+    needs the running in-kernel partial-sum encode body (``"weighted"``,
+    factor 11) where the deferred single final check runs the lighter
+    precomputed-expectations body (``"weighted_precomp"``, factor 9) —
+    ``tuner.space.variant_for(check_every=...)`` resolves a cadence to
+    the body that will actually run, exactly as ``make_ft_sgemm`` does.
     """
     if variant not in TEMP_TILE_FACTORS:
         raise ValueError(
             f"unknown kernel variant {variant!r}; pick from"
             f" {tuple(TEMP_TILE_FACTORS)}")
+    from ft_sgemm_tpu.configs import PIPELINE_DEPTHS
+
+    if pipeline_depth not in PIPELINE_DEPTHS:
+        raise ValueError(
+            f"unknown pipeline_depth {pipeline_depth!r}; pick from"
+            f" {PIPELINE_DEPTHS}")
     bm, bn, bk = shape.block
     aug = aug_rows(in_itemsize)
     aug_a = aug if variant in ("fused", "rowcol_mxu", "global_mxu") else 0
     aug_b = aug if variant in ("rowcol_mxu", "global_mxu") else 0
     a_rows, b_rows, _ = shape.aug_block(aug_a, aug_b)
 
-    buffers = 2 * a_rows * bk * in_itemsize     # A window
-    buffers += 2 * b_rows * bk * in_itemsize    # B window
+    panels = 2 * (pipeline_depth - 1)           # resident K panels/stream
+    buffers = panels * a_rows * bk * in_itemsize     # A window
+    buffers += panels * b_rows * bk * in_itemsize    # B window
     buffers += 2 * bm * bn * 4                  # C operand window
     buffers += 2 * bm * bn * 4                  # output window
     if variant == "weighted_precomp":
@@ -169,7 +195,8 @@ def _variant_for(strategy: str | None) -> str:
 def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
                       limit: int, in_itemsize: int = 4,
                       allow_shrink: bool, adaptive: bool = False,
-                      exact: bool = False) -> KernelShape:
+                      exact: bool = False,
+                      pipeline_depth: int = 2) -> KernelShape:
     """Guard one kernel launch against a Mosaic scoped-VMEM OOM.
 
     Estimates the footprint at ``shape``; if it exceeds ``limit`` either
@@ -190,7 +217,8 @@ def fit_block_to_vmem(shape: KernelShape, strategy: str | None, *,
 
     def est_for(s):
         return estimate_vmem_bytes(s, variant, in_itemsize=in_itemsize,
-                                   adaptive=adaptive, exact=exact)
+                                   adaptive=adaptive, exact=exact,
+                                   pipeline_depth=pipeline_depth)
 
     est = est_for(shape)
     if est <= limit:

@@ -309,6 +309,20 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
             if s:
                 entry["measurements"]["serve_block.kv_verify_hit_rate"] \
                     = s
+    elif ctx.get("serve"):
+        # GEMM serve workload (ISSUE 13): steady-state p50/p99 and
+        # throughput land as serve.* measurements so a tuner win on the
+        # serve path is judged by `cli trend --gate` against its own
+        # rolling history, not a one-off A/B. Same lint.*/serve_block.*
+        # pattern: OUTSIDE extract_measurements (the compare mirror pin
+        # stands; goodput_rps itself already flows through it as the
+        # artifact headline).
+        for key, hib in (("throughput_rps", True),
+                         ("p50_latency_seconds", False),
+                         ("p99_latency_seconds", False)):
+            s = _measurement(ctx.get(key), higher_is_better=hib)
+            if s:
+                entry["measurements"][f"serve.{key}"] = s
 
     if entry["kind"] == "multichip":
         entry["metric"] = entry["metric"] or "multichip_ok"

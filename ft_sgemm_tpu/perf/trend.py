@@ -151,6 +151,49 @@ def collect_series(entries) -> dict:
     return series
 
 
+def stage_seconds_history(entries, stage: str,
+                          platform: str) -> List[float]:
+    """Non-null wall-seconds history of ONE bench stage on ONE platform,
+    in append order — the ``stage[<name>].seconds`` measurement series
+    the ledger banks from every RunReport's per-stage rows.
+
+    This is the bench supervisor's rung-budgeting input (ISSUE 13 /
+    ROADMAP item 1): instead of guarding each headline rung with a flat
+    deadline margin, the worker asks how long THIS stage has actually
+    taken on THIS platform across the run history.
+    """
+    series = collect_series(entries)
+    s = series.get(f"stage[{stage}].seconds@{platform}")
+    if not s:
+        return []
+    return [p["value"] for p in s["points"]
+            if isinstance(p["value"], (int, float))
+            and not isinstance(p["value"], bool)]
+
+
+def stage_wall_budget(entries, stage: str, platform: str, *,
+                      default: Optional[float] = None,
+                      sigma: float = 2.0,
+                      window: int = DEFAULT_WINDOW) -> Optional[float]:
+    """A wall budget for one stage: ``mean + sigma*std`` of its recent
+    per-stage history (:func:`stage_seconds_history`), or ``default``
+    when the series is empty.
+
+    The budget answers "how long should I EXPECT this rung to take if I
+    start it now" — the bench worker compares it against its remaining
+    deadline and skips rungs that cannot finish, falling through to a
+    cheaper rung instead of dying mid-measurement with nothing banked
+    (the r02–r05 failure class). Conservative by construction: the
+    noise term uses the same moments machinery as the verdicts, and
+    callers typically floor the result at their old flat margin.
+    """
+    hist = stage_seconds_history(entries, stage, platform)[-window:]
+    if not hist:
+        return default
+    mom = Moments(hist)
+    return mom.mean + sigma * mom.std
+
+
 def judge_series(values: List[Optional[float]], *,
                  higher_is_better: bool,
                  window: int = DEFAULT_WINDOW,

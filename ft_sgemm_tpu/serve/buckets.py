@@ -35,6 +35,7 @@ from typing import Iterable, Optional, Sequence, Tuple
 
 from ft_sgemm_tpu.configs import (
     DEFAULT_STRATEGY,
+    EpilogueSpec,
     canonical_in_dtype,
     check_kernel_legality,
 )
@@ -75,6 +76,12 @@ class Bucket:
     k: int
     in_dtype: str = "float32"
     strategy: str = "weighted"
+    # Fused-epilogue spelling (configs.EpilogueSpec) every request this
+    # bucket serves runs: bias/activation/quantize fused into the FT
+    # kernel's detect-correct epilogue — what int8/fp8 serving actually
+    # wants from a GEMM endpoint. "none" (the default) keeps the bucket's
+    # executables byte-identical to the pre-epilogue build.
+    epilogue: str = "none"
 
     def __post_init__(self):
         for field in ("m", "n", "k"):
@@ -89,11 +96,24 @@ class Bucket:
         canon = check_kernel_legality(
             strategy=self.strategy, encode="vpu", in_dtype=self.in_dtype)
         object.__setattr__(self, "in_dtype", canon)
+        # One parser for the epilogue spelling (CLI / tuner key / bucket
+        # field all agree), canonicalized so spellings key stably.
+        object.__setattr__(
+            self, "epilogue", EpilogueSpec.parse(self.epilogue).spelling)
+
+    @property
+    def epilogue_spec(self) -> EpilogueSpec:
+        return EpilogueSpec.parse(self.epilogue)
 
     @property
     def key(self) -> str:
-        """Stable bucket identity: dims, dtype, strategy."""
-        return f"{self.m}x{self.n}x{self.k}|{self.in_dtype}|{self.strategy}"
+        """Stable bucket identity: dims, dtype, strategy — and the fused
+        epilogue when one is configured (historical keys unchanged for
+        epilogue-free buckets)."""
+        base = f"{self.m}x{self.n}x{self.k}|{self.in_dtype}|{self.strategy}"
+        if self.epilogue != "none":
+            base += f"|epi={self.epilogue}"
+        return base
 
     @property
     def volume(self) -> int:
@@ -105,7 +125,8 @@ class Bucket:
 
 def default_bucket_set(sizes: Sequence[int] = (256, 512, 1024),
                        in_dtype: str = "float32",
-                       strategy: Optional[str] = None) -> Tuple[Bucket, ...]:
+                       strategy: Optional[str] = None,
+                       epilogue: str = "none") -> Tuple[Bucket, ...]:
     """A ladder of square buckets — the deliberately SMALL default set.
 
     Square powers of two keep the set prewarmable in seconds and make
@@ -130,7 +151,8 @@ def default_bucket_set(sizes: Sequence[int] = (256, 512, 1024),
             raise ValueError(
                 f"default_bucket_set sizes must be powers of two >= 128"
                 f" (tuner-cache bucket alignment), got {s}")
-        out.append(Bucket(s, s, s, in_dtype=dtype, strategy=strategy))
+        out.append(Bucket(s, s, s, in_dtype=dtype, strategy=strategy,
+                          epilogue=epilogue))
     if not out:
         raise ValueError("default_bucket_set needs at least one size")
     return tuple(out)

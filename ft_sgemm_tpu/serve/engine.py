@@ -92,6 +92,11 @@ class ServeRequest:
     c: Optional[np.ndarray] = None
     in_dtype: str = "float32"
     variant: str = "clean"
+    # Per-request fused-epilogue bias (length n), consumed only when the
+    # serving bucket's epilogue fuses one (Bucket.epilogue "bias+...");
+    # None there means a zero bias. Zero-padded to the bucket width like
+    # every other operand.
+    bias: Optional[np.ndarray] = None
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQ_IDS))
     # Minted at construction (DESIGN.md §12 rule 1): a request that only
@@ -113,6 +118,12 @@ class ServeRequest:
             raise ValueError(
                 f"ServeRequest contraction mismatch: a is {self.a.shape}"
                 f" (m, k), b is {self.b.shape} (n, k)")
+        if self.bias is not None:
+            self.bias = np.asarray(self.bias).reshape(-1)
+            if self.bias.shape[0] != self.b.shape[0]:
+                raise ValueError(
+                    f"ServeRequest.bias must have length n="
+                    f"{self.b.shape[0]}, got {self.bias.shape[0]}")
 
     @property
     def mnk(self) -> Tuple[int, int, int]:
@@ -346,6 +357,7 @@ class ServeEngine:
             tile, alpha=self.alpha, beta=self.beta,
             strategy=bucket.strategy, in_dtype=bucket.in_dtype,
             threshold=self.threshold,
+            epilogue=bucket.epilogue,
             tunable=variant != "adversarial")
         self._kernels[key] = kern
         return kern
@@ -369,13 +381,23 @@ class ServeEngine:
 
             kern = self._kernel(bucket, variant)
             spec = self._variant_spec(bucket, variant)
-            fn = jax.jit(lambda a, b, c: kern(a, b, c, spec))
             a_av = jax.ShapeDtypeStruct((bucket.m, bucket.k), jnp.float32)
             b_av = jax.ShapeDtypeStruct((bucket.n, bucket.k), jnp.float32)
             c_av = jax.ShapeDtypeStruct((bucket.m, bucket.n), jnp.float32)
+            avals = (a_av, b_av, c_av)
+            if bucket.epilogue_spec.bias:
+                # The fused bias is a fourth positional operand of the
+                # bucket's ONE executable — per-request bias values,
+                # zero steady-state recompiles.
+                fn = jax.jit(
+                    lambda a, b, c, bias: kern(a, b, c, spec, bias=bias))
+                avals = avals + (jax.ShapeDtypeStruct((bucket.n,),
+                                                      jnp.float32),)
+            else:
+                fn = jax.jit(lambda a, b, c: kern(a, b, c, spec))
             with self._tl.span(f"compile[{bucket.key}:{variant}]",
                                kind="compile"):
-                compiled = fn.lower(a_av, b_av, c_av).compile()
+                compiled = fn.lower(*avals).compile()
             self._compiled[key] = compiled
             return compiled
 
@@ -534,7 +556,12 @@ class ServeEngine:
         b[:n, :k] = request.b
         if request.c is not None:
             c[:m, :n] = request.c
-        return a, b, c
+        if not bucket.epilogue_spec.bias:
+            return a, b, c
+        bias = np.zeros((bucket.n,), np.float32)
+        if request.bias is not None:
+            bias[:n] = request.bias
+        return a, b, c, bias
 
     def _execute_batch(self, bucket: Bucket, entries: Sequence[_Entry]):
         with self._stats_lock:
@@ -577,13 +604,13 @@ class ServeEngine:
         request = entry.request
         trace_id = request.trace_id
         m, n, _ = request.mnk
-        a, b, c = self._pad_operands(bucket, request)
+        operands = self._pad_operands(bucket, request)
         variant = request.variant
         retries = 0
         res = det = unc = None
         while True:
             compiled = self._get_compiled(bucket, variant)
-            res = compiled(a, b, c)
+            res = compiled(*operands)
             det = int(np.sum(np.asarray(res.detections)))
             unc = int(np.sum(np.asarray(res.uncorrectable)))
             if unc == 0 or retries >= self.max_retries:
@@ -667,6 +694,11 @@ class ServeEngine:
             "variant": request.variant,
             "retries": retries,
             "latency_seconds": round(latency, 6)}
+        if bucket.epilogue != "none":
+            # Epilogue-fused buckets label their events with the fused
+            # spelling; epilogue-free buckets' events stay byte-identical
+            # to the pre-epilogue build.
+            request_extra["epilogue"] = bucket.epilogue
         if telemetry.enabled():
             # Per-request fault attribution: the request's OWN counter
             # grids (not the batch's, not the process's) feed the event,

@@ -65,6 +65,11 @@ class LoadSpec:
     seed: int = 10
     verify: bool = False
     result_timeout: float = 300.0
+    # Fused-epilogue spelling the bucket set serves (Bucket.epilogue);
+    # a bias-fusing epilogue makes every generated request carry its own
+    # bias vector, and verification composes the epilogue oracle
+    # (ops.reference.epilogue_reference) over the GEMM oracle.
+    epilogue: str = "none"
 
 
 def smoke_spec() -> LoadSpec:
@@ -99,7 +104,13 @@ def _gen_request(rng, spec: LoadSpec, buckets) -> ServeRequest:
             pass  # submit() will reject it either way
     elif u < spec.adversarial_rate + spec.inject_rate:
         variant = "inject"
-    return ServeRequest(a=a, b=b, in_dtype=spec.in_dtype, variant=variant)
+    bias = None
+    from ft_sgemm_tpu.configs import EpilogueSpec
+
+    if EpilogueSpec.parse(spec.epilogue).bias:
+        bias = rng.standard_normal((n,)).astype(np.float32)
+    return ServeRequest(a=a, b=b, in_dtype=spec.in_dtype, variant=variant,
+                        bias=bias)
 
 
 def run_load(engine: ServeEngine, spec: LoadSpec, *,
@@ -151,13 +162,21 @@ def run_load(engine: ServeEngine, spec: LoadSpec, *,
             uncorrectable_final += 1
             continue
         if spec.verify:
-            from ft_sgemm_tpu.ops.reference import sgemm_reference
+            from ft_sgemm_tpu.ops.reference import (
+                epilogue_reference,
+                sgemm_reference,
+            )
             from ft_sgemm_tpu.utils.matrices import verify_matrix
 
             m, n, _ = req.mnk
             want = np.asarray(sgemm_reference(
                 req.a, req.b, np.zeros((m, n), np.float32),
                 engine.alpha, engine.beta, in_dtype=req.in_dtype))
+            if spec.epilogue != "none":
+                # The oracle composes the SAME epilogue the bucket
+                # fuses: goodput counts results correct THROUGH the
+                # fused bias/activation/quantize, not just the GEMM.
+                want = epilogue_reference(want, spec.epilogue, req.bias)
             ok, _, _ = verify_matrix(want, res.c, verbose=False)
             if not ok:
                 verify_failures += 1
@@ -208,7 +227,8 @@ def run_serve_bench(*, smoke: bool = False,
                     should_stop: Optional[Callable[[], bool]] = None,
                     progress_out=None,
                     monitor="auto", monitor_port: Optional[int] = None,
-                    slo=None) -> dict:
+                    slo=None,
+                    epilogue: str = "none") -> dict:
     """The serve-bench core shared by ``bench.py --serve`` and
     ``cli serve-bench``: build the bucket set, prewarm it (AOT compile,
     recorded as compile spans), drive the load, and return the artifact
@@ -232,12 +252,14 @@ def run_serve_bench(*, smoke: bool = False,
     """
     sizes = tuple(bucket_sizes) if bucket_sizes else (
         (128, 256) if smoke else (256, 512, 1024))
-    buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+    buckets = default_bucket_set(sizes, in_dtype=in_dtype,
+                                 epilogue=epilogue)
     base = smoke_spec() if smoke else LoadSpec(
         inject_rate=0.2, adversarial_rate=0.05, verify=False)
     spec = dataclasses.replace(
         base,
         in_dtype=in_dtype,
+        epilogue=buckets[0].epilogue,
         num_requests=base.num_requests if num_requests is None
         else int(num_requests),
         inject_rate=base.inject_rate if inject_rate is None
@@ -285,6 +307,7 @@ def run_serve_bench(*, smoke: bool = False,
             stats["prewarm"] = prewarm
             stats["buckets"] = [b.key for b in buckets]
             stats["smoke"] = bool(smoke)
+            stats["epilogue"] = buckets[0].epilogue
             stats["seconds_total"] = round(time.monotonic() - t0, 3)
         if mon is not None:
             # The final SLO/budget + health snapshot the artifact embeds

@@ -354,7 +354,8 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
                 operands=None, alpha: float = 1.0, beta: float = 0.0,
                 extra: Optional[dict] = None,
                 devices: Optional[list] = None,
-                host: Optional[int] = None) -> Optional[FaultEvent]:
+                host: Optional[int] = None,
+                epilogue: Optional[str] = None) -> Optional[FaultEvent]:
     """Record one FT-GEMM call from its materialized result counters.
 
     ``result`` is an :class:`~ft_sgemm_tpu.ops.ft_sgemm.FtSgemmResult`
@@ -387,7 +388,7 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
             operands[2] if len(operands) > 2 else None,
             alpha=alpha, beta=beta)
     if encode is not None or threshold_mode is not None or (
-            variance is not None):
+            variance is not None) or epilogue is not None:
         extra = dict(extra or {})
         if encode is not None:
             extra["encode"] = encode
@@ -395,6 +396,12 @@ def record_gemm(op: str, result, *, strategy: Optional[str] = None,
             extra["threshold_mode"] = threshold_mode
         if variance is not None:
             extra["variance"] = _float_or_none(variance)
+        if epilogue is not None:
+            # The fused-epilogue spelling (configs.EpilogueSpec), e.g.
+            # "bias+relu" — only non-identity epilogues are recorded, so
+            # default calls' events are byte-identical to pre-variant
+            # builds.
+            extra["epilogue"] = epilogue
     event = FaultEvent(
         outcome=outcome, op=op, detected=det, corrected=corrected,
         uncorrectable=unc,

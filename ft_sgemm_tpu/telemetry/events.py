@@ -57,6 +57,15 @@ AXIS_LABELS = {
     # import-free mirror discipline as the kernel axes above (the lint
     # axis-drift pass cross-checks the two spellings).
     "block_phase": ("prefill", "decode"),
+    # Searched kernel-variant axes (PR 13) — mirror configs.GRID_ORDERS /
+    # DIM_SEMANTICS / EPILOGUE_ACTIVATIONS / EPILOGUE_QUANTIZE and
+    # contracts.VARIANT_AXES (lint-cross-checked). The composite epilogue
+    # SPELLING ("bias+relu+qint8") rides event ``extra["epilogue"]``; the
+    # closed per-axis value sets are what label schemas may enumerate.
+    "grid_order": ("mn", "nm"),
+    "dim_semantics": ("parallel", "arbitrary"),
+    "epilogue_activation": ("none", "relu", "gelu"),
+    "epilogue_quantize": ("none", "int8", "float8_e4m3fn"),
 }
 
 

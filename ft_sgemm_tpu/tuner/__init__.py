@@ -43,7 +43,12 @@ import os
 import threading
 from typing import Optional
 
-from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.configs import (
+    EpilogueSpec,
+    KernelShape,
+    KernelVariant,
+    canonical_variant,
+)
 from ft_sgemm_tpu.tuner import cache, measure, space
 from ft_sgemm_tpu.tuner.cache import (
     ENV_CACHE_PATH,
@@ -59,7 +64,11 @@ from ft_sgemm_tpu.tuner.measure import (
     default_method,
     measure_space,
 )
-from ft_sgemm_tpu.tuner.space import enumerate_space, heuristic_shape
+from ft_sgemm_tpu.tuner.space import (
+    enumerate_joint_space,
+    enumerate_space,
+    heuristic_shape,
+)
 
 ENV_TUNING = "FT_SGEMM_TUNING"
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -126,32 +135,87 @@ def override_disabled():
         _LOCAL.off_depth -= 1
 
 
+def variant_key_components(variant: Optional[KernelVariant],
+                           cadence: Optional[int],
+                           epilogue: str = "none") -> dict:
+    """The schema-4 ``pipe=``/``grid=``/``cad=``/``epi=`` key components
+    for one dispatch constraint: ``"auto"`` for every axis the caller
+    left to the search, the explicit spelling for pinned axes. ONE
+    resolver shared by dispatch lookup and the search's store so the two
+    sides can never key differently."""
+    if variant is not None:
+        pipe = str(variant.pipeline_depth)
+        grid = variant.grid_spelling
+    else:
+        pipe = grid = "auto"
+    return {
+        "pipe": pipe,
+        "grid": grid,
+        "cad": "auto" if cadence is None else str(cadence),
+        "epi": EpilogueSpec.parse(epilogue).spelling,
+    }
+
+
+def lookup_winner(
+    m: int, n: int, k: int, *, strategy: Optional[str],
+    in_dtype, injection_enabled: bool,
+    encode: str = "vpu",
+    threshold_mode: str = "static",
+    variant: Optional[KernelVariant] = None,
+    cadence: Optional[int] = None,
+    epilogue: str = "none",
+) -> tuple:
+    """The cached winner for one dispatch site:
+    ``(tile or None, winning KernelVariant or None)``.
+
+    Pure host-side and cheap (one ``os.stat`` + dict probe in the steady
+    state); returns ``(None, None)`` without touching anything when
+    tuning is off, so the no-entry/disabled dispatch path is bit-for-bit
+    the heuristic one. ``variant``/``cadence``/``epilogue`` are the
+    caller's CONSTRAINTS (:func:`variant_key_components`): a pinned axis
+    keys with its explicit spelling and the returned variant echoes the
+    record's — the caller decides which unpinned axes to adopt. A record
+    without a valid ``variant`` field yields ``(tile, None)``.
+    """
+    if not enabled():
+        return None, None
+    comp = variant_key_components(variant, cadence, epilogue)
+    rec = cache.lookup(make_key(m, n, k, strategy=strategy,
+                                in_dtype=in_dtype, encode=encode,
+                                threshold_mode=threshold_mode,
+                                injection_enabled=injection_enabled,
+                                **comp))
+    _count_lookup(rec is not None)
+    if rec is None:
+        return None, None
+    bm, bn, bk = rec["block"]
+    tile = KernelShape(space.candidate_name(bm, bn, bk), bm, bn, bk,
+                       (0,) * 7)
+    win_var = None
+    vrec = rec.get("variant")
+    if isinstance(vrec, dict):
+        try:
+            win_var = canonical_variant(vrec)
+        except ValueError:
+            win_var = None  # stale/foreign record: tile still serves
+    return tile, win_var
+
+
 def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
                 in_dtype, injection_enabled: bool,
                 encode: str = "vpu",
                 threshold_mode: str = "static") -> Optional[KernelShape]:
     """The cached winning tile for one dispatch site, or None (heuristics).
 
-    Pure host-side and cheap (one ``os.stat`` + dict probe in the steady
-    state); returns None without touching anything when tuning is off, so
-    the no-entry/disabled dispatch path is bit-for-bit the heuristic one.
-    ``encode`` is the checksum-encode mode the dispatch will run — a key
-    component since schema 2 (MXU-encode winners differ);
-    ``threshold_mode`` the detection-threshold axis — a component since
-    schema 3 (adaptive kernels carry in-kernel derivation work).
+    The tile-only view of :func:`lookup_winner` (default-variant
+    constraint), kept for callers with no variant axis of their own —
+    the attention factories' QK/PV tile dispatch.
     """
-    if not enabled():
-        return None
-    rec = cache.lookup(make_key(m, n, k, strategy=strategy,
-                                in_dtype=in_dtype, encode=encode,
-                                threshold_mode=threshold_mode,
-                                injection_enabled=injection_enabled))
-    _count_lookup(rec is not None)
-    if rec is None:
-        return None
-    bm, bn, bk = rec["block"]
-    return KernelShape(space.candidate_name(bm, bn, bk), bm, bn, bk,
-                       (0,) * 7)
+    tile, _ = lookup_winner(
+        m, n, k, strategy=strategy, in_dtype=in_dtype,
+        injection_enabled=injection_enabled, encode=encode,
+        threshold_mode=threshold_mode)
+    return tile
 
 
 def tune(
@@ -168,21 +232,34 @@ def tune(
     dry_run: bool = False,
     write_cache: bool = True,
     progress=None,
+    epilogue: str = "none",
+    pipeline_depth: Optional[int] = None,
+    grid_order: Optional[str] = None,
+    dim_semantics: Optional[str] = None,
+    check_every: Optional[int] = None,
+    axis_tile_top: int = 2,
 ) -> dict:
-    """Search the tile family for one problem and persist the winner.
+    """Search the JOINT (tile x variant) space for one problem and
+    persist the winner.
 
     Returns a report dict: the candidate space (feasible + pruned with
-    reasons), per-candidate measurements, the heuristic baseline row, the
-    winner, and the cache key/path written. ``dry_run`` stops after the
-    static prune (nothing measured, nothing written). ``inject`` is False,
-    True (a reference-like schedule), or an explicit ``InjectionSpec``.
-    ``budget`` caps how many candidates are timed (best-guess-first order);
-    None times them all. ``encode`` is a searched dimension since schema
-    2: the same problem tunes (and caches) separately per encode mode —
-    as are ``threshold_mode`` ("static"/"adaptive": adaptive kernels
-    carry in-kernel moment/derivation work) and the low-precision dtypes
-    since schema 3. Illegal (strategy, encode, dtype) combinations (e.g.
-    int8 x mxu) are rejected up front with the kernel factory's error.
+    reasons, per tile AND per variant axis), per-candidate measurements,
+    the heuristic baseline row, the winner, and the cache key/path
+    written. ``dry_run`` stops after the static prune (nothing measured,
+    nothing written). ``inject`` is False, True (a reference-like
+    schedule), or an explicit ``InjectionSpec``. ``budget`` caps how
+    many candidates are timed (best-guess-first order); None times them
+    all. ``encode`` is a searched dimension since schema 2, the
+    threshold mode and low-precision dtypes since schema 3, and the
+    pipeline/grid/cadence variant axes since schema 4 — searched by
+    default (``enumerate_joint_space``'s per-axis pruning names
+    everything not tried), or pinned via ``pipeline_depth`` /
+    ``grid_order`` / ``dim_semantics`` / ``check_every``. ``epilogue``
+    is the workload-owned fused-epilogue spelling: it keys the search
+    (``epi=``) and rides every measured candidate, but is never
+    enumerated against other epilogues. Illegal (strategy, encode,
+    dtype) combinations (e.g. int8 x mxu) are rejected up front with the
+    kernel factory's error.
     """
     from ft_sgemm_tpu.configs import check_kernel_legality
     from ft_sgemm_tpu.injection import InjectionSpec
@@ -194,24 +271,41 @@ def tune(
             strategy=strategy, encode=encode, in_dtype=in_dtype,
             threshold_mode=threshold_mode)
     method = default_method() if method is None else method
-    feasible, pruned = enumerate_space(m, n, k, strategy=strategy,
-                                       encode=encode, in_dtype=in_dtype,
-                                       threshold_mode=threshold_mode)
+    epi = EpilogueSpec.parse(epilogue).spelling
+    pinned_axes = (pipeline_depth is not None or grid_order is not None
+                   or dim_semantics is not None)
+    pin_variant = KernelVariant(
+        pipeline_depth=pipeline_depth if pipeline_depth is not None else 2,
+        grid_order=grid_order if grid_order is not None else "mn",
+        dim_semantics=(dim_semantics if dim_semantics is not None
+                       else "parallel"),
+        epilogue=epi) if pinned_axes else None
+    candidates, pruned = enumerate_joint_space(
+        m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
+        threshold_mode=threshold_mode, epilogue=epi,
+        axis_tile_top=axis_tile_top,
+        pin_pipeline=pipeline_depth, pin_grid_order=grid_order,
+        pin_dim_semantics=dim_semantics, pin_check_every=check_every)
     key = make_key(m, n, k, strategy=strategy, in_dtype=in_dtype,
                    encode=encode, threshold_mode=threshold_mode,
                    injection_enabled=bool(
                        inject.enabled if isinstance(inject, InjectionSpec)
-                       else inject))
+                       else inject),
+                   **variant_key_components(pin_variant, check_every, epi))
     report = {
         "problem": [m, n, k],
         "strategy": "plain" if strategy is None else strategy,
         "encode": "vpu" if strategy is None else encode,
         "in_dtype": str(in_dtype),
         "threshold_mode": "static" if strategy is None else threshold_mode,
+        "epilogue": epi,
         "method": method,
         "key": key,
-        "feasible": [list(s.block) for s in feasible],
-        "pruned": [{"block": list(p.shape.block), "reason": p.reason}
+        "feasible": [{"block": list(c.shape.block),
+                      "variant": variant_asdict(c.variant)}
+                     for c in candidates],
+        "pruned": [{"block": list(p.shape.block), "reason": p.reason,
+                    **({"variant": p.variant} if p.variant else {})}
                    for p in pruned],
     }
     if dry_run:
@@ -222,8 +316,17 @@ def tune(
     # what dispatch would have done, and the report carries both numbers.
     heuristic = heuristic_shape(m, n, k, strategy=strategy,
                                 in_dtype=in_dtype)
-    candidates = [heuristic] + [s for s in feasible
-                                if s.block != heuristic.block]
+    heur_variant = (pin_variant if pin_variant is not None
+                    else KernelVariant(epilogue=epi))
+    if check_every is not None:
+        import dataclasses as _dc
+
+        heur_variant = _dc.replace(heur_variant, check_every=check_every)
+    heur_cand = space.JointCandidate(heuristic, heur_variant)
+    candidates = [heur_cand] + [
+        c for c in candidates
+        if not (c.shape.block == heuristic.block
+                and c.variant == heur_variant)]
     budget_n = None if budget is None else budget + 1
     if isinstance(inject, InjectionSpec):
         spec = inject
@@ -252,6 +355,7 @@ def tune(
             "gflops": best.gflops,
             "seconds_per_call": best.seconds,
             "method": best.method,
+            "variant": variant_asdict(best.variant),
             "heuristic_block": list(heuristic.block),
             "heuristic_gflops": (results[0].gflops
                                  if results and results[0].ok else None),
@@ -261,6 +365,15 @@ def tune(
     return report
 
 
+def variant_asdict(v: Optional[KernelVariant]) -> Optional[dict]:
+    """A JSON-friendly view of one kernel variant (None passes through)."""
+    if v is None:
+        return None
+    import dataclasses as _dc
+
+    return _dc.asdict(v)
+
+
 def dataclasses_asdict(r: MeasureResult) -> dict:
     """A JSON-friendly view of one measurement (KernelShape flattened to
     its block)."""
@@ -268,6 +381,7 @@ def dataclasses_asdict(r: MeasureResult) -> dict:
         "block": r.block, "method": r.method, "ok": r.ok,
         "seconds_per_call": r.seconds, "gflops": r.gflops,
         "score": r.score, "error": r.error,
+        "variant": variant_asdict(r.variant),
     }
 
 
@@ -282,10 +396,12 @@ __all__ = [
     "default_method",
     "device_kind",
     "enabled",
+    "enumerate_joint_space",
     "enumerate_space",
     "heuristic_shape",
     "lookup_stats",
     "lookup_tile",
+    "lookup_winner",
     "make_key",
     "reset_lookup_stats",
     "measure",
@@ -294,4 +410,6 @@ __all__ = [
     "override_disabled",
     "space",
     "tune",
+    "variant_asdict",
+    "variant_key_components",
 ]

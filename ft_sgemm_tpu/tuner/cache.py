@@ -48,7 +48,20 @@ from typing import Optional
 # ignored-with-warning (a clean MISS -> re-tune), never migrated and never
 # an exception: the dtype axis widened at the same time (int8 / fp8 keys)
 # and stale entries must not mis-serve the new spellings.
-SCHEMA_VERSION = 3
+# Schema 4: the kernel VARIANT axes joined the key when the tuner took
+# over the whole kernel (ROADMAP item 4): ``pipe=`` (pipeline depth
+# constraint), ``grid=`` (traversal order + dimension semantics),
+# ``cad=`` (detect/correct cadence), ``epi=`` (fused-epilogue spelling).
+# Unconstrained dispatch keys as ``pipe=auto|grid=auto|cad=auto`` and the
+# RECORD's ``variant`` field carries the winning searched values; a
+# pinned axis keys with its explicit spelling. Epilogues are always
+# concrete (``epi=none`` by default) — an epilogue-fused call must never
+# be served a tile tuned for the bare kernel's register/VPU mix. Like
+# every prior bump, schema-3 files are ignored-with-warning (a clean
+# MISS -> re-tune, pinned in tests/test_variants.py), never migrated:
+# their keys would silently collide every variant's winner onto one
+# entry.
+SCHEMA_VERSION = 4
 ENV_CACHE_PATH = "FT_SGEMM_TUNER_CACHE"
 _DEFAULT_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "ft_sgemm_tpu", "tuner_cache.json")
@@ -111,6 +124,8 @@ def mnk_bucket(m: int, n: int, k: int) -> tuple:
 def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
              in_dtype, injection_enabled: bool, encode: str = "vpu",
              threshold_mode: str = "static",
+             pipe: str = "auto", grid: str = "auto", cad: str = "auto",
+             epi: str = "none",
              device: Optional[str] = None) -> str:
     """The canonical cache key for one dispatch site.
 
@@ -128,6 +143,17 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
     a runtime scalar. The dtype axis needs no spelling change here:
     ``jnp.dtype(...).name`` already keys int8 / float8_e4m3fn distinctly
     (``configs.canonical_in_dtype`` normalizes aliases upstream).
+
+    The variant axes (schema 4) key the dispatch CONSTRAINT, not the
+    winner: ``pipe``/``grid``/``cad`` are ``"auto"`` when the caller
+    left the axis to the search (the record's ``variant`` field then
+    carries the winning value) and the explicit spelling
+    (``pipe="3"``, ``grid="nm.arbitrary"``, ``cad="8"``) when the
+    caller pinned it — a pinned call's tile is tuned for exactly that
+    variant. ``epi`` is the fused-epilogue SPELLING
+    (``configs.EpilogueSpec``, default ``"none"``): always concrete,
+    since the epilogue is workload-owned and changes the winning tile's
+    register/VPU balance.
     """
     from ft_sgemm_tpu.configs import canonical_in_dtype
 
@@ -139,7 +165,8 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
         else "adaptive"
     return (f"{dev}|{bm}x{bn}x{bk}|{canonical_in_dtype(in_dtype)}"
             f"|{strat}|enc={enc}|thr={thr}"
-            f"|inj={int(bool(injection_enabled))}")
+            f"|inj={int(bool(injection_enabled))}"
+            f"|pipe={pipe}|grid={grid}|cad={cad}|epi={epi}")
 
 
 def _valid_block(block) -> bool:

@@ -34,14 +34,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
-from ft_sgemm_tpu.configs import KernelShape
+from ft_sgemm_tpu.configs import DEFAULT_VARIANT, KernelShape, KernelVariant
 
 METHODS = ("wall", "interpret", "compile")
 
 
 @dataclasses.dataclass
 class MeasureResult:
-    """One measured candidate."""
+    """One measured candidate (a tile, at one kernel variant)."""
 
     shape: KernelShape
     method: str
@@ -50,6 +50,7 @@ class MeasureResult:
     gflops: Optional[float] = None
     score: float = float("inf")       # lower is better, any method
     error: Optional[str] = None
+    variant: KernelVariant = DEFAULT_VARIANT
 
     @property
     def block(self):
@@ -65,19 +66,38 @@ def default_method() -> str:
 
 def _build_fn(shape: KernelShape, *, strategy: Optional[str], in_dtype: str,
               inject, alpha: float, beta: float, interpret: Optional[bool],
-              encode: str = "vpu", threshold_mode: str = "static"):
-    """fn(a, b, c) -> array for one candidate, clean or injected."""
+              encode: str = "vpu", threshold_mode: str = "static",
+              variant: Optional[KernelVariant] = None):
+    """fn(a, b, c) -> array for one candidate, clean or injected.
+
+    ``variant`` pins the full kernel-variant descriptor on the factory
+    (explicit variants bypass winner application, exactly as explicit
+    shapes bypass the tile cache — a measurement must run the variant
+    its row label claims). A bias-fusing epilogue gets a deterministic
+    all-ones bias so the measured program is the program dispatch will
+    run."""
+    import numpy as np
+
     from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
     from ft_sgemm_tpu.ops.sgemm import make_sgemm
 
+    variant = DEFAULT_VARIANT if variant is None else variant
     if strategy is None:
-        return make_sgemm(shape, alpha=alpha, beta=beta, in_dtype=in_dtype,
-                          interpret=interpret)
+        fn = make_sgemm(shape, alpha=alpha, beta=beta, in_dtype=in_dtype,
+                        interpret=interpret, variant=variant)
+        if variant.epilogue_spec.bias:
+            return lambda a, b, c: fn(
+                a, b, c, bias=np.ones((c.shape[1],), np.float32))
+        return fn
     threshold = ("adaptive" if threshold_mode == "adaptive"
                  else "auto" if threshold_mode == "auto" else "static")
     ft = make_ft_sgemm(shape, alpha=alpha, beta=beta, strategy=strategy,
                        encode=encode, threshold=threshold,
-                       in_dtype=in_dtype, interpret=interpret)
+                       in_dtype=in_dtype, interpret=interpret,
+                       variant=variant)
+    if variant.epilogue_spec.bias:
+        return lambda a, b, c: ft(
+            a, b, c, inject, bias=np.ones((c.shape[1],), np.float32)).c
     return lambda a, b, c: ft(a, b, c, inject).c
 
 
@@ -119,9 +139,11 @@ def measure_candidate(
     method: Optional[str] = None,
     alpha: float = 1.0, beta: float = -1.5,
     reps: int = 3, samples: int = 3,
+    variant: Optional[KernelVariant] = None,
 ) -> MeasureResult:
-    """Measure ONE candidate tile; failures are recorded, never raised
-    (a search must survive a candidate the static model wrongly admitted).
+    """Measure ONE candidate (tile x variant); failures are recorded,
+    never raised (a search must survive a candidate the static model
+    wrongly admitted).
     """
     import jax
     import jax.numpy as jnp
@@ -133,6 +155,7 @@ def measure_candidate(
     if method not in METHODS:
         raise ValueError(f"unknown method {method!r}; pick from {METHODS}")
     inject = inject or InjectionSpec.none()
+    variant = DEFAULT_VARIANT if variant is None else variant
     m, n = c.shape
     k = a.shape[1]
     interpret = True if method == "interpret" else None
@@ -140,7 +163,7 @@ def measure_candidate(
         fn = _build_fn(shape, strategy=strategy, encode=encode,
                        threshold_mode=threshold_mode,
                        in_dtype=in_dtype, inject=inject, alpha=alpha,
-                       beta=beta, interpret=interpret)
+                       beta=beta, interpret=interpret, variant=variant)
         if method == "compile":
             args = (jax.ShapeDtypeStruct(a.shape, jnp.dtype(in_dtype)),
                     jax.ShapeDtypeStruct(b.shape, jnp.dtype(in_dtype)),
@@ -148,18 +171,22 @@ def measure_candidate(
             jax.jit(fn).lower(*args).compile()
             # Rank compiled-only candidates by grid-step count: fewer,
             # bigger steps is the measured direction at every swept size
-            # (configs.SHAPES provenance). A proxy, not a measurement —
-            # the record says so via method="compile".
+            # (configs.SHAPES provenance) — and a deep pipeline's wider
+            # K window means fewer steps, mirroring its intent. A proxy,
+            # not a measurement — the record says so via
+            # method="compile".
+            kwin = shape.bk * (variant.pipeline_depth - 1)
             steps = (-(-m // shape.bm)) * (-(-n // shape.bn)) * (
-                -(-k // shape.bk))
-            return MeasureResult(shape, method, ok=True, score=float(steps))
+                -(-k // kwin))
+            return MeasureResult(shape, method, ok=True,
+                                 score=float(steps), variant=variant)
         sec = median_seconds_per_call(fn, a, b, c, reps=reps,
                                       samples=samples)
         gf = 2.0 * m * n * k / 1e9 / sec
         return MeasureResult(shape, method, ok=True, seconds=sec,
-                             gflops=gf, score=sec)
+                             gflops=gf, score=sec, variant=variant)
     except Exception as e:  # noqa: BLE001 — sweep must survive bad tiles
-        return MeasureResult(shape, method, ok=False,
+        return MeasureResult(shape, method, ok=False, variant=variant,
                              error=f"{type(e).__name__}: {str(e)[:200]}")
 
 
@@ -177,7 +204,9 @@ def measure_space(
     progress=None,
 ) -> list:
     """Measure up to ``budget`` candidates (order preserved — callers pass
-    the best-guess-first list from :func:`..space.enumerate_space`).
+    the best-guess-first list from :func:`..space.enumerate_space` or
+    the joint :func:`..space.enumerate_joint_space`; bare
+    ``KernelShape`` entries measure at the default variant).
     Returns the list of :class:`MeasureResult`. ``progress`` is an optional
     ``fn(result)`` callback (the CLI streams rows as they land, so a
     killed search still printed everything it measured).
@@ -189,13 +218,16 @@ def measure_space(
     results = []
     strat_label = "plain" if strategy is None else strategy
     with telemetry.trace_span("tuner_measure"):
-        for shape in picked:
+        for cand in picked:
+            shape = getattr(cand, "shape", cand)
+            cand_variant = getattr(cand, "variant", None)
             a, b, c = _inputs_memo(m, n, k, in_dtype)
             res = measure_candidate(
                 shape, a, b, c, strategy=strategy, encode=encode,
                 threshold_mode=threshold_mode,
                 in_dtype=in_dtype, inject=inject, method=method,
-                alpha=alpha, beta=beta, reps=reps, samples=samples)
+                alpha=alpha, beta=beta, reps=reps, samples=samples,
+                variant=cand_variant)
             results.append(res)
             if telemetry.enabled():
                 reg = telemetry.get_registry()

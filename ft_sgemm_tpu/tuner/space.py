@@ -23,7 +23,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence, Tuple
 
-from ft_sgemm_tpu.configs import SHAPES, KernelShape, shape_for_dtype
+from ft_sgemm_tpu.configs import (
+    SHAPES,
+    EpilogueSpec,
+    KernelShape,
+    KernelVariant,
+    shape_for_dtype,
+)
 from ft_sgemm_tpu.ops.vmem import MIB, estimate_vmem_bytes
 
 # Dimension menus: multiples of 128 spanning the shipped family and the
@@ -36,7 +42,8 @@ BK_MENU = (128, 256, 512, 1024, 2048)
 
 
 def variant_for(strategy: Optional[str], *, single_check: bool = True,
-                encode: str = "vpu", threshold_mode: str = "static") -> str:
+                encode: str = "vpu",
+                threshold_mode: str = "static") -> str:
     """The :data:`~ft_sgemm_tpu.ops.vmem.TEMP_TILE_FACTORS` key a strategy's
     dispatch will actually run at the tuner's measurement settings.
 
@@ -46,7 +53,10 @@ def variant_for(strategy: Optional[str], *, single_check: bool = True,
     its default single-final-check VPU cadence runs the lighter
     precomputed-expectations body — EXCEPT under ``threshold_mode=
     "adaptive"``, whose moment statistics need the in-kernel encode.
-    ``None`` is the plain (non-FT) kernel.
+    ``None`` is the plain (non-FT) kernel. This is also how the CADENCE
+    axis is priced (ops/vmem docstring): an intermediate cadence on the
+    weighted strategy is ``single_check=False`` — the running-partial-sum
+    body, two VMEM units heavier.
     """
     from ft_sgemm_tpu.ops.ft_sgemm import resolve_kernel_strategy
 
@@ -69,11 +79,23 @@ def candidate_name(bm: int, bn: int, bk: int) -> str:
 
 @dataclasses.dataclass(frozen=True)
 class PrunedCandidate:
-    """A candidate rejected before measurement, with the reason."""
+    """A candidate rejected before measurement, with the reason.
+
+    ``variant`` names the variant-axis spelling the prune applies to
+    (None = the tile itself was pruned, every variant with it)."""
 
     shape: KernelShape
     reason: str
     est_bytes: Optional[int] = None
+    variant: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class JointCandidate:
+    """One point of the joint (tile x variant) search space."""
+
+    shape: KernelShape
+    variant: KernelVariant
 
 
 def heuristic_shape(m: int, n: int, k: int, *, strategy: Optional[str],
@@ -162,3 +184,193 @@ def enumerate_space(
 
     feasible.sort(key=score)
     return feasible, pruned
+
+
+def default_cadence_menu(strategy: Optional[str]) -> Tuple[int, ...]:
+    """The detect/correct cadences the joint search explores beyond the
+    strategy's auto default (the reference's ~K/20 rule for rowcol/
+    global, the single deferred final check for weighted/fused). Small
+    explicit cadences are where the MTBF-vs-overhead tradeoff actually
+    lives (arXiv 2305.01024 / 2305.02444): every-step and every-other-
+    step checking bound the per-fault exposure window at measured cost.
+    The plain kernel has no checks, hence no cadence axis."""
+    return () if strategy is None else (1, 2)
+
+
+def enumerate_joint_space(
+    m: int, n: int, k: int, *,
+    strategy: Optional[str] = "weighted",
+    encode: str = "vpu",
+    in_dtype: str = "float32",
+    threshold_mode: str = "static",
+    epilogue: str = "none",
+    limit: Optional[int] = None,
+    axis_tile_top: int = 2,
+    pin_pipeline: Optional[int] = None,
+    pin_grid_order: Optional[str] = None,
+    pin_dim_semantics: Optional[str] = None,
+    pin_check_every: Optional[int] = None,
+    bm_menu: Sequence[int] = BM_MENU,
+    bn_menu: Sequence[int] = BN_MENU,
+    bk_menu: Sequence[int] = BK_MENU,
+) -> Tuple[list, list]:
+    """Enumerate and prune the JOINT (tile x variant) space.
+
+    Returns ``(candidates, pruned)``: ``candidates`` a best-guess-first
+    list of :class:`JointCandidate`; ``pruned`` the
+    :class:`PrunedCandidate` list naming every rejection — tiles dropped
+    by the base enumeration (problem fit / VMEM) and variant axes
+    dropped per tile, each with its reason (a search report must say
+    what it did NOT try; acceptance criterion of ISSUE 13).
+
+    ``epilogue`` is the workload-owned epilogue spelling: it rides every
+    candidate (and the cache key) but is never enumerated — a fused-
+    epilogue deployment tunes for its own epilogue, not against others.
+    ``pin_*`` arguments pin one axis to an explicit value (the
+    corresponding key component then spells that value; the search
+    explores only it). Per-axis pruning, in order:
+
+      1. every axis value that is structurally degenerate for the
+         problem (pipeline depth 3 on a single-panel K; grid order on a
+         single-output-tile grid; cadences at or past the K-grid depth);
+      2. VMEM: depth-3 windows and intermediate-cadence running-sum
+         bodies re-priced through ``ops/vmem`` (the cadence pricing —
+         weighted's in-kernel encode body — is ``variant_for``'s
+         ``single_check=False`` resolution);
+      3. search budget: non-default axis values are explored on the top
+         ``axis_tile_top`` tiles only, one axis at a time (the named
+         ``joint-axis exploration capped`` reason) — the axes are
+         near-separable from the tile choice, and a full cross product
+         would burn the measurement budget the tiles need.
+    """
+    from ft_sgemm_tpu.configs import (
+        DIM_SEMANTICS,
+        GRID_ORDERS,
+        PIPELINE_DEPTHS,
+        canonical_in_dtype,
+        vmem_limit_bytes,
+    )
+
+    if limit is None:
+        limit = vmem_limit_bytes()
+    import jax.numpy as jnp
+
+    epi = EpilogueSpec.parse(epilogue).spelling
+    itemsize = jnp.dtype(canonical_in_dtype(in_dtype)).itemsize
+    adaptive = threshold_mode == "adaptive"
+    exact = canonical_in_dtype(in_dtype) == "int8" and strategy is not None
+    base_variant = variant_for(strategy, encode=encode,
+                               threshold_mode=threshold_mode)
+    cadence_body = variant_for(strategy, single_check=False, encode=encode,
+                               threshold_mode=threshold_mode)
+    tiles, pruned = enumerate_space(
+        m, n, k, strategy=strategy, encode=encode, in_dtype=in_dtype,
+        threshold_mode=threshold_mode, limit=limit,
+        bm_menu=bm_menu, bn_menu=bn_menu, bk_menu=bk_menu)
+    kpad = _round_up(k, 128)
+    mpad = _round_up(m, 128)
+    npad = _round_up(n, 128)
+
+    depth_menu = (PIPELINE_DEPTHS if pin_pipeline is None
+                  else (pin_pipeline,))
+    order_menu = (GRID_ORDERS if pin_grid_order is None
+                  else (pin_grid_order,))
+    sem_menu = (DIM_SEMANTICS if pin_dim_semantics is None
+                else (pin_dim_semantics,))
+    cad_menu = (default_cadence_menu(strategy) if pin_check_every is None
+                else (pin_check_every,))
+
+    def est(shape, body, depth):
+        return estimate_vmem_bytes(shape, body, in_itemsize=itemsize,
+                                   adaptive=adaptive, exact=exact,
+                                   pipeline_depth=depth)
+
+    candidates = []
+    for t_idx, s in enumerate(tiles):
+        default = KernelVariant(
+            pipeline_depth=(pin_pipeline or 2),
+            grid_order=(pin_grid_order or "mn"),
+            dim_semantics=(pin_dim_semantics or "parallel"),
+            check_every=pin_check_every, epilogue=epi)
+        candidates.append(JointCandidate(s, default))
+        axis_variants = []
+        for depth in depth_menu:
+            if depth == default.pipeline_depth:
+                continue
+            if kpad < (depth - 1) * s.bk:
+                pruned.append(PrunedCandidate(
+                    s, f"pipeline depth {depth} needs {depth - 1} K"
+                    f" panels of bk={s.bk}; 128-padded K is {kpad}",
+                    variant=f"pipe={depth}"))
+                continue
+            e = est(s, base_variant, depth)
+            if e > limit:
+                pruned.append(PrunedCandidate(
+                    s, f"pipeline depth {depth} predicted"
+                    f" ~{e / MIB:.1f} MiB scoped VMEM >"
+                    f" {limit / MIB:.0f} MiB limit ({base_variant})",
+                    est_bytes=e, variant=f"pipe={depth}"))
+                continue
+            axis_variants.append(dataclasses.replace(
+                default, pipeline_depth=depth))
+        gm_t = -(-mpad // s.bm)
+        gn_t = -(-npad // s.bn)
+        for order in order_menu:
+            if order == default.grid_order:
+                continue
+            if gm_t == 1 or gn_t == 1:
+                pruned.append(PrunedCandidate(
+                    s, "grid traversal order is degenerate: one of the"
+                    " output-tile dims has a single 128-granule tile",
+                    variant=f"grid={order}"))
+                continue
+            axis_variants.append(dataclasses.replace(
+                default, grid_order=order))
+        for sem in sem_menu:
+            if sem == default.dim_semantics:
+                continue
+            axis_variants.append(dataclasses.replace(
+                default, dim_semantics=sem))
+        nk_tile = -(-kpad // s.bk)
+        for cad in cad_menu:
+            if cad is None or cad == default.check_every:
+                continue
+            if cad >= nk_tile:
+                pruned.append(PrunedCandidate(
+                    s, f"cadence {cad} >= K-grid depth {nk_tile}:"
+                    " identical to the auto final check",
+                    variant=f"cad={cad}"))
+                continue
+            if cadence_body != base_variant:
+                e = est(s, cadence_body, default.pipeline_depth)
+                if e > limit:
+                    pruned.append(PrunedCandidate(
+                        s, f"cadence {cad} needs the running-partial-sum"
+                        f" body ({cadence_body}): predicted"
+                        f" ~{e / MIB:.1f} MiB scoped VMEM >"
+                        f" {limit / MIB:.0f} MiB limit",
+                        est_bytes=e, variant=f"cad={cad}"))
+                    continue
+            axis_variants.append(dataclasses.replace(
+                default, check_every=cad))
+        if t_idx < axis_tile_top:
+            candidates.extend(JointCandidate(s, v) for v in axis_variants)
+        else:
+            for v in axis_variants:
+                delta = [p for p in
+                         (f"pipe={v.pipeline_depth}"
+                          if v.pipeline_depth != default.pipeline_depth
+                          else None,
+                          f"grid={v.grid_order}"
+                          if v.grid_order != default.grid_order else None,
+                          f"sem={v.dim_semantics}"
+                          if v.dim_semantics != default.dim_semantics
+                          else None,
+                          f"cad={v.check_every}"
+                          if v.check_every != default.check_every
+                          else None) if p]
+                pruned.append(PrunedCandidate(
+                    s, f"joint-axis exploration capped to top"
+                    f" {axis_tile_top} tiles (search budget)",
+                    variant="+".join(delta) or "variant"))
+    return candidates, pruned
