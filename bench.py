@@ -954,12 +954,42 @@ def _emit_locked(values, errors, extra_errors=None):
         if tpath:
             context["timeline"] = os.path.basename(tpath)
     context["errors"] = errors
+    metric = "abft_kernel_huge_gflops_4096"
+    value = None if ft is None else round(ft, 1)
+    vs_baseline = (None if ft is None
+                   else round(ft / REFERENCE_ABFT_HUGE_GFLOPS, 3))
+    if ft is None and isinstance(fb, dict):
+        # Platform-honest CPU headline (ROADMAP item 1): the TPU 4096
+        # headline cannot exist on this host, but the VERIFIED fallback
+        # smoke did measure the injected-and-corrected FT kernel —
+        # promote its warm-path GFLOPS under a metric that says exactly
+        # what it measured (smoke tile at SMOKE_SIZE) instead of
+        # emitting another value:null artifact. bench-compare reads the
+        # differing metric vs the TPU baseline as incomparable (exit 0,
+        # never a fake ratio — vs_baseline stays null), and the trend
+        # plane gates the new (metric, platform) series against its own
+        # history.
+        row = (fb.get("encode_modes") or {}).get("vpu") or {}
+        warm = row.get("warm_seconds")
+        if isinstance(warm, (int, float)) and warm > 0 \
+                and row.get("corrected_ok") \
+                and not row.get("uncorrectable"):
+            value = round(2.0 * SMOKE_SIZE**3 / 1e9 / warm, 3)
+            metric = f"abft_kernel_smoke_gflops_{SMOKE_SIZE}"
+            context["headline_fallback"] = {
+                "reason": "no TPU backend: smoke-tile headline on "
+                          + str(context.get("platform_used")
+                                or context.get("backend") or "unknown"),
+                "size": SMOKE_SIZE,
+                "warm_seconds": warm,
+                "strategy": "rowcol",
+                "encode": "vpu",
+            }
     artifact = {
-        "metric": "abft_kernel_huge_gflops_4096",
-        "value": None if ft is None else round(ft, 1),
+        "metric": metric,
+        "value": value,
         "unit": "GFLOPS",
-        "vs_baseline": (None if ft is None
-                        else round(ft / REFERENCE_ABFT_HUGE_GFLOPS, 3)),
+        "vs_baseline": vs_baseline,
         "context": context,
     }
     print(json.dumps(artifact), flush=True)
@@ -2447,6 +2477,15 @@ def serve_main(argv):
     stored-state fault counters (``kv_faults`` /
     ``kv_corrected_in_place`` / ``kv_page_restores``) in context;
     ``--decode-ratio=R`` and ``--kv-corrupt-rate=R`` shape the mix.
+    ``--pool`` (GEMM workload) runs the MULTI-DEVICE pool stage
+    (``serve/pool.py``): the same load drives the single-device engine
+    and then a health-steered device pool over every local device —
+    per-device AOT replicas, bounded async in-flight, a marked-sick
+    device drained (``--sick-device=N``, default 1, ``none`` disables)
+    — and the artifact reports goodput scaling (``context.scaling``),
+    per-device placement (``context.pool.per_device``), and the drain
+    outcome; rc!=0 unless placement spread over >1 device and the sick
+    device was drained.
     Flags: ``--smoke`` (the CPU/CI scenario),
     ``--requests=N``, ``--inject-rate=R``, ``--adversarial-rate=R``,
     ``--rate=RPS``, ``--buckets=256,512`` (block: padded SEQ sizes),
@@ -2458,13 +2497,20 @@ def serve_main(argv):
     plus a RunReport whose SLO section ``cli report`` renders.
     """
     smoke = "--smoke" in argv
+    pool = "--pool" in argv
     workload = "gemm"
     kw = {}
     bad = None
     sizes = None
     for f in argv:
         try:
-            if f.startswith("--workload="):
+            if f.startswith("--sick-device="):
+                # Pool drain self-test knob (serve/pool.py mark_sick):
+                # which pool device is marked sick before the load;
+                # "none" disables the marking.
+                val = f.split("=", 1)[1]
+                kw["sick_device"] = None if val == "none" else int(val)
+            elif f.startswith("--workload="):
                 workload = f.split("=", 1)[1]
                 if workload not in ("gemm", "block"):
                     raise ValueError(
@@ -2506,6 +2552,11 @@ def serve_main(argv):
                     " --workload=block"
     elif "epilogue" in kw:
         bad = "--epilogue= needs --workload=gemm"
+    if pool and block:
+        bad = "--pool needs --workload=gemm (the block engine is not"\
+            " pool-dispatched yet)"
+    if not pool and "sick_device" in kw:
+        bad = "--sick-device= needs --pool"
     if bad:
         print(json.dumps({"metric": metric, "value": None,
                           "unit": unit, "vs_baseline": None,
@@ -2526,7 +2577,7 @@ def serve_main(argv):
     signal.signal(signal.SIGINT, on_signal)
 
     context = {"serve": True, "smoke": smoke, "workload": workload,
-               "errors": {}}
+               "pool": pool, "errors": {}}
     tl = (_make_timeline(None)
           if os.environ.get("FT_SGEMM_BENCH_TIMELINE") else _NoTimeline())
     try:
@@ -2562,6 +2613,13 @@ def serve_main(argv):
                                           should_stop=stop.is_set,
                                           progress_out=sys.stderr, **kw)
             value = stats.get("goodput_tps")
+        elif pool:
+            from ft_sgemm_tpu.serve import run_pool_serve_bench
+
+            stats = run_pool_serve_bench(smoke=smoke, timeline=tl,
+                                         should_stop=stop.is_set,
+                                         progress_out=sys.stderr, **kw)
+            value = stats.get("goodput_rps")
         else:
             from ft_sgemm_tpu.serve import run_serve_bench
 
@@ -2588,7 +2646,7 @@ def serve_main(argv):
         # (ISSUE 9: the artifact embeds the SLO/budget snapshot).
         from ft_sgemm_tpu.perf.report import RunReport, build_manifest
 
-        serve_extra = {"serve": True, "workload": workload}
+        serve_extra = {"serve": True, "workload": workload, "pool": pool}
         lint = _lint_facts()
         if lint is not None:
             serve_extra["lint"] = lint
@@ -2607,6 +2665,14 @@ def serve_main(argv):
           and context.get("completed", 0) > 0
           and context.get("correct") == context.get("completed")
           and context.get("whole_queue_retries", 0) == 0)
+    if ok and pool:
+        # The pool stage's own acceptance facts: placement actually
+        # spread over the mesh, and a marked-sick device was drained.
+        pool_stats = context.get("pool")
+        pool_stats = pool_stats if isinstance(pool_stats, dict) else {}
+        ok = (pool_stats.get("devices_used", 0) > 1
+              and (context.get("sick_device") is None
+                   or bool(context.get("sick_device_drained"))))
     return 0 if ok else 1
 
 

@@ -48,21 +48,24 @@ Usage:
         [--plain] [--inject] [--budget=N] \
         [--reps=N] [--samples=N] [--method=wall|interpret|compile] \
         [--dry-run] [--prewarm]
+    python -m ft_sgemm_tpu.cli tune-ring [SIZE | M N K] \
+        [--strategy=...] [--dtype=...] [--plain] \
+        [--method=wall|cost] [--dry-run]
     python -m ft_sgemm_tpu.cli tune-show
     python -m ft_sgemm_tpu.cli prewarm [SIZE] [--dry-run] \
         [--timeline=RUN.timeline.jsonl]
     python -m ft_sgemm_tpu.cli report ARTIFACT.json [--format=md|json]
     python -m ft_sgemm_tpu.cli bench-compare BASELINE.json CANDIDATE.json \
         [--tolerance=0.10] [--format=text|json]
-    python -m ft_sgemm_tpu.cli serve [--workload=gemm|block] \
+    python -m ft_sgemm_tpu.cli serve [--workload=gemm|block] [--pool] \
         [--buckets=256,512] [--dtype=...] [--epilogue=SPEC] \
         [--requests=N] [--inject-rate=R] [--telemetry=LOG.jsonl] \
-        [--monitor-port=N] [--dry-run]
+        [--sick-device=N|none] [--monitor-port=N] [--dry-run]
     python -m ft_sgemm_tpu.cli serve-bench [--smoke] \
-        [--workload=gemm|block] [--buckets=...] [--epilogue=SPEC] \
-        [--requests=N] [--inject-rate=R] [--rate=RPS] \
+        [--workload=gemm|block] [--pool] [--buckets=...] \
+        [--epilogue=SPEC] [--requests=N] [--inject-rate=R] [--rate=RPS] \
         [--decode-ratio=R] [--kv-corrupt-rate=R] \
-        [--monitor-port=N] [--out=ARTIFACT.json]
+        [--sick-device=N|none] [--monitor-port=N] [--out=ARTIFACT.json]
     python -m ft_sgemm_tpu.cli history [LEDGER.jsonl] \
         [--limit=N] [--format=text|json]
     python -m ft_sgemm_tpu.cli trend [LEDGER.jsonl] [--gate] \
@@ -213,6 +216,20 @@ Goodput becomes tokens-correct-per-second; ``--decode-ratio=R`` sets
 the prefill/decode mix and ``--kv-corrupt-rate=R`` the stored-page
 corruption rate (the block workload's ``--buckets=`` values are padded
 SEQUENCE sizes).
+
+``--pool`` (GEMM workload; DESIGN.md §17) runs the MULTI-DEVICE pool
+stage: the same load drives the single-device engine and then a
+health-steered device pool over every local device — per-device AOT
+executable replicas, placement by ``DeviceHealthTracker`` score over
+queue depth (sick devices drain, not schedule), a bounded async
+in-flight window per device worker — reporting goodput scaling vs the
+single-device control, per-device placement, and the
+``--sick-device=N`` drain self-test outcome (``none`` disables the
+marking). The ring collective paths' hop schedule is the related
+``ring_overlap`` axis (``--ring-overlap=serial|overlap`` on the ring
+entry points; ``tune-ring`` searches it — wall-timed on TPU, priced by
+the compute/ICI cost model elsewhere — and banks the winner the
+``auto`` dispatch spelling serves).
 
 Live monitoring (``ft_sgemm_tpu.telemetry.monitor``, DESIGN.md §12):
 ``--monitor-port=N`` on ``serve`` / ``serve-bench`` starts the stdlib
@@ -1113,6 +1130,52 @@ def run_tune(args, flags, out=None) -> int:
     return 0
 
 
+def run_tune_ring(args, flags, out=None) -> int:
+    """``tune-ring`` subcommand: search the ring hop-schedule axis
+    (``--ring-overlap=serial|overlap`` is the dispatch pin; this banks
+    the searched winner) for one global ring problem and persist it
+    under the per-device local-shard key (``tuner.tune_ring``)."""
+    out = sys.stdout if out is None else out
+    from ft_sgemm_tpu import tuner
+
+    size = 1024
+    dims = [int(a) for a in args[:3]] if args else [size]
+    m = dims[0]
+    n = dims[1] if len(dims) > 1 else None
+    k = dims[2] if len(dims) > 2 else None
+    strategy = "weighted"
+    in_dtype = "float32"
+    method = None
+    write_cache = "--dry-run" not in flags
+    for f in flags:
+        if f.startswith("--strategy="):
+            strategy = f.split("=", 1)[1]
+        elif f.startswith("--dtype="):
+            in_dtype = canonical_in_dtype(f.split("=", 1)[1])
+        elif f.startswith("--method="):
+            method = f.split("=", 1)[1]
+    if "--plain" in flags:
+        strategy = None
+    print_device_info(out=sys.stderr)
+    try:
+        report = tuner.tune_ring(m, n, k, strategy=strategy,
+                                 in_dtype=in_dtype, method=method,
+                                 write_cache=write_cache)
+    except ValueError as e:
+        print(f"ft_sgemm: tune-ring: {e}", file=sys.stderr)
+        return 2
+    for mode in ("serial", "overlap"):
+        row = report[mode]
+        extra = (f"  {row['gflops']:.1f} GFLOP/s"
+                 if row.get("gflops") else "")
+        print(f"  {mode:<8s} score={row['score']:.3e}{extra}", file=out)
+    print(f"winner: {report['winner']}  (method={report['method']},"
+          f" ring size {report['d']})", file=out)
+    if write_cache:
+        print(f"cached under {report['key']}", file=out)
+    return 0
+
+
 def run_roc(flags, out=None) -> int:
     """``roc`` subcommand: the static-vs-adaptive threshold ROC sweep.
 
@@ -1358,9 +1421,13 @@ def _parse_serve_flags(flags):
     kw = {}
     workload = "gemm"
     sizes = None
+    pool = "--pool" in flags
     for f in flags:
         try:
-            if f.startswith("--workload="):
+            if f.startswith("--sick-device="):
+                val = f.split("=", 1)[1]
+                kw["sick_device"] = None if val == "none" else int(val)
+            elif f.startswith("--workload="):
                 workload = f.split("=", 1)[1]
                 if workload not in ("gemm", "block"):
                     raise ValueError(
@@ -1396,8 +1463,15 @@ def _parse_serve_flags(flags):
                                     " --workload=block")
     elif "epilogue" in kw:
         return None, None, "--epilogue= needs --workload=gemm"
+    if pool and workload == "block":
+        return None, None, ("--pool needs --workload=gemm (the block"
+                            " engine is not pool-dispatched yet)")
+    if not pool and "sick_device" in kw:
+        return None, None, "--sick-device= needs --pool"
     if sizes is not None:
         kw["seq_sizes" if workload == "block" else "bucket_sizes"] = sizes
+    if pool:
+        workload = "pool"
     return workload, kw, None
 
 
@@ -1424,6 +1498,7 @@ def run_serve(flags, out=None) -> int:
         return 2
     in_dtype = kw.pop("in_dtype", "float32")
     block = workload == "block"
+    pool = workload == "pool"
     try:
         if block:
             sizes = kw.pop("seq_sizes", None) or (128, 256)
@@ -1444,6 +1519,11 @@ def run_serve(flags, out=None) -> int:
         print(f"serve (dry run): {len(buckets)} {workload} buckets, "
               "compile cache "
               + (f"at {path}" if path else f"OFF ({reason})"), file=out)
+        if pool:
+            print("  pool: per-device AOT replicas over every local"
+                  " device, health-steered placement"
+                  f" (sick-device self-test: {kw.get('sick_device', 1)})",
+                  file=out)
         for b in buckets:
             if block:
                 # Block buckets dispatch explicit per-bucket tiles (the
@@ -1474,13 +1554,18 @@ def run_serve(flags, out=None) -> int:
 
         telemetry.configure(telemetry_log, log_clean=True)
     print_device_info()
-    from ft_sgemm_tpu.serve import run_block_serve_bench, run_serve_bench
+    from ft_sgemm_tpu.serve import (
+        run_block_serve_bench, run_pool_serve_bench, run_serve_bench)
 
     try:
         if block:
             stats = run_block_serve_bench(smoke=True, in_dtype=in_dtype,
                                           seq_sizes=sizes, verify=True,
                                           progress_out=sys.stderr, **kw)
+        elif pool:
+            stats = run_pool_serve_bench(smoke=True, in_dtype=in_dtype,
+                                         bucket_sizes=sizes, verify=True,
+                                         progress_out=sys.stderr, **kw)
         else:
             stats = run_serve_bench(smoke=True, in_dtype=in_dtype,
                                     bucket_sizes=sizes, verify=True,
@@ -1509,6 +1594,17 @@ def run_serve(flags, out=None) -> int:
     else:
         print(f"  goodput {stats['goodput_rps']} correct req/s  "
               f"(throughput {stats['throughput_rps']} req/s)", file=out)
+    if pool:
+        scaling = stats.get("scaling") or {}
+        ps = stats.get("pool") or {}
+        print(f"  pool: {ps.get('devices_used')}/{ps.get('devices')} "
+              f"devices used  scaling x{scaling.get('throughput_ratio')}"
+              f"  sick {stats.get('sick_device')} drained="
+              f"{stats.get('sick_device_drained')}", file=out)
+        for label, row in sorted((ps.get("per_device") or {}).items()):
+            print(f"    {label:<16s} batches={row['batches']:<3d} "
+                  f"requests={row['requests']:<4d} "
+                  f"health={row['health']}", file=out)
     print(f"  latency p50<={stats['p50_latency_seconds']}s "
           f"p99<={stats['p99_latency_seconds']}s", file=out)
     print(f"  corrected free: {stats['corrected_free']}   bucket retries: "
@@ -1556,6 +1652,18 @@ def run_serve_bench_cmd(flags, out=None) -> int:
             "metric": "serve_block_goodput_tps",
             "value": stats.get("goodput_tps"),
             "unit": "tokens/s",
+            "vs_baseline": None,
+            "context": stats,
+        }
+    elif workload == "pool":
+        from ft_sgemm_tpu.serve import run_pool_serve_bench
+
+        stats = run_pool_serve_bench(smoke="--smoke" in flags,
+                                     progress_out=sys.stderr, **kw)
+        artifact = {
+            "metric": "serve_goodput_rps",
+            "value": stats.get("goodput_rps"),
+            "unit": "requests/s",
             "vs_baseline": None,
             "context": stats,
         }
@@ -1803,6 +1911,8 @@ def main(argv=None) -> int:
         return lint_main(sorted(flags))
     if args and args[0] == "tune":
         return run_tune(args[1:], flags)
+    if args and args[0] == "tune-ring":
+        return run_tune_ring(args[1:], flags)
     if args and args[0] == "tune-show":
         return run_tune_show()
     if args and args[0] == "roc":

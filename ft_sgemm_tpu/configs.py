@@ -177,6 +177,20 @@ GRID_ORDERS = ("mn", "nm")
 # (it carries the accumulation dependency) and is not part of the axis.
 DIM_SEMANTICS = ("parallel", "arbitrary")
 
+# ``RING_OVERLAP_MODES``: the hop schedule of the ring collective paths
+# (parallel/ring.py, parallel/ring_attention.py). "serial" computes hop
+# t's local FT-GEMM, then rotates the visiting shard (hop t+1 waits on
+# the ICI transfer — the historical schedule). "overlap" is the
+# double-buffered rotate-ahead pipeline: the ppermute producing hop
+# t+1's shard is issued BEFORE hop t's local compute, so XLA's async
+# collective-permute hides the ICI transfer behind the MXU dot, at the
+# cost of a second resident copy of each rotating operand. The two
+# schedules run identical local GEMMs on identical shard values, so
+# outputs and per-device counters are byte-value equal (test-pinned).
+# A searched tuner axis (``ring=`` key component, schema 5); dispatch
+# spells the unconstrained lookup "auto" like every other variant axis.
+RING_OVERLAP_MODES = ("serial", "overlap")
+
 # Fused-epilogue axes: the detect-correct epilogue of every kernel can
 # fuse a bias add, an activation, and an int8/fp8 quantize-rescale —
 # applied strictly AFTER correction, so the ABFT checksums verify the
@@ -322,9 +336,11 @@ class KernelVariant:
     semantics of the output dims (:data:`DIM_SEMANTICS`), the
     detect/correct cadence (``check_every`` in K-grid steps; ``None`` =
     the strategy's default — the reference's ~K/20 rule for rowcol/
-    global, a single deferred final check for weighted/fused), and the
+    global, a single deferred final check for weighted/fused), the
     fused epilogue (an :class:`EpilogueSpec` SPELLING, kept as a string
-    so the descriptor stays hashable/jit-static).
+    so the descriptor stays hashable/jit-static), and the ring hop
+    schedule (:data:`RING_OVERLAP_MODES` — consumed by the ring
+    collective wrappers, ignored by the single-device kernel factories).
 
     ``KernelVariant()`` is the exact historical behavior: dispatching
     with it (or with ``variant=None``) emits byte-identical HLO to the
@@ -336,6 +352,7 @@ class KernelVariant:
     dim_semantics: str = "parallel"
     check_every: Optional[int] = None
     epilogue: str = "none"
+    ring_overlap: str = "serial"
 
     def __post_init__(self):
         if self.pipeline_depth not in PIPELINE_DEPTHS:
@@ -357,6 +374,10 @@ class KernelVariant:
                 f"KernelVariant.check_every={self.check_every!r} must be"
                 " a positive int (K-grid steps) or None for the"
                 " strategy default")
+        if self.ring_overlap not in RING_OVERLAP_MODES:
+            raise ValueError(
+                f"KernelVariant.ring_overlap={self.ring_overlap!r} must"
+                f" be one of {RING_OVERLAP_MODES}")
         # Canonicalize the epilogue spelling through the one parser so
         # "Bias+ReLU" and "bias+relu" key identically everywhere.
         object.__setattr__(

@@ -103,14 +103,32 @@ VARIANT_AXES = {
     "dim_semantics": ("parallel", "arbitrary"),
     "epilogue_activation": ("none", "relu", "gelu"),
     "epilogue_quantize": ("none", "int8", "float8_e4m3fn"),
+    # Ring collective hop schedule (PR 14): serial = compute-then-rotate,
+    # overlap = double-buffered rotate-ahead (the ppermute producing the
+    # next hop's shard is issued before the hop's local FT-GEMM, hiding
+    # ICI behind the MXU). Mirrors configs.RING_OVERLAP_MODES.
+    "ring_overlap": ("serial", "overlap"),
 }
 
-# The f-string markers the tuner cache key (schema 4) must carry for the
+# The f-string markers the tuner cache key (schema 5) must carry for the
 # variant axes — cross-checked against ``tuner/cache.py::make_key`` by
 # the lint axis-drift pass exactly like the historical ``enc=``/``thr=``/
 # ``inj=`` components. ``cad=`` is the detect/correct cadence, ``epi=``
-# the epilogue spelling.
-TUNER_VARIANT_KEY_MARKERS = ("pipe=", "grid=", "cad=", "epi=")
+# the epilogue spelling, ``ring=`` the ring hop schedule.
+TUNER_VARIANT_KEY_MARKERS = ("pipe=", "grid=", "cad=", "epi=", "ring=")
+
+# --- multi-device serve pool -------------------------------------------
+#
+# Placement policies of the serving layer's device pool
+# (``serve/pool.py::PLACEMENTS`` is the runtime spelling of the same
+# declaration — the BLOCK_PHASES mirror discipline): "health" steers
+# each batch to the healthiest least-loaded device and DRAINS devices
+# whose DeviceHealthTracker score falls below the pool's threshold;
+# "round_robin" ignores health (the A/B control and the no-tracker
+# fallback). Every pool placement event labels ``pool_placement`` with
+# one of these spellings, and telemetry's
+# ``events.AXIS_LABELS["pool_placement"]`` mirrors this tuple.
+POOL_PLACEMENTS = ("health", "round_robin")
 
 # --- kernel-axis declaration sources -----------------------------------
 #

@@ -83,6 +83,7 @@ DEFAULT_ALLOWLIST = "lint-allowlist.json"
 THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
                     "ft_sgemm_tpu/serve/blocks.py",
                     "ft_sgemm_tpu/serve/kv_cache.py",
+                    "ft_sgemm_tpu/serve/pool.py",
                     "ft_sgemm_tpu/telemetry/monitor.py")
 
 
@@ -276,6 +277,7 @@ class Declarations:
         self.variant_axes = dict(contracts.get("VARIANT_AXES", {}))
         self.variant_key_markers = tuple(
             contracts.get("TUNER_VARIANT_KEY_MARKERS", ()))
+        self.pool_placements = tuple(contracts.get("POOL_PLACEMENTS", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -295,6 +297,9 @@ class Declarations:
                 configs.get("EPILOGUE_ACTIVATIONS", ())),
             "epilogue_quantize": tuple(
                 configs.get("EPILOGUE_QUANTIZE", ())),
+            # Ring hop schedule (PR 14): searched like the PR-13 axes.
+            "ring_overlap": tuple(
+                configs.get("RING_OVERLAP_MODES", ())),
         }
 
         self.vmem_variants = tuple(vmem.get("TEMP_TILE_FACTORS", {}))
@@ -491,6 +496,8 @@ AXIS_VAR_SETS = {
     "in_dtype": "dtypes",
     "grid_order": "grid_orders",
     "dim_semantics": "dim_semantics",
+    "ring_overlap": "ring_overlap_modes",
+    "pool_placement": "pool_placements",
 }
 
 
@@ -552,7 +559,7 @@ def _cli_doc_axes(doc: str):
     for lineno, line in enumerate(doc.splitlines(), 2):
         for m in re.finditer(
                 r"--(strategy|encode|threshold|dtype|grid-order"
-                r"|dim-semantics)=([A-Za-z0-9_.|]+)",
+                r"|dim-semantics|ring-overlap)=([A-Za-z0-9_.|]+)",
                 line):
             flag = m.group(1)
             for token in m.group(2).split("|"):
@@ -720,10 +727,14 @@ def check_axis_drift(repo: Repo, decls: Declarations):
     # sets are what the label schema enumerates). pipeline_depth is
     # integer-valued and deliberately not a label axis.
     for axis in ("grid_order", "dim_semantics", "epilogue_activation",
-                 "epilogue_quantize"):
+                 "epilogue_quantize", "ring_overlap"):
         values = decls.configs_variant_axes.get(axis)
         if values:
             mirror[axis] = values
+    # The serve pool's placement-policy axis mirrors contracts directly
+    # (no configs counterpart — serving-plane axis, like block_phase).
+    if decls.pool_placements:
+        mirror["pool_placement"] = decls.pool_placements
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -753,6 +764,8 @@ def check_axis_drift(repo: Repo, decls: Declarations):
         alias_ok = dtypes | set(decls.dtype_aliases)
         grid_orders = set(decls.configs_variant_axes.get("grid_order", ()))
         dim_sems = set(decls.configs_variant_axes.get("dim_semantics", ()))
+        ring_modes = set(
+            decls.configs_variant_axes.get("ring_overlap", ()))
         for flag, token, line in _cli_doc_axes(doc):
             ok = {
                 "strategy": lambda t: t in strategies,
@@ -761,6 +774,7 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                 "dtype": lambda t: t in alias_ok,
                 "grid-order": lambda t: t in grid_orders,
                 "dim-semantics": lambda t: t in dim_sems,
+                "ring-overlap": lambda t: t in ring_modes or t == "auto",
             }[flag](token)
             if not ok:
                 f(CLI_PATH, line, f"--{flag}={token}",
@@ -783,7 +797,11 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                      | {"auto"},
                      "dim_semantics": set(
                          decls.configs_variant_axes.get(
-                             "dim_semantics", ())) | {"auto"}}
+                             "dim_semantics", ())) | {"auto"},
+                     "ring_overlap": set(
+                         decls.configs_variant_axes.get(
+                             "ring_overlap", ())) | {"auto"},
+                     "pool_placement": set(decls.pool_placements)}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

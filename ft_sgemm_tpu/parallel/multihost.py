@@ -219,8 +219,12 @@ def multihost_ft_sgemm(
         shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
         precision=precision, in_dtype=in_dtype, interpret=interpret,
     )
-    # K-partials psum over "y" (ICI only); the int32 detection count is the
-    # one value that crosses "host" (DCN).
+    # K-partials psum over "y" (ICI only). Detection counters reduce in
+    # STAGES (parallel/reduce.py): per-device -> "y" (ICI ring) -> "x"
+    # (ICI) -> "host" (DCN) — axes ordered innermost-first is the
+    # staging contract, so the only counter values crossing DCN are one
+    # already-combined int32 set per host slot (O(local) detection
+    # traffic; the 2112.09017 panel structure).
     step = make_ft_step(local_ft, alpha, beta, inject, scatter_output,
                         det_axes=("y", "x", "host"),
                         mesh_axes=("host", "x", "y"),

@@ -1,0 +1,57 @@
+"""Hierarchical (staged) reduction of detection counters over a mesh.
+
+The flat spelling — one ``psum`` over every mesh axis at once — lowers to
+a single all-reduce in which EVERY device of the mesh participates
+directly. For the payloads this package reduces that way (int32
+detection/uncorrectable counters, a few scalars per device) the cost is
+not the bytes, it is the participation: on a multi-host mesh the flat
+all-reduce's communication pattern spans DCN with full device fan-in, so
+detection overhead grows with the mesh instead of staying O(local).
+
+*Large Scale Distributed Linear Algebra With TPUs* (PAPERS.md,
+arXiv 2112.09017) structures its checksums hierarchically — per-panel
+sums combined per host, then globally — precisely so verification traffic
+composes along the machine's own hierarchy. :func:`hierarchical_psum` is
+that panel structure applied to this package's counter plane: the
+reduction runs ONE AXIS AT A TIME, innermost (ICI) first, so each stage
+combines values that are already partial sums of the previous stage.
+On the 3-axis multi-host mesh (``parallel/multihost.py``) the staging is
+
+    per-device -> psum over "y"  (intra-slice ICI ring)
+               -> psum over "x"  (intra-slice ICI)
+               -> psum over "host" (DCN — already-reduced scalars only)
+
+so the only values crossing DCN are one already-combined counter set per
+host slot — detection cost stays O(local) as the mesh grows. Counters
+are integers, so the staged sum is EXACTLY the flat sum (no float
+reassociation concerns; equality is test-pinned on an 8-vdev mesh).
+
+Axis order is the caller's contract: pass axes innermost-first (ICI
+before DCN). A single-axis mesh degenerates to the flat psum — the ring
+paths route through here anyway so every counter reduction in
+``parallel/`` shares one spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+
+
+def hierarchical_psum(x, axes: Union[str, Sequence[str]]):
+    """Staged ``psum`` over ``axes``, one axis at a time, in order.
+
+    ``axes`` should run innermost-first (ICI axes before the DCN
+    ``host`` axis) so later — wider — stages reduce already-combined
+    values. For integer counters the result equals the flat
+    ``jax.lax.psum(x, tuple(axes))`` exactly.
+    """
+    if isinstance(axes, str):
+        axes = (axes,)
+    for ax in axes:
+        x = jax.lax.psum(x, ax)
+    return x
+
+
+__all__ = ["hierarchical_psum"]

@@ -14,7 +14,22 @@ no distributed backend at all).
 Fault tolerance composes per hop: each visiting shard's partial C columns
 are produced by the fused-ABFT kernel and corrected locally BEFORE the
 shard moves on, so a corrupted accumulator never propagates around the
-ring. Detection counts ``psum`` over the ring at the end.
+ring. Detection counts reduce hierarchically over the ring at the end
+(``parallel/reduce.py``).
+
+**Hop schedules** (the ``ring_overlap`` axis, searched by the tuner —
+DESIGN.md §17): ``overlap=False`` is the historical serial schedule —
+compute hop t, then rotate, so hop t+1's local GEMM waits on hop t's
+``ppermute``. ``overlap=True`` is the double-buffered rotate-ahead
+schedule: the ``ppermute`` that produces hop t+1's shard is issued BEFORE
+hop t's local FT-GEMM, so XLA's async collective-permute (start/done
+pair) has a full hop of MXU compute to hide the ICI transfer behind —
+the paper's fault-tolerance-is-free argument (arXiv 2305.01024) applied
+to the ring's communication plane. The two schedules run the SAME local
+GEMMs on the SAME shard values in the SAME order, so their outputs and
+per-device counters are byte-value identical (test-pinned); overlap pays
+one extra resident copy of each rotating operand (the double buffer) and
+one extra rotation's ICI traffic.
 
 Layout (D = ring size):
   A  (M, K)  -> P("x", None): row shards, stationary.
@@ -38,6 +53,7 @@ from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
+from ft_sgemm_tpu.parallel.reduce import hierarchical_psum
 from ft_sgemm_tpu.parallel.sharded import shard_local_ft, shard_map
 
 
@@ -66,6 +82,168 @@ def _check_divisible(name, dim, parts):
         )
 
 
+def rotate_ahead_loop(dnum, perm, hop_body, rotating, carry, *,
+                      overlap=False, axis="x"):
+    """Run ``hop_body(t, rotating, carry) -> carry`` for ``t`` in
+    ``[0, dnum)``, rotating ``rotating`` (a tuple of arrays) one ring
+    position between hops with ``ppermute``. The ONE hop loop every ring
+    path in this package runs — FT and plain GEMM, the attention
+    forward — so each schedule is implemented once, not per caller.
+
+    ``overlap=False`` — the serial schedule: compute hop t with the
+    t-rotated shards, then rotate. The loop-carried dependency makes hop
+    t+1's compute wait on hop t's transfer.
+
+    ``overlap=True`` — double-buffered rotate-ahead: the loop carries
+    BOTH hop t's shards and hop t+1's (already in flight), and each
+    iteration issues the rotation producing hop t+2's shards BEFORE
+    running hop t's compute. No data dependence ties that ``ppermute``
+    to the local GEMM, and its consumer is a full iteration away, so
+    XLA's async collective-permute overlaps the ICI transfer with the
+    MXU dot. Hop t's compute sees exactly the t-rotated shards under
+    both schedules — value-identical by construction — at the cost of a
+    second resident copy of each rotating operand and one extra
+    (prologue) rotation's traffic.
+    """
+    def rot(ops):
+        return tuple(jax.lax.ppermute(x, axis, perm) for x in ops)
+
+    if not overlap:
+        def hop(t, state):
+            ops, car = state
+            car = hop_body(t, ops, car)
+            return rot(ops), car
+
+        _, carry = jax.lax.fori_loop(0, dnum, hop, (rotating, carry))
+        return carry
+
+    def hop(t, state):
+        cur, nxt, car = state
+        fut = rot(nxt)  # hop t+2's shards: issued BEFORE hop t's compute
+        car = hop_body(t, cur, car)
+        return nxt, fut, car
+
+    ahead = rot(rotating)  # prologue: hop 1's shards start moving now
+    _, _, carry = jax.lax.fori_loop(0, dnum, hop, (rotating, ahead, carry))
+    return carry
+
+
+def _make_ring_gemm_step(run_local, d, nb, n, perm, *, alpha, beta, ft,
+                         overlap):
+    """The shard_map-able per-device ring-GEMM step, parameterized over
+    the FT/plain axis and the hop schedule — ONE hop body serves all
+    four (ft x overlap) spellings, so a schedule change can never drift
+    between the FT and plain paths (the historical near-duplicate
+    bodies this replaces)."""
+
+    def step_fn(a_loc, b_loc, c_loc):
+        my = jax.lax.axis_index("x")
+        zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
+
+        def hop_body(t, rotating, carry):
+            (b_vis,) = rotating
+            out, det, unc = carry
+            # perm shifts shards UP the ring, so after t rotations a
+            # device holds the shard that started at position my - t =>
+            # that shard's C columns start at its owner's offset.
+            col0 = jnp.mod(my - t, d) * nb
+            if ft:
+                res = run_local(a_loc, b_vis, zeros)
+                out = jax.lax.dynamic_update_slice(out, res.c, (0, col0))
+                det = det + jnp.sum(res.detections)
+                unc = unc + jnp.sum(res.uncorrectable)
+            else:
+                part = run_local(a_loc, b_vis, zeros)
+                out = jax.lax.dynamic_update_slice(out, part, (0, col0))
+            return out, det, unc
+
+        out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
+        carry0 = (out0, jnp.int32(0), jnp.int32(0))
+        out, det, unc = rotate_ahead_loop(
+            d, perm, hop_body, (b_loc,), carry0, overlap=overlap)
+        out = alpha * out + beta * c_loc
+        if not ft:
+            return out
+        # Per-device counts (summed over this device's hops) keep their
+        # ring position via the P("x") layout; the staged reduction
+        # (one axis — the ring degenerates to the flat psum) yields the
+        # globals.
+        dev_det = det.reshape(1)
+        dev_unc = unc.reshape(1)
+        det = hierarchical_psum(det, ("x",))
+        unc = hierarchical_psum(unc, ("x",))
+        return out, det.reshape(1, 1), unc.reshape(1, 1), dev_det, dev_unc
+
+    return step_fn
+
+
+def _resolve_ring_overlap(ring_overlap, m, n, k, d, *, strategy, in_dtype):
+    """One resolver for the ``ring_overlap`` dispatch axis: an explicit
+    mode passes through; ``None``/"auto" consults the tuner cache for a
+    searched winner (``tuner.lookup_ring_overlap``, keyed on the
+    PER-DEVICE local shard problem so the ring size rides the key) and
+    falls back to the serial schedule — the historical behavior — on a
+    miss or with tuning disabled."""
+    from ft_sgemm_tpu.configs import RING_OVERLAP_MODES
+
+    if ring_overlap in (None, "auto"):
+        from ft_sgemm_tpu import tuner
+
+        win = tuner.lookup_ring_overlap(
+            m // d, n // d, k, strategy=strategy, in_dtype=in_dtype)
+        return win or "serial"
+    if ring_overlap not in RING_OVERLAP_MODES:
+        raise ValueError(
+            f"ring_overlap={ring_overlap!r} must be one of"
+            f" {RING_OVERLAP_MODES} (or None/'auto' for the tuner)")
+    return ring_overlap
+
+
+def make_ring_ft_sgemm_fn(
+    mesh: Mesh,
+    d: int,
+    nb: int,
+    n: int,
+    shape: KernelShape | str,
+    *,
+    alpha: float,
+    beta: float,
+    inject: InjectionSpec,
+    strategy: str,
+    threshold,
+    precision: str,
+    in_dtype: str,
+    interpret: Optional[bool],
+    inject_coords: Optional[tuple],
+    overlap: bool,
+):
+    """The un-jitted shard_map'd ring-FT executor:
+    ``fn(a, b, c) -> (out, det, unc, dev_det, dev_unc)``.
+
+    The factory form exists for callers that need jit-once reuse across
+    many calls — :func:`ring_ft_sgemm` wraps one call, while the tuner's
+    ring-schedule search (``tuner.tune_ring``) times BOTH hop schedules
+    through one compiled executable each (a fresh closure per timed call
+    would re-pay trace+compile and measure the compiler, not the ring).
+    """
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
+        precision=precision, in_dtype=in_dtype, interpret=interpret,
+    )
+    perm = [(i, (i + 1) % d) for i in range(d)]  # shift shards up the ring
+    run_local = shard_local_ft(local_ft, inject, inject_coords, ("x",))
+    step_fn = _make_ring_gemm_step(
+        run_local, d, nb, n, perm, alpha=alpha, beta=beta, ft=True,
+        overlap=overlap)
+    return shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(P("x", None), P("x", None), P("x", None)),
+        out_specs=(P("x", None), P(None, None), P(None, None),
+                   P("x"), P("x")),
+    )
+
+
 def ring_ft_sgemm(
     a,
     b,
@@ -83,6 +261,7 @@ def ring_ft_sgemm(
     interpret: Optional[bool] = None,
     inject_coords: Optional[tuple] = None,
     donate_c: bool = False,
+    ring_overlap: Optional[str] = None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` as a ring collective matmul.
 
@@ -93,10 +272,16 @@ def ring_ft_sgemm(
     its ring position and host when telemetry is enabled, DESIGN.md §8).
     ``inject_coords=(i,)`` restricts injection to ring position ``i``
     (every hop on that device injects; all other devices run clean).
-    ``donate_c=True`` donates C's buffer to the output at the jit
-    boundary — C is read once by the ``beta*C`` epilogue and the output
-    shares its P("x", None) sharding, so XLA reuses the HBM buffer
-    (the caller's ``c`` is invalidated; see
+    ``ring_overlap`` selects the hop schedule
+    (``configs.RING_OVERLAP_MODES``): ``"serial"`` computes then
+    rotates, ``"overlap"`` is the double-buffered rotate-ahead pipeline
+    (module docstring), and ``None``/``"auto"`` consults the tuner cache
+    (``tuner.tune_ring`` banks winners) falling back to serial. Both
+    schedules are byte-value identical in outputs AND per-device
+    counters. ``donate_c=True`` donates C's buffer to the output at the
+    jit boundary — C is read once by the ``beta*C`` epilogue and the
+    output shares its P("x", None) sharding, so XLA reuses the HBM
+    buffer (the caller's ``c`` is invalidated; see
     :func:`~ft_sgemm_tpu.parallel.sharded.sharded_ft_sgemm`).
     """
     # String shapes stay names: make_ft_sgemm resolves them through the
@@ -114,58 +299,20 @@ def ring_ft_sgemm(
     _check_divisible("M", m, d)
     _check_divisible("N", n, d)
     nb = n // d  # visiting-shard width = one C column block
+    overlap = _resolve_ring_overlap(ring_overlap, m, n, k, d,
+                                    strategy=strategy, in_dtype=in_dtype)
 
-    local_ft = make_ft_sgemm(
-        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
-        precision=precision, in_dtype=in_dtype, interpret=interpret,
-    )
-    perm = [(i, (i + 1) % d) for i in range(d)]  # shift shards up the ring
-    run_local = shard_local_ft(local_ft, inject, inject_coords, ("x",))
-
-    def step_fn(a_loc, b_loc, c_loc):
-        my = jax.lax.axis_index("x")
-        zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
-
-        def hop(t, carry):
-            out, b_vis, det, unc = carry
-            res = run_local(a_loc, b_vis, zeros)
-            # perm shifts shards UP the ring, so after t rotations a device
-            # holds the shard that started at position my - t => that
-            # shard's C columns start at its owner's offset.
-            col0 = jnp.mod(my - t, d) * nb
-            out = jax.lax.dynamic_update_slice(out, res.c, (0, col0))
-            det = det + jnp.sum(res.detections)
-            unc = unc + jnp.sum(res.uncorrectable)
-            # Rotate AFTER computing so hop t uses the t-shifted shard; the
-            # final rotation returns shards to their owners.
-            b_vis = jax.lax.ppermute(b_vis, "x", perm)
-            return out, b_vis, det, unc
-
-        out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
-        out, _, det, unc = jax.lax.fori_loop(
-            0, d, hop, (out0, b_loc, jnp.int32(0), jnp.int32(0)))
-        out = alpha * out + beta * c_loc
-        # Per-device counts (summed over this device's hops) keep their
-        # ring position via the P("x") layout; the psum'd globals follow.
-        dev_det = det.reshape(1)
-        dev_unc = unc.reshape(1)
-        det = jax.lax.psum(det, "x")
-        unc = jax.lax.psum(unc, "x")
-        return out, det.reshape(1, 1), unc.reshape(1, 1), dev_det, dev_unc
-
-    fn = shard_map(
-        step_fn,
-        mesh=mesh,
-        in_specs=(P("x", None), P("x", None), P("x", None)),
-        out_specs=(P("x", None), P(None, None), P(None, None),
-                   P("x"), P("x")),
-    )
+    fn = make_ring_ft_sgemm_fn(
+        mesh, d, nb, n, shape, alpha=alpha, beta=beta, inject=inject,
+        strategy=strategy, threshold=threshold, precision=precision,
+        in_dtype=in_dtype, interpret=interpret,
+        inject_coords=inject_coords, overlap=overlap == "overlap")
     jit_kwargs = {"donate_argnums": (2,)} if donate_c else {}
     with telemetry.trace_span("ring_ft_sgemm"):
         out, det, unc, dev_det, dev_unc = jax.jit(fn, **jit_kwargs)(a, b, c)
     result = FtSgemmResult(out, det, unc)
     if telemetry.enabled():
-        # Ring counts psum over all hops and devices; the device label
+        # Ring counts reduce over all hops and devices; the device label
         # carries the ring extent, and the sharded per-device counts
         # attribute each hop-summed total to its ring position.
         telemetry.record_mesh_gemm(
@@ -173,7 +320,7 @@ def ring_ft_sgemm(
             device=f"ring{d}", operands=(a, b, c),
             alpha=alpha, beta=beta,
             dev_detections=dev_det, dev_uncorrectable=dev_unc,
-            axes=("x",))
+            axes=("x",), extra={"ring_overlap": overlap})
     return result
 
 
@@ -190,11 +337,14 @@ def ring_sgemm(
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     donate_c: bool = False,
+    ring_overlap: Optional[str] = None,
 ) -> jax.Array:
     """Plain (non-FT) ring collective matmul with the same layout.
 
-    ``donate_c=True`` donates C's buffer to the output at the jit
-    boundary (caller's ``c`` invalidated)."""
+    ``ring_overlap`` selects the hop schedule exactly as in
+    :func:`ring_ft_sgemm` (the plain path keys the tuner lookup with
+    ``strategy=None``). ``donate_c=True`` donates C's buffer to the
+    output at the jit boundary (caller's ``c`` invalidated)."""
     cast_dtype, _ = resolve_in_dtype(in_dtype, precision)
     a = jnp.asarray(a, cast_dtype)
     b = jnp.asarray(b, cast_dtype)
@@ -204,26 +354,15 @@ def ring_sgemm(
     _check_divisible("M", m, d)
     _check_divisible("N", n, d)
     nb = n // d
+    overlap = _resolve_ring_overlap(ring_overlap, m, n, k, d,
+                                    strategy=None, in_dtype=in_dtype)
 
     local = make_sgemm(shape, alpha=1.0, beta=0.0, precision=precision,
                        in_dtype=in_dtype, interpret=interpret)
     perm = [(i, (i + 1) % d) for i in range(d)]
-
-    def step_fn(a_loc, b_loc, c_loc):
-        my = jax.lax.axis_index("x")
-        zeros = jnp.zeros((a_loc.shape[0], nb), jnp.float32)
-
-        def hop(t, carry):
-            out, b_vis = carry
-            part = local(a_loc, b_vis, zeros)
-            col0 = jnp.mod(my - t, d) * nb
-            out = jax.lax.dynamic_update_slice(out, part, (0, col0))
-            b_vis = jax.lax.ppermute(b_vis, "x", perm)
-            return out, b_vis
-
-        out0 = jnp.zeros((a_loc.shape[0], n), jnp.float32)
-        out, _ = jax.lax.fori_loop(0, d, hop, (out0, b_loc))
-        return alpha * out + beta * c_loc
+    step_fn = _make_ring_gemm_step(
+        local, d, nb, n, perm, alpha=alpha, beta=beta, ft=False,
+        overlap=overlap == "overlap")
 
     fn = shard_map(
         step_fn,
@@ -235,4 +374,5 @@ def ring_sgemm(
     return jax.jit(fn, **jit_kwargs)(a, b, c)
 
 
-__all__ = ["make_ring_mesh", "ring_ft_sgemm", "ring_sgemm"]
+__all__ = ["make_ring_ft_sgemm_fn", "make_ring_mesh", "ring_ft_sgemm",
+           "ring_sgemm", "rotate_ahead_loop"]

@@ -62,7 +62,9 @@ from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.attention import (
     FtAttentionResult, PV_SHAPE, QK_SHAPE)
 from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
-from ft_sgemm_tpu.parallel.ring import _check_divisible, make_ring_mesh
+from ft_sgemm_tpu.parallel.reduce import hierarchical_psum
+from ft_sgemm_tpu.parallel.ring import (
+    _check_divisible, make_ring_mesh, rotate_ahead_loop)
 from ft_sgemm_tpu.parallel.sharded import shard_local_ft, shard_map
 
 
@@ -98,14 +100,20 @@ def _masked_scores(s_res, sc, causal, my, t, dnum, qpos, nk_blk):
 
 def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
                    qk_shape, pv_shape, in_dtype, interpret, lq, lk, dv,
-                   dnum, inject_coords=None):
+                   dnum, inject_coords=None, overlap=False):
     """The shard_map'd forward ring; returns
     (out, m, l, det, flags, unc, dev_det, dev_unc) with (m, l)
     row-sharded like the output — the residuals the differentiable
     path's backward ring needs — and the trailing pair the P("x")
     per-device counter arrays telemetry attribution reads
     (DESIGN.md §8). ``inject_coords=(i,)`` restricts injection to ring
-    position ``i`` (both of that device's hop GEMMs inject)."""
+    position ``i`` (both of that device's hop GEMMs inject).
+    ``overlap=True`` runs the double-buffered rotate-ahead hop schedule
+    (``parallel/ring.py::rotate_ahead_loop``): the K/V blocks' next-hop
+    ``ppermute`` is issued before the hop's QK/PV FT-GEMMs, so the ICI
+    transfer hides behind the MXU work; the online-softmax recurrence
+    consumes the same block values in the same order either way, so the
+    two schedules are byte-value identical."""
     inject = inject or InjectionSpec.none()
     sc_causal = causal
     qk = make_ft_sgemm(qk_shape, alpha=1.0, beta=0.0, strategy=strategy,
@@ -129,8 +137,9 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
         # row r sits at key position my*nq + r + (lk - lq).
         qpos = (my * nq + jnp.arange(nq) + (lk - lq))[:, None]
 
-        def hop(t, carry):
-            m, l, o, k_vis, vt_vis, det, unc = carry
+        def hop_body(t, rotating, carry):
+            k_vis, vt_vis = rotating
+            m, l, o, det, unc = carry
             s_res = run_qk(q_loc, k_vis, zs)
             s_t = _masked_scores(s_res, sc, sc_causal, my, t, dnum, qpos,
                                  nk_blk)
@@ -146,15 +155,13 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
             det = det + jnp.sum(s_res.detections) + jnp.sum(o_res.detections)
             unc = unc + jnp.sum(s_res.uncorrectable) + jnp.sum(
                 o_res.uncorrectable)
-            k_vis = jax.lax.ppermute(k_vis, "x", perm)
-            vt_vis = jax.lax.ppermute(vt_vis, "x", perm)
-            return m_new, l, o, k_vis, vt_vis, det, unc
+            return m_new, l, o, det, unc
 
         m0 = jnp.full((nq, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((nq, 1), jnp.float32)
-        m, l, o, _, _, det, unc = jax.lax.fori_loop(
-            0, dnum, hop,
-            (m0, l0, zo, k_loc, vt_loc, jnp.int32(0), jnp.int32(0)))
+        m, l, o, det, unc = rotate_ahead_loop(
+            dnum, perm, hop_body, (k_loc, vt_loc),
+            (m0, l0, zo, jnp.int32(0), jnp.int32(0)), overlap=overlap)
         # Normalization invariant of the streaming softmax: l aggregates
         # exp(s - m) > 0 over all Lk keys; non-finite or non-positive rows
         # mean corrupted softmax state (detect-only, like the single-device
@@ -163,12 +170,13 @@ def _build_forward(mesh, *, scale, causal, inject, strategy, threshold,
             jnp.isfinite(l) & (l > 0.0)).astype(jnp.int32))
         out = o / l
         # Per-device counts keep their ring position via P("x") before
-        # the psums collapse the global totals.
+        # the staged reduction collapses the global totals (the ring's
+        # one axis degenerates to the flat psum; parallel/reduce.py).
         dev_det = det.reshape(1)
         dev_unc = unc.reshape(1)
-        det = jax.lax.psum(det, "x")
-        flags = jax.lax.psum(flags, "x")
-        unc = jax.lax.psum(unc, "x")
+        det = hierarchical_psum(det, ("x",))
+        flags = hierarchical_psum(flags, ("x",))
+        unc = hierarchical_psum(unc, ("x",))
         return (out, m, l, det.reshape(1, 1), flags.reshape(1, 1),
                 unc.reshape(1, 1), dev_det, dev_unc)
 
@@ -194,6 +202,7 @@ def make_ring_ft_attention(
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     inject_coords: Optional[tuple] = None,
+    ring_overlap: Optional[str] = "serial",
 ):
     """Build a REUSABLE ring-attention executor: ``fn(q, k, v) ->
     (out, det, flags, unc, dev_det, dev_unc)`` raw arrays.
@@ -209,16 +218,27 @@ def make_ring_ft_attention(
     counter arrays (one entry per ring position) telemetry attribution
     reads; ``inject_coords=(i,)`` restricts injection to ring position
     ``i``, the per-device fault-localization knob the sharded GEMM paths
-    established."""
+    established. ``ring_overlap`` selects the hop schedule: ``"serial"``
+    (compute-then-rotate, the historical default) or ``"overlap"`` (the
+    double-buffered rotate-ahead pipeline — the K/V ``ppermute`` rides
+    under the QK/PV FT-GEMMs); ``None``/``"auto"`` consults the tuner
+    cache on the per-device QK problem. Both schedules are byte-value
+    identical (test-pinned)."""
 
     def fn(q, k, v):
+        from ft_sgemm_tpu.parallel.ring import _resolve_ring_overlap
+
         q2, k2, v2, lq, lk, dv, dnum, sc = _ring_geometry(
             q, k, v, mesh, scale, causal, in_dtype)
+        overlap = _resolve_ring_overlap(
+            ring_overlap, lq, lk, q2.shape[1], dnum, strategy=strategy,
+            in_dtype=in_dtype)
         fwd = _build_forward(
             mesh, scale=sc, causal=causal, inject=inject,
             strategy=strategy, threshold=threshold, qk_shape=qk_shape,
             pv_shape=pv_shape, in_dtype=in_dtype, interpret=interpret,
-            lq=lq, lk=lk, dv=dv, dnum=dnum, inject_coords=inject_coords)
+            lq=lq, lk=lk, dv=dv, dnum=dnum, inject_coords=inject_coords,
+            overlap=overlap == "overlap")
         out, _, _, det, flags, unc, dev_det, dev_unc = fwd(
             q2, k2, jnp.swapaxes(v2, 0, 1))
         return (out, det[0, 0], flags[0, 0], unc[0, 0], dev_det, dev_unc)
@@ -245,6 +265,7 @@ def ring_ft_attention(
     in_dtype: str = "float32",
     interpret: Optional[bool] = None,
     inject_coords: Optional[tuple] = None,
+    ring_overlap: Optional[str] = "serial",
 ) -> FtAttentionResult:
     """Fault-tolerant ring attention over a 1-D mesh.
 
@@ -256,13 +277,15 @@ def ring_ft_attention(
     rowsum==1 invariant (detect-only; 0 on clean runs). With telemetry
     enabled, each device's hop-summed counts are recorded against its
     ring position and host (``telemetry.record_mesh_attention``);
-    ``inject_coords=(i,)`` restricts injection to ring position ``i``.
+    ``inject_coords=(i,)`` restricts injection to ring position ``i``;
+    ``ring_overlap`` selects the hop schedule (see
+    :func:`make_ring_ft_attention`).
     """
     fn = make_ring_ft_attention(
         mesh, scale=scale, causal=causal, inject=inject,
         strategy=strategy, threshold=threshold, qk_shape=qk_shape,
         pv_shape=pv_shape, in_dtype=in_dtype, interpret=interpret,
-        inject_coords=inject_coords)
+        inject_coords=inject_coords, ring_overlap=ring_overlap)
     dnum = mesh.shape["x"]
     with telemetry.trace_span("ring_ft_attention"):
         out, det, flags, unc, dev_det, dev_unc = jax.jit(fn)(q, k, v)
@@ -313,6 +336,13 @@ def make_ring_ft_attention_diff(
     respectively (static; self-test). ``bwd_threshold`` tightens the
     gradient GEMMs' detection threshold (cotangent scale; or use
     ``threshold="auto"``).
+
+    Both passes run the SERIAL hop schedule: the backward's dK/dV
+    accumulators are OUTPUTS of each hop's gradient GEMMs and rotate
+    alongside their blocks, so the rotation genuinely depends on the
+    hop's compute — there is nothing for a rotate-ahead schedule to
+    issue early without breaking that dependency, and the forward pass
+    of a custom_vjp must match its recompute exactly.
     """
     if strategy == "global":
         raise ValueError(

@@ -36,6 +36,7 @@ from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
 from ft_sgemm_tpu.ops.common import resolve_in_dtype
 from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
 from ft_sgemm_tpu.ops.sgemm import make_sgemm
+from ft_sgemm_tpu.parallel.reduce import hierarchical_psum
 
 
 def shard_map(f, *, mesh, in_specs, out_specs):
@@ -118,8 +119,15 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes,
 
     Runs the local fused-ABFT kernel on the device's shard (corrects BEFORE
     any collective), combines K-partials over mesh axis "y" with psum or
-    psum_scatter, applies alpha/beta once, and psums detection and
-    uncorrectable-interval counts over ``det_axes``.
+    psum_scatter, applies alpha/beta once, and reduces detection and
+    uncorrectable-interval counts over ``det_axes`` HIERARCHICALLY
+    (``parallel/reduce.py``): one axis at a time, innermost/ICI first,
+    so on the multi-host mesh the only counter values crossing DCN are
+    one already-combined set per host slot — detection traffic stays
+    O(local) as the mesh grows (the arXiv 2112.09017 panel structure
+    applied to the counter plane; count-equality vs the flat psum is
+    test-pinned). ``det_axes`` order is therefore a contract: ICI axes
+    before "host".
 
     Besides the psum'd global counters, the step returns each device's
     LOCAL detection/uncorrectable sums as size-1-per-axis arrays laid
@@ -146,8 +154,8 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes,
         out = alpha * partial + beta * c_loc
         dev_det = jnp.sum(res.detections).reshape(dev_shape)
         dev_unc = jnp.sum(res.uncorrectable).reshape(dev_shape)
-        det = jax.lax.psum(res.detections, det_axes)
-        unc = jax.lax.psum(res.uncorrectable, det_axes)
+        det = hierarchical_psum(res.detections, det_axes)
+        unc = hierarchical_psum(res.uncorrectable, det_axes)
         return out, det, unc, dev_det, dev_unc
 
     return step

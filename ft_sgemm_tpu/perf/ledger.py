@@ -236,8 +236,14 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
     # Driver wrapper ({"n", "cmd", "rc", "tail", "parsed"}): the banked
     # BENCH_r* shape. A null "parsed" means the run died before emitting
     # its artifact line — record the rc and whatever the tail names.
+    # A wrapper carrying "n_devices" is a MULTICHIP artifact even when
+    # its parsed payload is an ordinary serve/bench line (PR 14: the
+    # multi-device pool bench IS the multichip probe, with real
+    # measurements instead of an ok flag).
+    multichip_wrapper = False
     if "parsed" in doc and ("rc" in doc or "cmd" in doc):
         wrapper, doc = doc, doc.get("parsed")
+        multichip_wrapper = "n_devices" in wrapper
         rc = wrapper.get("rc")
         if rc not in (0, None):
             deg.append(f"worker_rc:{rc}")
@@ -265,6 +271,8 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
     manifest = manifest if isinstance(manifest, dict) else {}
 
     entry["kind"] = _infer_kind(doc, ctx, source)
+    if multichip_wrapper:
+        entry["kind"] = "multichip"
     entry["git_rev"] = manifest.get("git_rev")
     entry["platform"] = _platform(ctx, manifest)
     entry["metric"] = doc.get("metric") if isinstance(
@@ -323,12 +331,28 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
             s = _measurement(ctx.get(key), higher_is_better=hib)
             if s:
                 entry["measurements"][f"serve.{key}"] = s
+        # Pool stage (PR 14): goodput scaling vs the single-device
+        # control is the headline multi-device fact — higher is better,
+        # gated longitudinally like every serve.* series.
+        scaling = ctx.get("scaling")
+        if isinstance(scaling, dict):
+            for key in ("throughput_ratio", "goodput_ratio"):
+                s = _measurement(scaling.get(key), higher_is_better=True)
+                if s:
+                    entry["measurements"][f"serve_pool.{key}"] = s
 
-    if entry["kind"] == "multichip":
+    if entry["kind"] == "multichip" and not entry["measurements"] \
+            and entry["value"] is None:
+        # The historical flag-only probe ({"n_devices", "ok"}): the ok
+        # flag is the whole signal. A multichip artifact that DID
+        # measure (the PR-14 pool bench wrapper) keeps its real
+        # metric/value/measurements and skips this degradation.
         entry["metric"] = entry["metric"] or "multichip_ok"
         ok = doc.get("ok")
         entry["value"] = 1.0 if ok else (0.0 if ok is not None else None)
         deg.append("no_measurements:multichip_ok_flag_only")
+    elif entry["kind"] == "multichip":
+        entry["metric"] = entry["metric"] or "multichip_ok"
     elif entry["value"] is None and "value" in doc:
         # The BENCH_r02–r05 class: the artifact line landed but the
         # headline never did. Name the reason the artifact itself gives.

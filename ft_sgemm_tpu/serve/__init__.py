@@ -31,6 +31,13 @@ a detected-and-corrected SDC costs the serving path nothing):
   appended checksum rows (plain + weighted column sums), verified on
   read, single-element corruption corrected IN PLACE, wider corruption
   recovered by the engine's bounded page-scoped restore ladder.
+- :mod:`.pool` — the multi-device dispatcher: each bucket's executable
+  replicated (AOT) across mesh devices, placement steered by
+  ``DeviceHealthTracker`` scores (sick devices drain, not schedule),
+  and a bounded async in-flight window per device worker — the mesh,
+  not one chip, is the unit of serving throughput
+  (``bench.py --serve --pool`` reports goodput scaling vs the
+  single-device engine).
 - :mod:`.loadgen` — the load-generator bench (``bench.py --serve``,
   ``cli serve-bench``): configurable arrival process with SDC injection,
   reporting p50/p99 latency (from the telemetry histogram machinery),
@@ -76,12 +83,15 @@ from ft_sgemm_tpu.serve.loadgen import (
     BlockLoadSpec,
     LoadSpec,
     block_smoke_spec,
+    pool_smoke_spec,
     run_block_load,
     run_block_serve_bench,
     run_load,
+    run_pool_serve_bench,
     run_serve_bench,
     smoke_spec,
 )
+from ft_sgemm_tpu.serve.pool import PLACEMENTS, DevicePool
 from ft_sgemm_tpu.serve.tracing import (
     current_trace_id,
     new_trace_id,
@@ -96,8 +106,10 @@ __all__ = [
     "BlockResult",
     "Bucket",
     "BucketOverflowError",
+    "DevicePool",
     "KVPageFault",
     "LoadSpec",
+    "PLACEMENTS",
     "PagedKVCache",
     "ServeEngine",
     "ServeRequest",
@@ -108,9 +120,11 @@ __all__ = [
     "default_block_bucket_set",
     "default_bucket_set",
     "new_trace_id",
+    "pool_smoke_spec",
     "run_block_load",
     "run_block_serve_bench",
     "run_load",
+    "run_pool_serve_bench",
     "run_serve_bench",
     "select_block_bucket",
     "select_bucket",

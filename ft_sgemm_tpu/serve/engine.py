@@ -244,6 +244,16 @@ class ServeEngine:
     number of producer threads; execution runs on the engine's single
     dispatcher thread (one device, one dispatch stream — batching, not
     device contention, is the concurrency model).
+
+    ``pool=DevicePool(...)`` (serve/pool.py) turns the dispatcher into
+    a PLACER: ready batches are steered to per-device worker threads by
+    health score and load, each device runs its own AOT-compiled
+    replica of every (bucket, variant) executable, and workers keep a
+    bounded async in-flight window instead of a synchronous per-request
+    wait — the mesh, not one chip, becomes the unit of throughput.
+    Share the live monitor's tracker (``DevicePool(health=mon.health)``)
+    so mid-run health degradation drains a device without operator
+    action.
     """
 
     def __init__(self, buckets: Sequence[Bucket], *,
@@ -251,7 +261,7 @@ class ServeEngine:
                  threshold="static",
                  max_batch: int = 4, max_wait: float = 0.05,
                  max_retries: int = 2, retry_backoff: float = 0.01,
-                 timeline=None, registry=None, monitor=None):
+                 timeline=None, registry=None, monitor=None, pool=None):
         if not buckets:
             raise ValueError("ServeEngine needs at least one bucket")
         if max_batch < 1:
@@ -273,6 +283,12 @@ class ServeEngine:
         # byte-identical (pinned in tests/test_monitor.py, the same
         # discipline as --telemetry in PR 1).
         self.monitor = monitor
+        # Multi-device dispatch (serve/pool.py): with a DevicePool the
+        # dispatcher thread only PLACES ready batches (health-steered);
+        # per-device worker threads execute them against per-device AOT
+        # executables with a bounded async in-flight window. pool=None
+        # keeps the historical single-device engine byte-for-byte.
+        self.pool = pool
         from ft_sgemm_tpu import telemetry
 
         self.registry = registry if registry is not None \
@@ -286,9 +302,11 @@ class ServeEngine:
         self._draining = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        self._pool_threads: list = []
 
         self._compile_lock = threading.Lock()
-        self._compiled: Dict[Tuple[str, str], object] = {}
+        # (bucket key, variant, device label or None) -> executable.
+        self._compiled: Dict[Tuple[str, str, Optional[str]], object] = {}
         self._kernels: Dict[Tuple[str, str], object] = {}
         self._prewarmed = False
 
@@ -362,13 +380,17 @@ class ServeEngine:
         self._kernels[key] = kern
         return kern
 
-    def _get_compiled(self, bucket: Bucket, variant: str):
-        """The AOT-compiled executable for one (bucket, variant) — the
-        object steady-state dispatch calls directly, so serving never
-        re-enters jit tracing. A compile that happens here (i.e. the
-        bucket was NOT prewarmed) is recorded as a ``compile`` span: the
-        timeline never lies about warm-path purity."""
-        key = (bucket.key, variant)
+    def _get_compiled(self, bucket: Bucket, variant: str, device=None):
+        """The AOT-compiled executable for one (bucket, variant[,
+        device]) — the object steady-state dispatch calls directly, so
+        serving never re-enters jit tracing. With ``device`` the avals
+        carry its ``SingleDeviceSharding``, so the executable runs (and
+        its results live) on exactly that pool device. A compile that
+        happens here (i.e. the bucket was NOT prewarmed) is recorded as
+        a ``compile`` span: the timeline never lies about warm-path
+        purity."""
+        label = None if device is None else str(device)
+        key = (bucket.key, variant, label)
         compiled = self._compiled.get(key)
         if compiled is not None:
             return compiled
@@ -379,24 +401,34 @@ class ServeEngine:
             import jax
             import jax.numpy as jnp
 
+            if device is None:
+                def av(shape):
+                    return jax.ShapeDtypeStruct(shape, jnp.float32)
+            else:
+                from jax.sharding import SingleDeviceSharding
+
+                sh = SingleDeviceSharding(device)
+
+                def av(shape):
+                    return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                                sharding=sh)
+
             kern = self._kernel(bucket, variant)
             spec = self._variant_spec(bucket, variant)
-            a_av = jax.ShapeDtypeStruct((bucket.m, bucket.k), jnp.float32)
-            b_av = jax.ShapeDtypeStruct((bucket.n, bucket.k), jnp.float32)
-            c_av = jax.ShapeDtypeStruct((bucket.m, bucket.n), jnp.float32)
-            avals = (a_av, b_av, c_av)
+            avals = (av((bucket.m, bucket.k)), av((bucket.n, bucket.k)),
+                     av((bucket.m, bucket.n)))
             if bucket.epilogue_spec.bias:
                 # The fused bias is a fourth positional operand of the
                 # bucket's ONE executable — per-request bias values,
                 # zero steady-state recompiles.
                 fn = jax.jit(
                     lambda a, b, c, bias: kern(a, b, c, spec, bias=bias))
-                avals = avals + (jax.ShapeDtypeStruct((bucket.n,),
-                                                      jnp.float32),)
+                avals = avals + (av((bucket.n,)),)
             else:
                 fn = jax.jit(lambda a, b, c: kern(a, b, c, spec))
-            with self._tl.span(f"compile[{bucket.key}:{variant}]",
-                               kind="compile"):
+            span = f"compile[{bucket.key}:{variant}]" if label is None \
+                else f"compile[{bucket.key}:{variant}@{label}]"
+            with self._tl.span(span, kind="compile"):
                 compiled = fn.lower(*avals).compile()
             self._compiled[key] = compiled
             return compiled
@@ -406,14 +438,19 @@ class ServeEngine:
         ``cli prewarm``'s machinery applied to the bucket set, with the
         persistent compile cache (``FT_SGEMM_COMPILE_CACHE``) banking
         each one when enabled, so even a server RESTART resumes warm.
-        Emits a ``prewarm_done`` timeline point: everything after it is
-        the steady state the zero-compile-span pin measures."""
+        With a device pool the set is (bucket, variant, DEVICE) — every
+        pool device gets its own replica, so placement never compiles on
+        the hot path. Emits a ``prewarm_done`` timeline point:
+        everything after it is the steady state the zero-compile-span
+        pin measures."""
         t0 = time.monotonic()
         compiled = 0
+        devices = (None,) if self.pool is None else self.pool.devices
         for bucket in self.buckets:
             for variant in variants:
-                self._get_compiled(bucket, variant)
-                compiled += 1
+                for device in devices:
+                    self._get_compiled(bucket, variant, device=device)
+                    compiled += 1
         self._prewarmed = True
         seconds = round(time.monotonic() - t0, 3)
         self._tl.point("serve", "prewarm_done", compiled=compiled,
@@ -429,6 +466,12 @@ class ServeEngine:
                 target=self._dispatch_loop, daemon=True,
                 name="serve-dispatch")
             self._thread.start()
+        if self.pool is not None and not self._pool_threads:
+            for i in range(len(self.pool.devices)):
+                t = threading.Thread(target=self._pool_worker, args=(i,),
+                                     daemon=True, name=f"serve-pool-{i}")
+                t.start()
+                self._pool_threads.append(t)
         return self
 
     def __enter__(self) -> "ServeEngine":
@@ -506,7 +549,42 @@ class ServeEngine:
                             for _ in range(min(len(q), self.max_batch))]
                     batches.append((self._by_key[key], take))
             for bucket, entries in batches:
-                self._execute_batch(bucket, entries)
+                if self.pool is not None:
+                    self._place_batch(bucket, entries)
+                else:
+                    self._execute_batch(bucket, entries)
+
+    def _place_batch(self, bucket: Bucket, entries: Sequence[_Entry]):
+        """Pool mode: the dispatcher only PLACES — the chosen device's
+        worker executes. The placement decision lands in the timeline
+        (trace flow: WHERE each request ran) and the per-device gauges,
+        and the choice itself is the health steer: a drained device's
+        queue receives nothing new."""
+        index = self.pool.choose()
+        label = self.pool.labels[index]
+        depth = self.pool.put(index, (bucket, entries))
+        self.registry.gauge("serve_pool_queue_depth",
+                            device=label).set(depth)
+        self.registry.counter("serve_pool_placements", device=label).inc()
+        self._tl.point("serve", "placement", device=label,
+                       pool_placement=self.pool.placement,
+                       bucket=bucket.key,
+                       trace_ids=[e.request.trace_id for e in entries])
+
+    def _pool_worker(self, index: int):
+        label = self.pool.labels[index]
+        while True:
+            item = self.pool.get(index)
+            if item is None:
+                if self.pool.stopped:
+                    return
+                continue
+            self.registry.gauge("serve_pool_queue_depth", device=label) \
+                .set(self.pool.queue_depth(index))
+            bucket, entries = item
+            self.pool.note_batch(index, len(entries))
+            self.registry.counter("serve_pool_batches", device=label).inc()
+            self._execute_batch(bucket, entries, device_index=index)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted request has resolved. Flushes
@@ -527,8 +605,9 @@ class ServeEngine:
                 self._draining = False
 
     def close(self) -> None:
-        """Stop the dispatcher. Unresolved futures are rejected (a closed
-        engine must never strand a waiter)."""
+        """Stop the dispatcher (and any pool workers). Unresolved
+        futures are rejected (a closed engine must never strand a
+        waiter)."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -536,6 +615,12 @@ class ServeEngine:
             self._thread.join(timeout=10.0)
             self._thread = None
         leftovers = []
+        if self.pool is not None:
+            for bucket, entries in self.pool.stop():
+                leftovers.extend(entries)
+            for t in self._pool_threads:
+                t.join(timeout=10.0)
+            self._pool_threads = []
         with self._cond:
             for q in self._pending.values():
                 leftovers.extend(q)
@@ -563,7 +648,8 @@ class ServeEngine:
             bias[:n] = request.bias
         return a, b, c, bias
 
-    def _execute_batch(self, bucket: Bucket, entries: Sequence[_Entry]):
+    def _execute_batch(self, bucket: Bucket, entries: Sequence[_Entry],
+                       device_index: Optional[int] = None):
         with self._stats_lock:
             self._counts["batches"] += 1
             self._per_bucket[bucket.key]["batches"] += 1
@@ -574,16 +660,66 @@ class ServeEngine:
         with self._tl.span(f"serve[{bucket.key}]", kind="stage",
                            trace_ids=trace_ids) as info:
             det_total = unc_total = 0
-            for entry in entries:
-                det, unc = self._execute_one(bucket, entry)
-                det_total += det
-                unc_total += unc
+            if device_index is None:
+                for entry in entries:
+                    det, unc = self._execute_one(bucket, entry)
+                    det_total += det
+                    unc_total += unc
+            else:
+                det_total, unc_total = self._execute_batch_pooled(
+                    bucket, entries, device_index)
             info["value"] = {"batch": len(entries),
                              "detections": det_total,
                              "uncorrectable_final": unc_total,
                              "trace_ids": trace_ids}
+            if device_index is not None:
+                info["value"]["device"] = self.pool.labels[device_index]
 
-    def _execute_one(self, bucket: Bucket, entry: _Entry) -> Tuple[int, int]:
+    def _execute_batch_pooled(self, bucket: Bucket,
+                              entries: Sequence[_Entry],
+                              device_index: int) -> Tuple[int, int]:
+        """One batch on one pool device, with a bounded ASYNC in-flight
+        window: up to ``pool.max_in_flight`` requests' executables are
+        launched (JAX async dispatch — the call returns before the
+        device finishes) before the oldest result is materialized and
+        its retry ladder/future run. The next request's host-side
+        padding and bookkeeping ride under the previous one's device
+        compute instead of behind a synchronous per-request wait."""
+        device = self.pool.devices[device_index]
+        label = self.pool.labels[device_index]
+        det_total = unc_total = 0
+        window = []
+
+        def complete(item):
+            nonlocal det_total, unc_total
+            entry, operands, res = item
+            det, unc = self._execute_one(
+                bucket, entry, device_index=device_index,
+                prelaunched=(operands, res))
+            n_inf = self.pool.adjust_in_flight(device_index, -1)
+            self.registry.gauge("serve_pool_in_flight",
+                                device=label).set(n_inf)
+            det_total += det
+            unc_total += unc
+
+        for entry in entries:
+            operands = self._pad_operands(bucket, entry.request)
+            compiled = self._get_compiled(bucket, entry.request.variant,
+                                          device=device)
+            res = compiled(*operands)  # async: materialized at complete()
+            n_inf = self.pool.adjust_in_flight(device_index, +1)
+            self.registry.gauge("serve_pool_in_flight",
+                                device=label).set(n_inf)
+            window.append((entry, operands, res))
+            if len(window) >= self.pool.max_in_flight:
+                complete(window.pop(0))
+        while window:
+            complete(window.pop(0))
+        return det_total, unc_total
+
+    def _execute_one(self, bucket: Bucket, entry: _Entry,
+                     device_index: Optional[int] = None,
+                     prelaunched=None) -> Tuple[int, int]:
         """Run one request (with the bucket-scoped retry ladder); resolve
         its future. Returns the final (detections, uncorrectable).
 
@@ -597,20 +733,36 @@ class ServeEngine:
 
         request = entry.request
         with trace_scope(request.trace_id):
-            return self._execute_one_traced(bucket, entry, telemetry)
+            return self._execute_one_traced(
+                bucket, entry, telemetry, device_index=device_index,
+                prelaunched=prelaunched)
 
     def _execute_one_traced(self, bucket: Bucket, entry: _Entry,
-                            telemetry) -> Tuple[int, int]:
+                            telemetry, device_index: Optional[int] = None,
+                            prelaunched=None) -> Tuple[int, int]:
         request = entry.request
         trace_id = request.trace_id
         m, n, _ = request.mnk
-        operands = self._pad_operands(bucket, request)
+        device = (None if device_index is None
+                  else self.pool.devices[device_index])
+        if prelaunched is not None:
+            # Pool path: attempt 0 was already launched asynchronously
+            # by the batch's in-flight window; materializing it here is
+            # the bounded wait.
+            operands, first_res = prelaunched
+        else:
+            operands = self._pad_operands(bucket, request)
+            first_res = None
         variant = request.variant
         retries = 0
         res = det = unc = None
         while True:
-            compiled = self._get_compiled(bucket, variant)
-            res = compiled(*operands)
+            if first_res is not None:
+                res, first_res = first_res, None
+            else:
+                compiled = self._get_compiled(bucket, variant,
+                                              device=device)
+                res = compiled(*operands)
             det = int(np.sum(np.asarray(res.detections)))
             unc = int(np.sum(np.asarray(res.uncorrectable)))
             if unc == 0 or retries >= self.max_retries:
@@ -753,6 +905,8 @@ class ServeEngine:
         out["per_bucket"] = per_bucket
         out["prewarmed"] = self._prewarmed
         out["latency"] = self.latency_percentiles()
+        if self.pool is not None:
+            out["pool"] = self.pool.stats()
         return out
 
 

@@ -325,6 +325,203 @@ def run_serve_bench(*, smoke: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# Multi-device pool workload: goodput scaling + health-steered draining
+# ---------------------------------------------------------------------------
+
+
+def pool_smoke_spec() -> LoadSpec:
+    """The 8-vdev pool CI scenario: a few dozen ragged requests with
+    enough adversarial (uncorrectable -> retry-ladder) traffic that the
+    retry backoff stalls are a real fraction of the wall — the
+    head-of-line blocking the pool's per-device workers remove — plus
+    correctable SDCs and full verification."""
+    return LoadSpec(num_requests=28, inject_rate=0.2,
+                    adversarial_rate=0.25, verify=True)
+
+
+def run_pool_serve_bench(*, smoke: bool = False,
+                         bucket_sizes: Optional[Sequence[int]] = None,
+                         in_dtype: str = "float32",
+                         num_requests: Optional[int] = None,
+                         inject_rate: Optional[float] = None,
+                         adversarial_rate: Optional[float] = None,
+                         rate: Optional[float] = None,
+                         max_batch: int = 2, max_wait: float = 0.05,
+                         verify: Optional[bool] = None,
+                         devices=None,
+                         placement: str = "health",
+                         sick_device: Optional[int] = 1,
+                         drain_below: float = 0.5,
+                         max_in_flight: int = 2,
+                         retry_backoff: float = 0.2,
+                         timeline=None,
+                         should_stop: Optional[Callable[[], bool]] = None,
+                         progress_out=None,
+                         monitor="auto", monitor_port: Optional[int] = None,
+                         slo=None,
+                         epilogue: str = "none") -> dict:
+    """``bench.py --serve --pool``: the SAME load through the
+    single-device engine and the device-pool engine, reporting goodput
+    scaling.
+
+    Two stages, identical :class:`LoadSpec` (same seed — identical
+    request streams) and identical retry config:
+
+    1. **single** — the historical one-device engine (the control).
+    2. **pool** — a :class:`~ft_sgemm_tpu.serve.pool.DevicePool` over
+       ``devices`` (default: every local device), health-steered
+       placement sharing the live monitor's tracker, bounded async
+       in-flight per device worker.
+
+    ``sick_device`` (default 1; ``None`` disables) marks that pool
+    device sick BEFORE the load (``DevicePool.mark_sick`` — synthetic
+    uncorrectable counts, the drain self-test the same way
+    ``inject_coords`` is the attribution self-test): the acceptance
+    facts are placement spread over >1 device, ZERO batches on the
+    marked device, and goodput intact without it.
+
+    ``retry_backoff`` (applied to BOTH engines — the comparison stays
+    apples-to-apples) models the transient-SDC cool-down before an
+    uncorrectable request's clean re-run. On the single-device engine
+    every backoff stalls the one dispatch thread — head-of-line
+    blocking for every bucket; the pool overlaps the stalls across
+    device workers (and, on multi-core/TPU hosts, overlaps the compute
+    itself), which is where the throughput scaling comes from.
+
+    Per-engine stats are isolated in private registries so the two
+    stages' latency histograms never mix. Returns the pool stats dict
+    with ``single`` (the control's numbers), ``scaling``
+    (pool/single throughput + goodput ratios), and ``pool`` (per-device
+    placement, drained list) sections.
+    """
+    from ft_sgemm_tpu.serve.pool import DevicePool
+    from ft_sgemm_tpu.telemetry.registry import MetricsRegistry
+
+    sizes = tuple(bucket_sizes) if bucket_sizes else (
+        (128, 256) if smoke else (256, 512, 1024))
+    buckets = default_bucket_set(sizes, in_dtype=in_dtype,
+                                 epilogue=epilogue)
+    base = pool_smoke_spec() if smoke else LoadSpec(
+        num_requests=64, inject_rate=0.2, adversarial_rate=0.1,
+        verify=False)
+    spec = dataclasses.replace(
+        base,
+        in_dtype=in_dtype,
+        epilogue=buckets[0].epilogue,
+        num_requests=base.num_requests if num_requests is None
+        else int(num_requests),
+        inject_rate=base.inject_rate if inject_rate is None
+        else float(inject_rate),
+        adversarial_rate=base.adversarial_rate if adversarial_rate is None
+        else float(adversarial_rate),
+        rate=base.rate if rate is None else float(rate),
+        verify=base.verify if verify is None else bool(verify),
+    )
+    largest = max(s for s in sizes)
+    shapes = tuple(s for s in spec.shapes if max(s) <= largest)
+    spec = dataclasses.replace(spec, shapes=shapes or ((largest // 2,) * 3,))
+
+    def progress(p):
+        if timeline is not None:
+            timeline.point("serve_progress", "load", **p)
+        if progress_out is not None:
+            print(f"serve-pool-bench: {p}", file=progress_out, flush=True)
+
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    mon = None
+    mon_server = None
+    if monitor == "auto":
+        from ft_sgemm_tpu.telemetry.monitor import Monitor
+
+        mon = Monitor(slo=slo)
+    elif monitor is not None:
+        mon = monitor
+    if mon is not None:
+        mon.attach()
+        if monitor_port is not None:
+            from ft_sgemm_tpu.telemetry.monitor import MonitorServer
+
+            mon_server = MonitorServer(mon, port=monitor_port).start()
+            progress({"monitor_url": mon_server.url})
+    try:
+        t0 = time.monotonic()
+        # Stage 1: the single-device control. Private registry so its
+        # latency histogram never bleeds into the pool stage's.
+        with ServeEngine(buckets, max_batch=max_batch, max_wait=max_wait,
+                         retry_backoff=retry_backoff,
+                         timeline=timeline,
+                         registry=MetricsRegistry()) as engine:
+            single_prewarm = engine.prewarm()
+            progress({"stage": "single",
+                      "prewarmed": single_prewarm["compiled"]})
+            single = run_load(engine, spec, should_stop=should_stop,
+                              progress=progress)
+
+        # Stage 2: the pool. Health steering shares the live monitor's
+        # tracker when one exists, so mid-run degradation drains too.
+        pool = DevicePool(devices, placement=placement,
+                          health=mon.health if mon is not None else None,
+                          drain_below=drain_below,
+                          max_in_flight=max_in_flight)
+        sick_label = None
+        if sick_device is not None and len(pool.devices) > 1 \
+                and 0 <= sick_device < len(pool.devices):
+            sick_label = pool.mark_sick(sick_device)
+            progress({"stage": "pool", "sick_device": sick_label})
+        with ServeEngine(buckets, max_batch=max_batch, max_wait=max_wait,
+                         retry_backoff=retry_backoff,
+                         timeline=timeline, monitor=mon,
+                         registry=MetricsRegistry(),
+                         pool=pool) as engine:
+            pool_prewarm = engine.prewarm()
+            progress({"stage": "pool",
+                      "prewarmed": pool_prewarm["compiled"]})
+            stats = run_load(engine, spec, should_stop=should_stop,
+                             progress=progress)
+            stats["pool"] = engine.stats()["pool"]
+        stats["prewarm"] = pool_prewarm
+        stats["single_prewarm"] = single_prewarm
+        stats["buckets"] = [b.key for b in buckets]
+        stats["smoke"] = bool(smoke)
+        stats["epilogue"] = buckets[0].epilogue
+        stats["retry_backoff"] = retry_backoff
+        stats["sick_device"] = sick_label
+        if sick_label is not None:
+            row = stats["pool"]["per_device"].get(sick_label, {})
+            stats["sick_device_batches"] = row.get("batches")
+            stats["sick_device_drained"] = (
+                sick_label in stats["pool"]["drained"]
+                and row.get("batches", 0) == 0)
+        stats["single"] = {
+            k: single.get(k)
+            for k in ("completed", "correct", "throughput_rps",
+                      "goodput_rps", "p50_latency_seconds",
+                      "p99_latency_seconds", "wall_seconds", "retries",
+                      "uncorrectable_final")}
+        scaling = {}
+        for key in ("throughput_rps", "goodput_rps"):
+            s, p = single.get(key), stats.get(key)
+            if s and p:
+                scaling[key.replace("_rps", "_ratio")] = round(p / s, 3)
+        stats["scaling"] = scaling
+        stats["seconds_total"] = round(time.monotonic() - t0, 3)
+        if mon is not None:
+            stats["slo"] = mon.snapshot()
+            stats["device_health"] = stats["slo"]["device_health"]
+            if mon_server is not None:
+                stats["monitor_url"] = mon_server.url
+    finally:
+        if mon_server is not None:
+            mon_server.close()
+        if mon is not None:
+            mon.detach()
+    return stats
+
+
+# ---------------------------------------------------------------------------
 # Transformer-block workload: ragged prefill/decode, tokens-correct/sec
 # ---------------------------------------------------------------------------
 
@@ -743,5 +940,6 @@ def run_block_serve_bench(*, smoke: bool = False,
 
 
 __all__ = ["BlockLoadSpec", "LoadSpec", "block_smoke_spec",
-           "run_block_load", "run_block_serve_bench", "run_load",
-           "run_serve_bench", "smoke_spec"]
+           "pool_smoke_spec", "run_block_load", "run_block_serve_bench",
+           "run_load", "run_pool_serve_bench", "run_serve_bench",
+           "smoke_spec"]

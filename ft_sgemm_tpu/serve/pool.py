@@ -1,0 +1,252 @@
+"""Multi-device serve dispatch: a health-steered device pool.
+
+The single-device :class:`~ft_sgemm_tpu.serve.engine.ServeEngine` runs
+every batch on the default device from one dispatcher thread, blocking
+per request — the mesh sits idle while one chip works. This module makes
+the MESH the unit of serving throughput:
+
+- **Replicated executables.** Each (bucket, variant) executable is
+  AOT-compiled once PER POOL DEVICE (``jax.ShapeDtypeStruct`` avals
+  carrying a ``SingleDeviceSharding`` — the engine's
+  ``_get_compiled(..., device=)`` does the compiling), so steady-state
+  dispatch on any device never re-enters tracing and the
+  zero-compile-span warm-path contract holds pool-wide.
+- **Health-steered placement.** Each ready batch is placed on the
+  healthiest least-loaded device: eligibility is
+  ``DeviceHealthTracker.score >= drain_below x the fleet MEDIAN score``
+  (relative on purpose — see :meth:`DevicePool._drain_floor`; sick
+  devices are DRAINED — they finish what they hold but receive no new
+  batches — unless every device falls through the floor, when refusing
+  service would be worse than degraded service), and among eligible
+  devices the one with the fewest queued+in-flight batches per unit of
+  health wins. The tracker
+  is normally the live monitor's (``Monitor.health`` — the same scores
+  ``/healthz`` reports), so a device whose detection counters or
+  residual drift degrade MID-RUN stops receiving traffic without any
+  operator action; :meth:`DevicePool.mark_sick` injects synthetic
+  uncorrectable counts for one device — the drain self-test knob, the
+  serving analog of ``inject_coords``.
+- **Bounded async in-flight.** Workers launch up to ``max_in_flight``
+  requests' executables before materializing the first result, riding
+  JAX's async dispatch instead of a synchronous per-request wait — on a
+  real mesh the next request's host-side work (padding, bookkeeping)
+  and the previous one's device compute overlap, and a retrying request
+  (backoff sleep) never head-of-line-blocks the other devices' queues.
+
+Observability: per-device ``serve_pool_queue_depth`` / ``serve_pool_in_
+flight`` gauges and ``serve_pool_batches`` counters in the registry, and
+a ``placement`` timeline point per batch carrying the batch's trace_ids,
+the chosen device, and the policy — so the trace flow shows WHERE each
+request ran, joined to the tile-level blame the engine already emits.
+
+``PLACEMENTS`` is the runtime spelling of ``contracts.POOL_PLACEMENTS``
+(the lint axis-drift pass cross-checks the two): ``"health"`` as above,
+``"round_robin"`` ignores health (the A/B control).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+PLACEMENTS = ("health", "round_robin")
+
+
+class DevicePool:
+    """Placement + queueing state for multi-device serve dispatch.
+
+    The pool owns WHERE work runs (device choice, per-device queues,
+    health eligibility); the engine owns WHAT runs (executables, the
+    retry ladder, futures). ``devices`` defaults to every local device;
+    ``health`` is a :class:`~ft_sgemm_tpu.telemetry.monitor
+    .DeviceHealthTracker` (the engine wires the monitor's in when one
+    exists; a private tracker otherwise). ``drain_below`` is the
+    eligibility threshold on the tracker's score; ``max_in_flight``
+    bounds each worker's async launch window.
+    """
+
+    def __init__(self, devices: Optional[Sequence] = None, *,
+                 placement: str = "health",
+                 health=None,
+                 drain_below: float = 0.5,
+                 max_in_flight: int = 2):
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"DevicePool.placement={placement!r} must be one of"
+                f" {PLACEMENTS}")
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        if max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight={max_in_flight} must be >= 1")
+        self.devices = tuple(devices)
+        self.labels = tuple(str(d) for d in self.devices)
+        self.placement = placement
+        self.drain_below = float(drain_below)
+        self.max_in_flight = int(max_in_flight)
+        if health is None and placement == "health":
+            from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
+
+            health = DeviceHealthTracker()
+        self.health = health
+
+        self._lock = threading.Lock()
+        self._queues: Dict[int, collections.deque] = {
+            i: collections.deque() for i in range(len(self.devices))}
+        self._in_flight = {i: 0 for i in range(len(self.devices))}
+        self._batches = {i: 0 for i in range(len(self.devices))}
+        self._requests = {i: 0 for i in range(len(self.devices))}
+        self._rr = itertools.cycle(range(len(self.devices)))
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+
+    # -- health ------------------------------------------------------------
+
+    def score(self, index: int) -> float:
+        if self.health is None:
+            return 1.0
+        return float(self.health.score(self.labels[index]))
+
+    def mark_sick(self, index: int, *, calls: int = 100,
+                  uncorrectable: Optional[int] = None) -> str:
+        """Feed synthetic uncorrectable counts for one device into the
+        health tracker — the drain SELF-TEST knob (the serving analog of
+        ``inject_coords``): the marked device's score collapses below
+        any sane ``drain_below`` and placement must route around it.
+        Returns the device label marked."""
+        if self.health is None:
+            raise ValueError("mark_sick needs a health tracker"
+                             " (placement='health')")
+        unc = calls if uncorrectable is None else uncorrectable
+        self.health.observe(self.labels[index], calls=calls,
+                            detected=unc, uncorrectable=unc)
+        return self.labels[index]
+
+    def _drain_floor(self, scores: List[float]) -> float:
+        """The eligibility floor for one score snapshot:
+        ``drain_below`` x the fleet MEDIAN. Relative, not absolute, on
+        purpose: the tracker's score compounds detection rates, so a
+        uniformly-injected load (every device correcting SDCs at the
+        same rate) depresses every score together — an absolute floor
+        would then drain the whole fleet, and refusing all service over
+        corrected (i.e. FREE) faults is exactly the economics the paper
+        rejects. A device an order of magnitude sicker than its peers —
+        uncorrectables, drift — falls through the relative floor no
+        matter where the fleet baseline sits."""
+        med = sorted(scores)[len(scores) // 2]
+        return self.drain_below * max(med, 1e-9)
+
+    def eligible(self) -> List[int]:
+        """Devices placement may use: ones at or above the relative
+        drain floor; every device when none clears it (degraded service
+        beats refused service)."""
+        idx = list(range(len(self.devices)))
+        if self.placement != "health" or self.health is None:
+            return idx
+        scores = [self.score(i) for i in idx]
+        floor = self._drain_floor(scores)
+        ok = [i for i in idx if scores[i] >= floor]
+        return ok or idx
+
+    # -- placement + queues ------------------------------------------------
+
+    def choose(self) -> int:
+        """Pick the device for one ready batch (called under no lock;
+        takes the pool lock briefly). Health policy: among eligible
+        devices, least (queued + in-flight) per unit of score."""
+        if self.placement == "round_robin":
+            with self._lock:
+                return next(self._rr)
+        cand = self.eligible()
+        with self._lock:
+            return min(cand, key=lambda i: (
+                (len(self._queues[i]) + self._in_flight[i] + 1)
+                / max(self.score(i), 1e-6), i))
+
+    def put(self, index: int, item) -> int:
+        """Enqueue one placed batch for ``index``'s worker; returns the
+        device's new queue depth."""
+        with self._lock:
+            self._queues[index].append(item)
+            depth = len(self._queues[index])
+            self._work.notify_all()
+        return depth
+
+    def get(self, index: int, timeout: float = 0.1):
+        """Worker side: pop the next batch for device ``index`` (None on
+        timeout/stop)."""
+        with self._lock:
+            if not self._queues[index] and not self._stop:
+                self._work.wait(timeout)
+            if self._queues[index]:
+                return self._queues[index].popleft()
+            return None
+
+    def stop(self) -> list:
+        """Flag workers to exit and return every unexecuted queued item
+        (the engine rejects their futures — a closed pool must not
+        strand waiters)."""
+        leftovers = []
+        with self._lock:
+            self._stop = True
+            for q in self._queues.values():
+                leftovers.extend(q)
+                q.clear()
+            self._work.notify_all()
+        return leftovers
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop
+
+    # -- accounting --------------------------------------------------------
+
+    def note_batch(self, index: int, n_requests: int) -> None:
+        with self._lock:
+            self._batches[index] += 1
+            self._requests[index] += n_requests
+
+    def adjust_in_flight(self, index: int, delta: int) -> int:
+        with self._lock:
+            self._in_flight[index] += delta
+            return self._in_flight[index]
+
+    def queue_depth(self, index: int) -> int:
+        with self._lock:
+            return len(self._queues[index])
+
+    def stats(self) -> dict:
+        """Per-device placement snapshot + the drain picture."""
+        with self._lock:
+            rows = {
+                self.labels[i]: {
+                    "batches": self._batches[i],
+                    "requests": self._requests[i],
+                    "queued": len(self._queues[i]),
+                    "in_flight": self._in_flight[i],
+                }
+                for i in range(len(self.devices))
+            }
+        scores = [self.score(i) for i in range(len(self.devices))]
+        for i, label in enumerate(self.labels):
+            rows[label]["health"] = round(scores[i], 6)
+        used = sum(1 for r in rows.values() if r["batches"] > 0)
+        drained = []
+        if self.placement == "health" and self.health is not None:
+            floor = self._drain_floor(scores)
+            drained = [label for i, label in enumerate(self.labels)
+                       if scores[i] < floor]
+        return {"devices": len(self.devices), "devices_used": used,
+                "placement": self.placement,
+                "drain_below": self.drain_below,
+                "max_in_flight": self.max_in_flight,
+                "drained": drained, "per_device": rows}
+
+
+__all__ = ["DevicePool", "PLACEMENTS"]

@@ -66,6 +66,15 @@ AXIS_LABELS = {
     "dim_semantics": ("parallel", "arbitrary"),
     "epilogue_activation": ("none", "relu", "gelu"),
     "epilogue_quantize": ("none", "int8", "float8_e4m3fn"),
+    # Ring hop schedule (PR 14) — mirrors configs.RING_OVERLAP_MODES and
+    # contracts.VARIANT_AXES["ring_overlap"]; rides mesh-GEMM event
+    # ``extra["ring_overlap"]``.
+    "ring_overlap": ("serial", "overlap"),
+    # Serve device-pool placement policy — mirrors
+    # contracts.POOL_PLACEMENTS (serve/pool.py::PLACEMENTS is the
+    # runtime spelling); rides pool placement timeline points and
+    # serve_gemm event extras when the pool executes the request.
+    "pool_placement": ("health", "round_robin"),
 }
 
 

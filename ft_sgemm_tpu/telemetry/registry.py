@@ -274,6 +274,8 @@ _METRIC_HELP_PREFIXES = {
     "hlo_": "Optimized-HLO census facts (perf/hlo.py)",
     "tuner_": "Autotuner search/measurement counters",
     "lint_": "Static contract checker facts (ft_sgemm_tpu/lint)",
+    "serve_pool_": "Multi-device serve pool: per-device placement/"
+                   "queue-depth/in-flight gauges (serve/pool.py)",
 }
 
 

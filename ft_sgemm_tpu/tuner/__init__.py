@@ -138,21 +138,28 @@ def override_disabled():
 def variant_key_components(variant: Optional[KernelVariant],
                            cadence: Optional[int],
                            epilogue: str = "none") -> dict:
-    """The schema-4 ``pipe=``/``grid=``/``cad=``/``epi=`` key components
-    for one dispatch constraint: ``"auto"`` for every axis the caller
-    left to the search, the explicit spelling for pinned axes. ONE
-    resolver shared by dispatch lookup and the search's store so the two
-    sides can never key differently."""
+    """The schema-5 ``pipe=``/``grid=``/``cad=``/``epi=``/``ring=`` key
+    components for one dispatch constraint: ``"auto"`` for every axis
+    the caller left to the search, the explicit spelling for pinned
+    axes. ONE resolver shared by dispatch lookup and the search's store
+    so the two sides can never key differently. The single-device
+    kernel family has no ring, so its constraint spells
+    ``ring="serial"`` (the :class:`KernelVariant` default) — the ring
+    wrappers key their own lookups ``ring="auto"`` through
+    :func:`lookup_ring_overlap`."""
     if variant is not None:
         pipe = str(variant.pipeline_depth)
         grid = variant.grid_spelling
+        ring = variant.ring_overlap
     else:
         pipe = grid = "auto"
+        ring = "serial"
     return {
         "pipe": pipe,
         "grid": grid,
         "cad": "auto" if cadence is None else str(cadence),
         "epi": EpilogueSpec.parse(epilogue).spelling,
+        "ring": ring,
     }
 
 
@@ -216,6 +223,88 @@ def lookup_tile(m: int, n: int, k: int, *, strategy: Optional[str],
         injection_enabled=injection_enabled, encode=encode,
         threshold_mode=threshold_mode)
     return tile
+
+
+def lookup_ring_overlap(m_loc: int, n_loc: int, k: int, *,
+                        strategy: Optional[str], in_dtype,
+                        injection_enabled: bool = False) -> Optional[str]:
+    """The cached winning ring hop schedule for one PER-DEVICE local
+    shard problem, or None (dispatch then runs the serial default).
+
+    The ring wrappers key on the local shard dims — ``(m/d, n/d, k)``
+    for the GEMM ring, the per-hop QK problem for ring attention — so
+    the ring size rides the key through the bucketed dims, and the
+    constraint spells ``ring="auto"`` (the record's ``variant`` carries
+    the searched winner, :func:`tune_ring` banks it). Pure host-side
+    and subject to the same enabled()/disabled discipline as every
+    other lookup.
+    """
+    from ft_sgemm_tpu.configs import RING_OVERLAP_MODES
+
+    if not enabled():
+        return None
+    rec = cache.lookup(make_key(
+        m_loc, n_loc, k, strategy=strategy, in_dtype=in_dtype,
+        injection_enabled=injection_enabled, ring="auto"))
+    _count_lookup(rec is not None)
+    if rec is None:
+        return None
+    vrec = rec.get("variant")
+    mode = vrec.get("ring_overlap") if isinstance(vrec, dict) else None
+    return mode if mode in RING_OVERLAP_MODES else None
+
+
+def tune_ring(
+    m: int, n: Optional[int] = None, k: Optional[int] = None, *,
+    mesh=None,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    method: Optional[str] = None,
+    alpha: float = 1.0, beta: float = -1.5,
+    reps: int = 2, samples: int = 2,
+    write_cache: bool = True,
+) -> dict:
+    """Search the ``ring_overlap`` axis for one GLOBAL ring problem and
+    persist the winner under the per-device local-shard key.
+
+    ``method`` is ``"wall"`` (time both schedules through jit-once ring
+    executors — the TPU default) or ``"cost"`` (the
+    :func:`measure.ring_schedule_cost` model — the CPU default, where
+    virtual devices have no ICI to time). The stored record's
+    ``variant.ring_overlap`` is what :func:`lookup_ring_overlap` serves
+    to ``ring_ft_sgemm``/ring attention dispatch with
+    ``ring_overlap=None``/"auto".
+    """
+    n = m if n is None else n
+    k = m if k is None else k
+    if mesh is None:
+        from ft_sgemm_tpu.parallel.ring import make_ring_mesh
+
+        mesh = make_ring_mesh()
+    d = mesh.shape["x"]
+    with override_disabled():
+        report = measure.measure_ring_schedules(
+            m, n, k, mesh, strategy=strategy, in_dtype=in_dtype,
+            method=method, alpha=alpha, beta=beta, reps=reps,
+            samples=samples)
+    win = report["winner"]
+    key = make_key(m // d, n // d, k, strategy=strategy,
+                   in_dtype=in_dtype, injection_enabled=False,
+                   ring="auto")
+    report["key"] = key
+    if write_cache:
+        tile = heuristic_shape(m // d, n // d, k, strategy=strategy,
+                               in_dtype=in_dtype)
+        record = {
+            "block": list(tile.block),
+            "variant": variant_asdict(KernelVariant(ring_overlap=win)),
+            "ring": {mode: report[mode] for mode in ("serial", "overlap")},
+            "method": report["method"],
+            "problem": [m, n, k],
+            "ring_size": d,
+        }
+        report["cache_path"] = cache.store(key, record)
+    return report
 
 
 def tune(
@@ -399,10 +488,12 @@ __all__ = [
     "enumerate_joint_space",
     "enumerate_space",
     "heuristic_shape",
+    "lookup_ring_overlap",
     "lookup_stats",
     "lookup_tile",
     "lookup_winner",
     "make_key",
+    "tune_ring",
     "reset_lookup_stats",
     "measure",
     "measure_space",

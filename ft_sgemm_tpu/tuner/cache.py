@@ -61,7 +61,15 @@ from typing import Optional
 # MISS -> re-tune, pinned in tests/test_variants.py), never migrated:
 # their keys would silently collide every variant's winner onto one
 # entry.
-SCHEMA_VERSION = 4
+# Schema 5: the ring hop schedule joined the key (``ring=serial`` /
+# ``ring=overlap`` / ``ring=auto``) when the ring collective paths'
+# rotate-ahead pipeline became a searched axis (``tuner.tune_ring``
+# banks winners keyed on the PER-DEVICE local shard problem). The
+# single-device key family carries ``ring=serial`` — there is no ring —
+# so schema-4 files would not collide, but every key string changed
+# shape; the standard ignored-with-warning miss (pinned in
+# tests/test_overlap_pool.py) keeps the contract uniform.
+SCHEMA_VERSION = 5
 ENV_CACHE_PATH = "FT_SGEMM_TUNER_CACHE"
 _DEFAULT_PATH = os.path.join(
     os.path.expanduser("~"), ".cache", "ft_sgemm_tpu", "tuner_cache.json")
@@ -125,7 +133,7 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
              in_dtype, injection_enabled: bool, encode: str = "vpu",
              threshold_mode: str = "static",
              pipe: str = "auto", grid: str = "auto", cad: str = "auto",
-             epi: str = "none",
+             epi: str = "none", ring: str = "serial",
              device: Optional[str] = None) -> str:
     """The canonical cache key for one dispatch site.
 
@@ -153,7 +161,12 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
     variant. ``epi`` is the fused-epilogue SPELLING
     (``configs.EpilogueSpec``, default ``"none"``): always concrete,
     since the epilogue is workload-owned and changes the winning tile's
-    register/VPU balance.
+    register/VPU balance. ``ring`` is the ring hop schedule axis
+    (schema 5, ``configs.RING_OVERLAP_MODES``): the single-device key
+    family spells it ``serial`` (there is no ring), ring dispatch keys
+    ``auto`` with the winning mode in the record's ``variant``, and the
+    problem dims of a ring key are the PER-DEVICE local shard — the
+    ring size therefore rides the key through the bucketed shard dims.
     """
     from ft_sgemm_tpu.configs import canonical_in_dtype
 
@@ -166,7 +179,8 @@ def make_key(m: int, n: int, k: int, *, strategy: Optional[str],
     return (f"{dev}|{bm}x{bn}x{bk}|{canonical_in_dtype(in_dtype)}"
             f"|{strat}|enc={enc}|thr={thr}"
             f"|inj={int(bool(injection_enabled))}"
-            f"|pipe={pipe}|grid={grid}|cad={cad}|epi={epi}")
+            f"|pipe={pipe}|grid={grid}|cad={cad}|epi={epi}"
+            f"|ring={ring}")
 
 
 def _valid_block(block) -> bool:

@@ -261,3 +261,139 @@ def best_result(results: Sequence[MeasureResult]) -> Optional[MeasureResult]:
     """The winning measurement (lowest score among ok results), or None."""
     ok = [r for r in results if r.ok]
     return min(ok, key=lambda r: r.score) if ok else None
+
+
+# ---------------------------------------------------------------------------
+# Ring hop-schedule axis (configs.RING_OVERLAP_MODES)
+# ---------------------------------------------------------------------------
+
+# One ICI link direction's sustained bandwidth, bytes/second. Provenance:
+# ~100 GB/s per link per direction on v4/v5p (Google's published 4800
+# Gbps aggregate over 6 links, two directions), derated ~10% for
+# protocol/framing — the same spirit as the roofline table's documented
+# estimates (perf/roofline.py). The COST METHOD only ranks the two hop
+# schedules; absolute accuracy matters far less than the compute/ICI
+# ratio's sign, and the wall method exists for hardware truth.
+ICI_BYTES_PER_SECOND = 9.0e10
+
+RING_METHODS = ("wall", "cost")
+
+
+def default_ring_method() -> str:
+    """``wall`` on a real TPU (ICI is real there); ``cost`` everywhere
+    else — CPU virtual devices have no interconnect, so wall-timing the
+    two schedules there measures host-threading noise, not the ring."""
+    import jax
+
+    return "wall" if jax.default_backend() == "tpu" else "cost"
+
+
+def ring_schedule_cost(m: int, n: int, k: int, d: int, *, overlap: bool,
+                       peak_flops: Optional[float] = None,
+                       itemsize: int = 4,
+                       ici_bytes_per_second: float = ICI_BYTES_PER_SECOND,
+                       device_kind: Optional[str] = None,
+                       in_dtype: str = "float32") -> float:
+    """Modeled seconds for one full ring sweep under one hop schedule —
+    the ``ring_overlap`` axis priced in the cost model.
+
+    Per hop a device computes a 2*(m/d)*(n/d)*k-flop local FT-GEMM and
+    moves one (n/d, k) B shard over ICI. The serial schedule pays the
+    two in sequence every hop; rotate-ahead pays the slower of the two
+    (plus one exposed transfer and compute at the pipeline's ends,
+    and the prologue's extra rotation documented in
+    ``parallel/ring.py``). ``peak_flops`` defaults to the roofline
+    table's dtype-correct peak for ``device_kind`` (the live device when
+    None), falling back to 1 TFLOP/s when no spec is known — rankings,
+    not absolute truth.
+    """
+    if peak_flops is None:
+        peak_flops = _peak_flops_for(device_kind, in_dtype)
+    t_c = 2.0 * (m / d) * (n / d) * k / peak_flops
+    t_i = (n / d) * k * itemsize / ici_bytes_per_second
+    if overlap:
+        return t_c + t_i + (d - 1) * max(t_c, t_i) + t_i
+    return d * (t_c + t_i)
+
+
+def _peak_flops_for(device_kind: Optional[str], in_dtype: str) -> float:
+    try:
+        from ft_sgemm_tpu.perf.roofline import find_spec
+
+        if device_kind is None:
+            import jax
+
+            device_kind = str(jax.local_devices()[0].device_kind)
+        spec = find_spec(device_kind)
+        peak = spec.peak_for(in_dtype) if spec is not None else None
+        if peak:
+            return float(peak)
+    except Exception:  # noqa: BLE001 — ranking fallback, never a gate
+        pass
+    return 1.0e12
+
+
+def measure_ring_schedules(
+    m: int, n: int, k: int, mesh=None, *,
+    strategy: Optional[str] = "weighted",
+    in_dtype: str = "float32",
+    method: Optional[str] = None,
+    alpha: float = 1.0, beta: float = -1.5,
+    reps: int = 2, samples: int = 2,
+) -> dict:
+    """Measure (or cost-model) BOTH ring hop schedules for one problem.
+
+    Returns ``{"serial": {...}, "overlap": {...}, "winner": mode,
+    "method": method, "d": ring_size}`` where each mode row carries
+    ``score`` (lower is better: wall seconds or modeled seconds) and,
+    for the wall method, ``seconds``/``gflops``. The wall method builds
+    each schedule's executor ONCE (``parallel.ring.make_ring_ft_sgemm_fn``)
+    and times it with the usual warmup/median discipline; the cost
+    method never touches a device.
+    """
+    method = default_ring_method() if method is None else method
+    if method not in RING_METHODS:
+        raise ValueError(
+            f"unknown ring method {method!r}; pick from {RING_METHODS}")
+    import jax
+
+    if mesh is None:
+        from ft_sgemm_tpu.parallel.ring import make_ring_mesh
+
+        mesh = make_ring_mesh()
+    d = mesh.shape["x"]
+    out = {"method": method, "d": d, "problem": [m, n, k]}
+    if method == "cost":
+        kind = str(jax.local_devices()[0].device_kind)
+        for mode in ("serial", "overlap"):
+            out[mode] = {"score": ring_schedule_cost(
+                m, n, k, d, overlap=mode == "overlap", device_kind=kind,
+                in_dtype=in_dtype)}
+    else:
+        import jax.numpy as jnp
+
+        from ft_sgemm_tpu.injection import InjectionSpec
+        from ft_sgemm_tpu.parallel.ring import make_ring_ft_sgemm_fn
+        from ft_sgemm_tpu.tuner.space import heuristic_shape
+        from ft_sgemm_tpu.utils.timing import median_seconds_per_call
+
+        a, b, c = _inputs_memo(m, n, k, in_dtype)
+        shape = heuristic_shape(m // d, n // d, k, strategy=strategy,
+                                in_dtype=in_dtype)
+        for mode in ("serial", "overlap"):
+            fn = make_ring_ft_sgemm_fn(
+                mesh, d, n // d, n, shape, alpha=alpha, beta=beta,
+                inject=InjectionSpec.none(),
+                strategy=strategy or "weighted", threshold="static",
+                precision="highest", in_dtype=in_dtype, interpret=None,
+                inject_coords=None, overlap=mode == "overlap")
+            jfn = jax.jit(lambda x, y, z, _f=fn: _f(x, y, z)[0])
+            a32 = jnp.asarray(a, jnp.float32)
+            b32 = jnp.asarray(b, jnp.float32)
+            sec = median_seconds_per_call(jfn, a32, b32, c, reps=reps,
+                                          samples=samples)
+            out[mode] = {"score": sec, "seconds": sec,
+                         "gflops": 2.0 * m * n * k / 1e9 / sec}
+    out["winner"] = min(("serial", "overlap"),
+                        key=lambda mode: out[mode]["score"])
+    return out

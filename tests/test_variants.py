@@ -302,13 +302,14 @@ def test_vmem_prices_cadence_through_body_choice():
 # -- (3) cache schema 4 -----------------------------------------------------
 
 
-def test_schema_is_4_and_schema3_misses_with_warning(tmp_path, monkeypatch):
-    assert tcache.SCHEMA_VERSION == 4
+def test_schema_is_5_and_schema3_misses_with_warning(tmp_path, monkeypatch):
+    assert tcache.SCHEMA_VERSION == 5
     path = tmp_path / "cache.json"
-    # A well-formed SCHEMA-3 file (the previous release's layout): its
-    # keys lack the variant components, so serving them would collide
-    # every variant's winner — the load must MISS with the standard
-    # warning, exactly like the 2->3 migration pin.
+    # A well-formed SCHEMA-3 file (two releases back): its keys lack the
+    # variant components, so serving them would collide every variant's
+    # winner — the load must MISS with the standard warning, exactly
+    # like the 2->3 migration pin. (The 4->5 ring-axis migration is
+    # pinned the same way in tests/test_overlap_pool.py.)
     path.write_text(json.dumps({"schema": 3, "entries": {
         "cpu|256x256x256|float32|weighted|enc=vpu|thr=static|inj=0":
             {"block": [256, 256, 256]},
@@ -342,12 +343,12 @@ def test_make_key_carries_variant_components_without_collisions():
 def test_variant_key_components_resolver():
     comp = tuner.variant_key_components(None, None, "none")
     assert comp == {"pipe": "auto", "grid": "auto", "cad": "auto",
-                    "epi": "none"}
+                    "epi": "none", "ring": "serial"}
     v = KernelVariant(pipeline_depth=3, grid_order="nm",
                       dim_semantics="arbitrary")
     comp = tuner.variant_key_components(v, 8, "bias+relu")
     assert comp == {"pipe": "3", "grid": "nm.arbitrary", "cad": "8",
-                    "epi": "bias+relu"}
+                    "epi": "bias+relu", "ring": "serial"}
 
 
 # -- (5) joint search -------------------------------------------------------
