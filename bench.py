@@ -2477,15 +2477,27 @@ def serve_main(argv):
     stored-state fault counters (``kv_faults`` /
     ``kv_corrected_in_place`` / ``kv_page_restores``) in context;
     ``--decode-ratio=R`` and ``--kv-corrupt-rate=R`` shape the mix.
-    ``--pool`` (GEMM workload) runs the MULTI-DEVICE pool stage
+    ``--pool`` runs the MULTI-DEVICE pool stage
     (``serve/pool.py``): the same load drives the single-device engine
     and then a health-steered device pool over every local device —
     per-device AOT replicas, bounded async in-flight, a marked-sick
-    device drained (``--sick-device=N``, default 1, ``none`` disables)
-    — and the artifact reports goodput scaling (``context.scaling``),
-    per-device placement (``context.pool.per_device``), and the drain
-    outcome; rc!=0 unless placement spread over >1 device and the sick
-    device was drained.
+    device drained (``--sick-device=N``, default 1, ``none`` disables;
+    GEMM workload only) — and the artifact reports goodput scaling
+    (``context.scaling``), per-device placement
+    (``context.pool.per_device``), and the drain outcome; rc!=0 unless
+    placement spread over >1 device and the sick device was drained.
+    ``--pool --workload=block`` dispatches the transformer-block engine
+    through the same pool (per-device block replicas, ring executors
+    off). ``--pool --evict-device=N`` runs the elastic-recovery FIRE
+    DRILL instead (``ft_sgemm_tpu.resilience.run_eviction_drill``,
+    DESIGN.md §18): persistent faults on device N under live load →
+    EVICTION (placement permanently stops naming it, queued batches
+    migrate, survivors re-confirmed in the re-AOT window) → recovery
+    load + one rehearsal of every checksum tier and ladder rung; the
+    artifact's ``context.recovery`` section (MTTR, tier-of-detection
+    counts, panel-recompute flops ratio, goodput recovery ratio) is
+    what the run ledger ingests as ``recovery.*`` measurements; rc!=0
+    unless evicted with zero incorrect responses and recovered goodput.
     Flags: ``--smoke`` (the CPU/CI scenario),
     ``--requests=N``, ``--inject-rate=R``, ``--adversarial-rate=R``,
     ``--rate=RPS``, ``--buckets=256,512`` (block: padded SEQ sizes),
@@ -2504,7 +2516,12 @@ def serve_main(argv):
     sizes = None
     for f in argv:
         try:
-            if f.startswith("--sick-device="):
+            if f.startswith("--evict-device="):
+                # Elastic-recovery fire-drill knob (resilience/
+                # elastic.py): which pool device receives the
+                # persistent fault stream and must be EVICTED.
+                kw["evict_device"] = int(f.split("=", 1)[1])
+            elif f.startswith("--sick-device="):
                 # Pool drain self-test knob (serve/pool.py mark_sick):
                 # which pool device is marked sick before the load;
                 # "none" disables the marking.
@@ -2552,11 +2569,11 @@ def serve_main(argv):
                     " --workload=block"
     elif "epilogue" in kw:
         bad = "--epilogue= needs --workload=gemm"
-    if pool and block:
-        bad = "--pool needs --workload=gemm (the block engine is not"\
-            " pool-dispatched yet)"
-    if not pool and "sick_device" in kw:
-        bad = "--sick-device= needs --pool"
+    drill = "evict_device" in kw
+    if "sick_device" in kw and (not pool or block or drill):
+        bad = "--sick-device= needs --pool with the gemm workload"
+    if drill and (not pool or block):
+        bad = "--evict-device= needs --pool with the gemm workload"
     if bad:
         print(json.dumps({"metric": metric, "value": None,
                           "unit": unit, "vs_baseline": None,
@@ -2577,7 +2594,7 @@ def serve_main(argv):
     signal.signal(signal.SIGINT, on_signal)
 
     context = {"serve": True, "smoke": smoke, "workload": workload,
-               "pool": pool, "errors": {}}
+               "pool": pool, "drill": drill, "errors": {}}
     tl = (_make_timeline(None)
           if os.environ.get("FT_SGEMM_BENCH_TIMELINE") else _NoTimeline())
     try:
@@ -2610,9 +2627,21 @@ def serve_main(argv):
             from ft_sgemm_tpu.serve import run_block_serve_bench
 
             stats = run_block_serve_bench(smoke=smoke, timeline=tl,
+                                          pool=pool,
                                           should_stop=stop.is_set,
                                           progress_out=sys.stderr, **kw)
             value = stats.get("goodput_tps")
+        elif drill:
+            from ft_sgemm_tpu.resilience import run_eviction_drill
+
+            drill_kw = {k: v for k, v in kw.items()
+                        if k in ("evict_device", "bucket_sizes")}
+            if "num_requests" in kw:
+                drill_kw["requests_per_phase"] = kw["num_requests"]
+            stats = run_eviction_drill(smoke=smoke, timeline=tl,
+                                       progress_out=sys.stderr,
+                                       **drill_kw)
+            value = stats.get("goodput_rps")
         elif pool:
             from ft_sgemm_tpu.serve import run_pool_serve_bench
 
@@ -2663,9 +2692,15 @@ def serve_main(argv):
     _ledger_append(artifact)
     ok = (value is not None and value > 0
           and context.get("completed", 0) > 0
-          and context.get("correct") == context.get("completed")
+          and (drill or context.get("correct")
+               == context.get("completed"))
           and context.get("whole_queue_retries", 0) == 0)
-    if ok and pool:
+    if ok and drill:
+        # The drill's own acceptance verdict: evicted (not just
+        # drained), zero incorrect/lost responses, nothing placed on
+        # the evicted device afterward, goodput recovered.
+        ok = bool(context.get("ok"))
+    elif ok and pool:
         # The pool stage's own acceptance facts: placement actually
         # spread over the mesh, and a marked-sick device was drained.
         pool_stats = context.get("pool")

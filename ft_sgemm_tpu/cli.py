@@ -66,6 +66,9 @@ Usage:
         [--epilogue=SPEC] [--requests=N] [--inject-rate=R] [--rate=RPS] \
         [--decode-ratio=R] [--kv-corrupt-rate=R] \
         [--sick-device=N|none] [--monitor-port=N] [--out=ARTIFACT.json]
+    python -m ft_sgemm_tpu.cli drill [--smoke] [--evict-device=N] \
+        [--requests=N] [--buckets=128,256] [--telemetry=LOG.jsonl] \
+        [--out=ARTIFACT.json]
     python -m ft_sgemm_tpu.cli history [LEDGER.jsonl] \
         [--limit=N] [--format=text|json]
     python -m ft_sgemm_tpu.cli trend [LEDGER.jsonl] [--gate] \
@@ -217,7 +220,7 @@ the prefill/decode mix and ``--kv-corrupt-rate=R`` the stored-page
 corruption rate (the block workload's ``--buckets=`` values are padded
 SEQUENCE sizes).
 
-``--pool`` (GEMM workload; DESIGN.md §17) runs the MULTI-DEVICE pool
+``--pool`` (DESIGN.md §17) runs the MULTI-DEVICE pool
 stage: the same load drives the single-device engine and then a
 health-steered device pool over every local device — per-device AOT
 executable replicas, placement by ``DeviceHealthTracker`` score over
@@ -225,7 +228,24 @@ queue depth (sick devices drain, not schedule), a bounded async
 in-flight window per device worker — reporting goodput scaling vs the
 single-device control, per-device placement, and the
 ``--sick-device=N`` drain self-test outcome (``none`` disables the
-marking). The ring collective paths' hop schedule is the related
+marking; GEMM workload only). ``--pool --workload=block`` dispatches
+the TRANSFORMER-BLOCK engine through the same pool (per-device block
+executor replicas; ring executors are mutually exclusive with pool
+replicas and switch off).
+
+``drill`` is the elastic-recovery fire drill (``ft_sgemm_tpu
+.resilience``, DESIGN.md §18): baseline load through a health-steered
+pool, a persistent fault stream on one device (``--evict-device=N``,
+default 1) under live traffic, EVICTION — placement permanently stops
+naming the device, its queued batches migrate, survivors' executables
+are re-confirmed (the re-AOT window) — then a recovery load plus one
+rehearsal of every data-plane checksum tier and recompute-ladder rung.
+Prints MTTR, goodput recovery ratio, tier-of-detection counts, and the
+panel-recompute flops ratio, and emits the artifact line whose
+``recovery.*`` facts the run ledger ingests (``cli trend`` then gates
+recovery health longitudinally). Exit 0 iff evicted (not just
+drained), zero incorrect/lost responses, nothing placed on the evicted
+device afterward, and goodput recovered past 0.7x baseline. The ring collective paths' hop schedule is the related
 ``ring_overlap`` axis (``--ring-overlap=serial|overlap`` on the ring
 entry points; ``tune-ring`` searches it — wall-timed on TPU, priced by
 the compute/ICI cost model elsewhere — and banks the winner the
@@ -1463,15 +1483,13 @@ def _parse_serve_flags(flags):
                                     " --workload=block")
     elif "epilogue" in kw:
         return None, None, "--epilogue= needs --workload=gemm"
-    if pool and workload == "block":
-        return None, None, ("--pool needs --workload=gemm (the block"
-                            " engine is not pool-dispatched yet)")
-    if not pool and "sick_device" in kw:
-        return None, None, "--sick-device= needs --pool"
+    if "sick_device" in kw and (not pool or workload == "block"):
+        return None, None, ("--sick-device= needs --pool with the gemm"
+                            " workload (the drain A/B control)")
     if sizes is not None:
         kw["seq_sizes" if workload == "block" else "bucket_sizes"] = sizes
     if pool:
-        workload = "pool"
+        workload = "block_pool" if workload == "block" else "pool"
     return workload, kw, None
 
 
@@ -1497,8 +1515,8 @@ def run_serve(flags, out=None) -> int:
         print(f"ft_sgemm: serve: {err}", file=sys.stderr)
         return 2
     in_dtype = kw.pop("in_dtype", "float32")
-    block = workload == "block"
-    pool = workload == "pool"
+    block = workload in ("block", "block_pool")
+    pool = workload in ("pool", "block_pool")
     try:
         if block:
             sizes = kw.pop("seq_sizes", None) or (128, 256)
@@ -1561,6 +1579,7 @@ def run_serve(flags, out=None) -> int:
         if block:
             stats = run_block_serve_bench(smoke=True, in_dtype=in_dtype,
                                           seq_sizes=sizes, verify=True,
+                                          pool=pool,
                                           progress_out=sys.stderr, **kw)
         elif pool:
             stats = run_pool_serve_bench(smoke=True, in_dtype=in_dtype,
@@ -1645,8 +1664,9 @@ def run_serve_bench_cmd(flags, out=None) -> int:
     print_device_info(out=sys.stderr)
     from ft_sgemm_tpu.serve import run_block_serve_bench, run_serve_bench
 
-    if workload == "block":
+    if workload in ("block", "block_pool"):
         stats = run_block_serve_bench(smoke="--smoke" in flags,
+                                      pool=workload == "block_pool",
                                       progress_out=sys.stderr, **kw)
         artifact = {
             "metric": "serve_block_goodput_tps",
@@ -1686,6 +1706,92 @@ def run_serve_bench_cmd(flags, out=None) -> int:
           and stats.get("correct") == stats.get("completed")
           and (artifact["value"] or 0) > 0)
     return 0 if ok else 1
+
+
+def run_drill(flags, out=None) -> int:
+    """``drill`` subcommand: the eviction fire drill (DESIGN.md §18).
+
+    Runs :func:`ft_sgemm_tpu.resilience.run_eviction_drill` — baseline
+    load through a health-steered pool over every local device, a
+    persistent fault stream on one device under live traffic, eviction
+    + queued-batch migration + re-AOT, a post-eviction recovery load,
+    and one rehearsal of every checksum tier and recompute-ladder rung
+    — then prints the recovery facts and emits the artifact line
+    (``--out=`` writes it to a file for ledger ingestion). Exit 0 iff
+    the device was EVICTED (not just drained), zero responses were lost
+    or incorrect, the evicted device received nothing after eviction,
+    and goodput recovered past 0.7x the pre-fault baseline.
+    """
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    kw = {}
+    out_path = None
+    telemetry_log = None
+    try:
+        for f in flags:
+            if f.startswith("--evict-device="):
+                kw["evict_device"] = int(f.split("=", 1)[1])
+            elif f.startswith("--requests="):
+                kw["requests_per_phase"] = int(f.split("=", 1)[1])
+            elif f.startswith("--buckets="):
+                kw["bucket_sizes"] = tuple(
+                    int(v) for v in f.split("=", 1)[1].split(",") if v)
+            elif f.startswith("--out="):
+                out_path = f.split("=", 1)[1]
+            elif f.startswith("--telemetry="):
+                telemetry_log = f.split("=", 1)[1]
+    except ValueError as e:
+        print(f"ft_sgemm: drill: {e}", file=sys.stderr)
+        return 2
+    if telemetry_log:
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.configure(telemetry_log, log_clean=True)
+    print_device_info(out=sys.stderr)
+    from ft_sgemm_tpu.resilience import run_eviction_drill
+
+    try:
+        stats = run_eviction_drill(smoke="--smoke" in flags,
+                                   progress_out=sys.stderr, **kw)
+    finally:
+        if telemetry_log:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.disable()
+    rec = stats["recovery"]
+    print(f"drill: evicted {rec['evicted_device']} "
+          f"(reason={rec['reason']})  migrated "
+          f"{rec['migrated_batches']} queued requests  mttr "
+          f"{rec['mttr_seconds']}s", file=out)
+    print(f"  goodput {rec['goodput_pre_rps']} -> "
+          f"{rec['goodput_post_rps']} req/s "
+          f"(recovery x{rec['goodput_recovery_ratio']})  incorrect "
+          f"responses {rec['incorrect_responses']}  batches on evicted "
+          f"after eviction {rec['post_eviction_batches_on_evicted']}",
+          file=out)
+    if rec.get("tier_detections") is not None:
+        tiers = "  ".join(f"{t}={n}"
+                          for t, n in rec["tier_detections"].items())
+        print(f"  checksum tiers: {tiers}  (checks "
+              f"{rec['tier_checks']})", file=out)
+    if rec.get("ladder") is not None:
+        rungs = "  ".join(f"{r}={n}" for r, n in rec["ladder"].items())
+        print(f"  recompute ladder: {rungs}  panel flops ratio "
+              f"{rec['panel_recompute_flops_ratio']}", file=out)
+    artifact = {
+        "metric": "serve_goodput_rps",
+        "value": stats.get("goodput_rps"),
+        "unit": "requests/s",
+        "vs_baseline": None,
+        "context": dict(stats, serve=True, drill=True),
+    }
+    line = _json.dumps(artifact)
+    print(line, file=out, flush=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return 0 if stats.get("ok") else 1
 
 
 def run_telemetry_watch(log_path: str, out=None, interval: float = 0.5,
@@ -1923,6 +2029,8 @@ def main(argv=None) -> int:
         return run_serve(flags)
     if args and args[0] == "serve-bench":
         return run_serve_bench_cmd(flags)
+    if args and args[0] == "drill":
+        return run_drill(flags)
     if args and args[0] == "history":
         return run_history(args[1:], flags)
     if args and args[0] == "trend":

@@ -117,6 +117,33 @@ VARIANT_AXES = {
 # the epilogue spelling, ``ring=`` the ring hop schedule.
 TUNER_VARIANT_KEY_MARKERS = ("pipe=", "grid=", "cad=", "epi=", "ring=")
 
+# --- elastic recovery declarations -------------------------------------
+#
+# The DATA-PLANE checksum tiers (resilience/tiers.py::TIERS is the
+# runtime spelling; telemetry's ``events.AXIS_LABELS["recovery_tier"]``
+# mirrors this tuple — the BLOCK_PHASES import-free mirror discipline,
+# cross-checked by the lint axis-drift pass). Every tier-of-detection
+# label a tiered checksum check emits is one of these spellings, ordered
+# cheapest-communication first: "device" = the per-device residual
+# vector (no collective), "host" = after the first staged (ICI) axis,
+# "global" = after every mesh axis (the arXiv 2112.09017 panel
+# structure applied to checksum rows, not just counters).
+RECOVERY_TIERS = ("device", "host", "global")
+
+# The recovery-ladder rungs (resilience/recompute.py::LADDER_RUNGS is
+# the runtime spelling; ``events.AXIS_LABELS["ladder_rung"]`` mirrors
+# it), ordered cheapest-flops first. A recovery NEVER skips a cheaper
+# rung whose localization precondition holds; each rung re-verifies
+# through the resident checksums before the ladder stops:
+#   element_correct   single located element repaired from its residual
+#   panel_recompute   only the implicated output panel(s) recomputed
+#                     from the resident A/B shards
+#   shard_restore     the blamed device's whole output shard recomputed
+#   full_retry        nothing local sufficed — the caller re-runs the
+#                     whole distributed GEMM
+LADDER_RUNGS = ("element_correct", "panel_recompute", "shard_restore",
+                "full_retry")
+
 # --- multi-device serve pool -------------------------------------------
 #
 # Placement policies of the serving layer's device pool

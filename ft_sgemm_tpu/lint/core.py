@@ -84,6 +84,7 @@ THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
                     "ft_sgemm_tpu/serve/blocks.py",
                     "ft_sgemm_tpu/serve/kv_cache.py",
                     "ft_sgemm_tpu/serve/pool.py",
+                    "ft_sgemm_tpu/resilience/elastic.py",
                     "ft_sgemm_tpu/telemetry/monitor.py")
 
 
@@ -278,6 +279,8 @@ class Declarations:
         self.variant_key_markers = tuple(
             contracts.get("TUNER_VARIANT_KEY_MARKERS", ()))
         self.pool_placements = tuple(contracts.get("POOL_PLACEMENTS", ()))
+        self.recovery_tiers = tuple(contracts.get("RECOVERY_TIERS", ()))
+        self.ladder_rungs = tuple(contracts.get("LADDER_RUNGS", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -498,6 +501,8 @@ AXIS_VAR_SETS = {
     "dim_semantics": "dim_semantics",
     "ring_overlap": "ring_overlap_modes",
     "pool_placement": "pool_placements",
+    "recovery_tier": "recovery_tiers",
+    "ladder_rung": "ladder_rungs",
 }
 
 
@@ -735,6 +740,13 @@ def check_axis_drift(repo: Repo, decls: Declarations):
     # (no configs counterpart — serving-plane axis, like block_phase).
     if decls.pool_placements:
         mirror["pool_placement"] = decls.pool_placements
+    # The elastic-recovery axes (PR 15) mirror contracts directly too:
+    # RECOVERY_TIERS / LADDER_RUNGS are recovery-plane declarations with
+    # no configs counterpart.
+    if decls.recovery_tiers:
+        mirror["recovery_tier"] = decls.recovery_tiers
+    if decls.ladder_rungs:
+        mirror["ladder_rung"] = decls.ladder_rungs
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -801,7 +813,9 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                      "ring_overlap": set(
                          decls.configs_variant_axes.get(
                              "ring_overlap", ())) | {"auto"},
-                     "pool_placement": set(decls.pool_placements)}
+                     "pool_placement": set(decls.pool_placements),
+                     "recovery_tier": set(decls.recovery_tiers),
+                     "ladder_rung": set(decls.ladder_rungs)}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

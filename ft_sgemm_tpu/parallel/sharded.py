@@ -161,6 +161,80 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes,
     return step
 
 
+def make_tiered_ft_step(local_ft, alpha, beta, inject, det_axes,
+                        *, mesh_axes=("x", "y"), tier_axes=("y", "x"),
+                        inject_coords=None, tier_corrupt=()):
+    """:func:`make_ft_step` + per-device DATA-PLANE checksum residual
+    vectors staged one mesh axis at a time — the tier emission half of
+    ``resilience/tiers.py`` (the arXiv 2112.09017 panel structure
+    applied to checksum ROWS, not just the int32 counter plane).
+
+    Each device computes the plain column-sum checksum of its local
+    K-partial two ways — observed (``sum_rows(partial)``) and expected
+    (``sum_rows(A_loc) @ B_loc.T``, the classic ABFT encode identity) —
+    and emits their signed difference ``r`` (an f32 vector of length n).
+    ``r`` is then reduced ONE AXIS AT A TIME in ``tier_axes`` order
+    (innermost/ICI first, the ``hierarchical_psum`` staging discipline),
+    and every stage's partial is returned as a fully sharded per-device
+    grid, so the host sees the residual at each tier: per-device
+    (tier "device", no collective), after the first staged axis
+    (tier "host"), after every axis (tier "global"). Unlike the counter
+    plane the staged values are FLOATS: staged == flat only up to f32
+    reassociation, which is why tier detection is tolerance-gated
+    (``resilience/tiers.py::checksum_tolerance``) while counter staging
+    is exact.
+
+    The residual is taken on the PRE-REDUCTION partial on purpose: the
+    in-kernel check already verified the kernel's own output, so a
+    nonzero ``r`` means corruption that struck AFTER the check — in the
+    partial buffer, in the reduction's in-flight values, or in a
+    resident shard — exactly the between-kernels window the in-kernel
+    ABFT cannot see. ``tier_corrupt`` is the self-test knob for that
+    window: trace-time ``((mesh coords), (i, j), delta)`` entries added
+    to the named device's local partial AFTER the kernel check and
+    BEFORE the reduction (the data-plane analog of ``inject_coords``).
+
+    The step returns ``(out, det, unc, dev_det, dev_unc, r_dev, *r_stages)``
+    with every ``r_*`` reshaped to one vector per device
+    (``P(*mesh_axes, None)`` grids — ``telemetry._device_entries``'s
+    shard-placement trick, applied to f32 vectors).
+    """
+    run_local = shard_local_ft(local_ft, inject, inject_coords, mesh_axes)
+    dev_shape = (1,) * len(mesh_axes)
+
+    def step(a_loc, b_loc, c_loc):
+        zeros = jnp.zeros((a_loc.shape[0], b_loc.shape[0]), jnp.float32)
+        res = run_local(a_loc, b_loc, zeros)
+        part = res.c
+        for coords, (ci, cj), delta in tier_corrupt:
+            on = jnp.bool_(True)
+            for ax, cc in zip(mesh_axes, coords):
+                on = jnp.logical_and(on, jax.lax.axis_index(ax) == cc)
+            part = part.at[ci, cj].add(
+                jnp.where(on, jnp.float32(delta), jnp.float32(0.0)))
+        # The data-plane checksum pair: observed vs encoded column sums
+        # of the local partial, both f32.
+        obs = jnp.sum(part, axis=0)
+        exp = jnp.sum(a_loc.astype(jnp.float32), axis=0) @ \
+            b_loc.astype(jnp.float32).T
+        r = (obs - exp).astype(jnp.float32)
+        vec_shape = dev_shape + (r.shape[0],)
+        r_stages = [r.reshape(vec_shape)]
+        staged = r
+        for ax in tier_axes:
+            staged = jax.lax.psum(staged, ax)
+            r_stages.append(staged.reshape(vec_shape))
+        partial = jax.lax.psum(part, "y")
+        out = alpha * partial + beta * c_loc
+        dev_det = jnp.sum(res.detections).reshape(dev_shape)
+        dev_unc = jnp.sum(res.uncorrectable).reshape(dev_shape)
+        det = hierarchical_psum(res.detections, det_axes)
+        unc = hierarchical_psum(res.uncorrectable, det_axes)
+        return (out, det, unc, dev_det, dev_unc, *r_stages)
+
+    return step
+
+
 def sharded_ft_sgemm(
     a,
     b,

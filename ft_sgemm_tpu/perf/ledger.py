@@ -340,6 +340,28 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
                 s = _measurement(scaling.get(key), higher_is_better=True)
                 if s:
                     entry["measurements"][f"serve_pool.{key}"] = s
+    # Elastic recovery (PR 15): the eviction fire drill's facts land as
+    # recovery.* measurements so `cli trend --gate` judges recovery
+    # health longitudinally — MTTR and the panel-recompute flops ratio
+    # must not creep up, the goodput recovery ratio must not creep
+    # down. Same lint.*/serve_block.* pattern: OUTSIDE
+    # extract_measurements (the compare.extract_stages mirror pin
+    # stands; a drill is not an A/B-comparable GEMM stage). Tier-of-
+    # detection counts ride the entry body (not the trend plane — they
+    # are categorical facts, not a monotone health series).
+    rec = ctx.get("recovery")
+    if isinstance(rec, dict):
+        for key, hib in (("mttr_seconds", False),
+                         ("evictions", False),
+                         ("panel_recompute_flops_ratio", False),
+                         ("goodput_recovery_ratio", True)):
+            s = _measurement(rec.get(key), higher_is_better=hib)
+            if s:
+                entry["measurements"][f"recovery.{key}"] = s
+        keep = ("evicted_device", "reason", "migrated_batches",
+                "tier_checks", "tier_detections", "ladder",
+                "incorrect_responses")
+        entry["recovery"] = {k: rec.get(k) for k in keep if k in rec}
 
     if entry["kind"] == "multichip" and not entry["measurements"] \
             and entry["value"] is None:

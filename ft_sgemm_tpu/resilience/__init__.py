@@ -1,0 +1,52 @@
+"""Elastic recovery: make an uncorrectable fault a bounded, local event.
+
+Three coupled pieces (ROADMAP item 2's survival arc, DESIGN.md §18):
+
+- :mod:`.tiers` — hierarchical DATA-PLANE checksums: every tiered
+  sharded FT-GEMM carries per-device checksum residual vectors staged
+  ICI-first into host and global tiers, so corruption that escapes the
+  in-kernel check — or strikes between kernels — is detected at the
+  cheapest tier that can see it, with tier-of-detection recorded.
+- :mod:`.recompute` — the recovery ladder: element-correct →
+  panel-recompute → shard-restore → full-retry, each rung re-verified,
+  replacing the historical jump straight to a full retry. Recomputed
+  flops vs full-retry flops is a pinned ledger measurement.
+- :mod:`.elastic` — live device eviction + reshard: a health score
+  crossing the eviction floor (or repeated panel recomputes on one
+  device) removes the device from placement under live traffic, its
+  queued batches migrate, and the mesh paths rebuild on the survivors.
+"""
+
+from ft_sgemm_tpu.resilience.elastic import (
+    ElasticController,
+    EvictionPolicy,
+    run_eviction_drill,
+    surviving_mesh,
+)
+from ft_sgemm_tpu.resilience.recompute import (
+    LADDER_RUNGS,
+    RecoveryOutcome,
+    recover_local,
+)
+from ft_sgemm_tpu.resilience.tiers import (
+    TIERS,
+    TierReport,
+    checksum_tolerance,
+    tiered_ft_sgemm,
+    verify_resident,
+)
+
+__all__ = [
+    "ElasticController",
+    "EvictionPolicy",
+    "LADDER_RUNGS",
+    "RecoveryOutcome",
+    "TIERS",
+    "TierReport",
+    "checksum_tolerance",
+    "recover_local",
+    "run_eviction_drill",
+    "surviving_mesh",
+    "tiered_ft_sgemm",
+    "verify_resident",
+]

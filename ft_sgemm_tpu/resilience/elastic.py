@@ -1,0 +1,562 @@
+"""Live device eviction + reshard: the mesh sheds a sick member.
+
+The PR-14 pool DRAINS a sick device — it stops receiving new batches
+while its score is low, but it remains a placement candidate (the
+relative floor can re-admit it) and its queued work just waits. Eviction
+is the terminal rung one level up: a device whose
+:class:`~ft_sgemm_tpu.telemetry.monitor.DeviceHealthTracker` score
+crosses the EVICTION floor — or that keeps forcing panel recomputes —
+is removed from placement permanently, its queued batches MIGRATE to
+the survivors (re-placed through the normal health steer, so the trace
+flow shows where every request went), and the serving executables for
+the surviving set are (re)confirmed through the prewarm machinery — the
+"re-AOT window", the only place a compile span is legitimate after
+steady state began.
+
+Pieces:
+
+- :class:`EvictionPolicy` / :class:`ElasticController` — the decision:
+  score below ``floor x fleet median`` with enough evidence, or
+  ``panel_recompute_limit`` ladder escalations blamed on one device.
+  The controller never leaves fewer than ``min_survivors`` devices.
+- :func:`surviving_mesh` — the reshard target for MESH-RESIDENT paths
+  (training): a fresh 2-D mesh over the largest power-of-two subset of
+  the surviving devices, ready for re-AOT through the existing factory
+  machinery (``train.resilient_step``'s ``on_persistent_fault`` hook
+  returns a step rebuilt on it).
+- :func:`run_eviction_drill` — the fire drill ``cli drill`` and the CI
+  step run: persistent faults on one device under live load → eviction
+  → queued work migrates → goodput recovers on the survivors, with
+  MTTR, tier-of-detection counts, and the recompute-ladder flops ratio
+  measured and returned for ledger ingestion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional, Tuple
+
+__all__ = ["ElasticController", "EvictionPolicy", "run_eviction_drill",
+           "surviving_mesh"]
+
+
+@dataclasses.dataclass
+class EvictionPolicy:
+    """When a device stops being worth keeping.
+
+    ``floor`` is the eviction threshold on the health score, RELATIVE to
+    the fleet median like the pool's drain floor (uniform degradation
+    must never evict the fleet) but strictly below it — a device is
+    drained first, evicted only when evidence keeps mounting. ``min_calls``
+    is the evidence floor (a single bad request is not a pattern);
+    ``panel_recompute_limit`` evicts a device that keeps forcing
+    recompute-ladder escalations even if its score survives;
+    ``min_survivors`` is the hard floor on fleet size after eviction.
+    """
+
+    floor: float = 0.25
+    min_calls: int = 8
+    panel_recompute_limit: int = 3
+    min_survivors: int = 1
+
+
+class ElasticController:
+    """Decides — and remembers — evictions for one pool.
+
+    The engine consults :meth:`should_evict` on every placement (the
+    dispatcher thread) and performs the actual eviction through
+    ``ServeEngine.evict_device`` (which calls :meth:`record_eviction`
+    with the facts). :meth:`note_panel_recompute` is the ladder's blame
+    feed. Thread-safe; a decision is handed out at most once per device.
+    """
+
+    def __init__(self, policy: Optional[EvictionPolicy] = None, *,
+                 registry=None, timeline=None):
+        self.policy = policy or EvictionPolicy()
+        self.registry = registry
+        self.timeline = timeline
+        self._lock = threading.Lock()
+        self._recomputes: dict = {}
+        self._deciding: set = set()
+        self.evictions: list = []
+        self.fault_marked_at: Optional[float] = None
+
+    # -- evidence feeds ----------------------------------------------------
+
+    def mark_fault(self, ts: Optional[float] = None) -> float:
+        """Timestamp the onset of the fault this controller is watching
+        (the drill's MTTR zero point)."""
+        with self._lock:
+            self.fault_marked_at = time.monotonic() if ts is None else ts
+            return self.fault_marked_at
+
+    def note_panel_recompute(self, device: str) -> int:
+        """One recompute-ladder escalation blamed on ``device``."""
+        with self._lock:
+            n = self._recomputes.get(str(device), 0) + 1
+            self._recomputes[str(device)] = n
+            return n
+
+    def recompute_count(self, device: str) -> int:
+        with self._lock:
+            return self._recomputes.get(str(device), 0)
+
+    # -- the decision ------------------------------------------------------
+
+    def should_evict(self, pool) -> Optional[Tuple[int, str]]:
+        """``(device index, reason)`` when one device crosses the policy,
+        else None. Never proposes a device already evicted (or already
+        handed out), and never shrinks the fleet below
+        ``min_survivors``."""
+        pol = self.policy
+        n = len(pool.devices)
+        with self._lock:
+            blocked = set(pool.evicted) | self._deciding
+            if n - len(set(pool.evicted)) - 1 < pol.min_survivors:
+                return None
+            candidates = [i for i in range(n) if i not in blocked]
+            if not candidates:
+                return None
+            decision = None
+            if pool.health is not None:
+                scores = [pool.score(i) for i in range(n)]
+                med = sorted(scores)[len(scores) // 2]
+                floor = pol.floor * max(med, 1e-9)
+                rows = pool.health.rows()
+                for i in candidates:
+                    calls = rows.get(pool.labels[i], {}).get("calls", 0)
+                    if calls >= pol.min_calls and scores[i] < floor:
+                        decision = (i, "health_floor")
+                        break
+            if decision is None:
+                for i in candidates:
+                    if self._recomputes.get(pool.labels[i], 0) \
+                            >= pol.panel_recompute_limit:
+                        decision = (i, "panel_recompute")
+                        break
+            if decision is not None:
+                self._deciding.add(decision[0])
+            return decision
+
+    def record_eviction(self, facts: dict) -> None:
+        with self._lock:
+            self.evictions.append(dict(facts))
+            self._deciding.discard(facts.get("index"))
+
+    def mttr_seconds(self, recovered_at: float) -> Optional[float]:
+        """MTTR from the marked fault onset to ``recovered_at``."""
+        with self._lock:
+            if self.fault_marked_at is None:
+                return None
+            return max(0.0, recovered_at - self.fault_marked_at)
+
+
+def surviving_mesh(exclude, devices=None, *, axis_names=("x", "y")):
+    """A fresh 2-D mesh over the survivors — the reshard target.
+
+    ``exclude`` is a device, its label string, its index into
+    ``devices``, or an iterable of those. The mesh spans the largest
+    POWER-OF-TWO count of surviving devices (power-of-two keeps the
+    existing divisibility contracts of the sharded entry points intact
+    through a reshard: a 256-row M that divided 8 devices still divides
+    4), most-square split — the ``make_mesh`` rule. The caller re-AOTs
+    its step over the returned mesh through the ordinary factories;
+    that recompile IS the re-AOT window.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if not isinstance(exclude, (list, tuple, set, frozenset)):
+        exclude = (exclude,)
+    excluded = set()
+    for e in exclude:
+        if isinstance(e, int):
+            excluded.add(e)
+        else:
+            label = str(e)
+            excluded.update(i for i, d in enumerate(devices)
+                            if str(d) == label)
+    survivors = [d for i, d in enumerate(devices) if i not in excluded]
+    if not survivors:
+        raise ValueError("surviving_mesh: no devices left after"
+                         f" excluding {sorted(excluded)}")
+    n = 1
+    while n * 2 <= len(survivors):
+        n *= 2
+    x = int(np.floor(np.sqrt(n)))
+    while n % x:
+        x -= 1
+    return Mesh(np.asarray(survivors[:n]).reshape(x, n // x), axis_names)
+
+
+# ---------------------------------------------------------------------------
+# The eviction fire drill
+# ---------------------------------------------------------------------------
+
+
+def _drive_phase(engine, spec, rng, n_requests, *, timeout=300.0,
+                 fault_feed=None, after_ts=None):
+    """Submit ``n_requests`` generated requests, poll every future to
+    completion (recording approximate resolution timestamps — the MTTR
+    probe), verify each result against the XLA oracle, and return the
+    phase stats. ``fault_feed(i)`` runs after each submission (the
+    persistent-fault evidence stream); ``after_ts`` filters the
+    first-correct timestamp to completions at or after it."""
+    import numpy as np
+
+    from ft_sgemm_tpu.ops.reference import sgemm_reference
+    from ft_sgemm_tpu.serve.loadgen import _gen_request
+    from ft_sgemm_tpu.utils.matrices import verify_matrix
+
+    t0 = time.monotonic()
+    futs = []
+    for i in range(n_requests):
+        req = _gen_request(rng, spec, engine.buckets)
+        futs.append((req, engine.submit(req)))
+        if fault_feed is not None:
+            fault_feed(i)
+    pending = dict(enumerate(futs))
+    resolved_at = {}
+    deadline = time.monotonic() + timeout
+    while pending:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"drill phase stuck with {len(pending)} futures pending")
+        for idx in list(pending):
+            if pending[idx][1].done():
+                resolved_at[idx] = time.monotonic()
+                del pending[idx]
+        if pending:
+            time.sleep(0.002)
+    wall = time.monotonic() - t0
+    completed = correct = incorrect = retries = 0
+    first_ok_ts = None
+    for idx, (req, fut) in enumerate(futs):
+        res = fut.result(timeout=1.0)
+        completed += 1
+        retries += res.retries
+        m, n, _ = req.mnk
+        want = np.asarray(sgemm_reference(
+            req.a, req.b, np.zeros((m, n), np.float32),
+            engine.alpha, engine.beta, in_dtype=req.in_dtype))
+        ok_v, _, _ = verify_matrix(want, res.c, verbose=False)
+        if res.ok and ok_v:
+            correct += 1
+            ts = resolved_at[idx]
+            if (after_ts is None or ts >= after_ts) and \
+                    (first_ok_ts is None or ts < first_ok_ts):
+                first_ok_ts = ts
+        else:
+            incorrect += 1
+    return {
+        "submitted": len(futs), "completed": completed,
+        "correct": correct, "incorrect": incorrect, "retries": retries,
+        "wall_seconds": round(wall, 3),
+        "goodput_rps": round(correct / wall, 3) if wall > 0 else None,
+        "first_correct_ts": first_ok_ts,
+    }
+
+
+def _tier_rehearsal(mesh, registry, *, margin=64.0, interpret=None):
+    """Exercise every data-plane checksum tier on the live mesh: one
+    corruption shaped for each tier (large-local -> device; sibling
+    accumulation -> host; mesh-wide drift -> global) plus a clean
+    control, all through :func:`~ft_sgemm_tpu.resilience.tiers.
+    tiered_ft_sgemm`. Returns the per-tier detection counts the drill
+    reports (and the registry carries)."""
+    import numpy as np
+
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.resilience.tiers import checksum_tolerance
+    from ft_sgemm_tpu.resilience.tiers import tiered_ft_sgemm as tiered
+    from ft_sgemm_tpu.utils.matrices import generate_random_matrix
+
+    mx, my = mesh.shape["x"], mesh.shape["y"]
+    m, n, k = 128 * mx, 128, 128 * my
+    rng = np.random.default_rng(10)
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    tile = KernelShape("drill128", 128, 128, 128, (0,) * 7)
+    amax = float(np.max(np.abs(a)))
+    bmax = float(np.max(np.abs(b)))
+    tol0 = checksum_tolerance(m // mx, k // my, amax, bmax, margin=margin)
+
+    cases = {"clean": ()}
+    # Device tier: one unmistakably-local corruption.
+    cases["device"] = (((0, 0), (1, 3), 50.0 * tol0),)
+    if my >= 2:
+        # Host tier: every y-sibling of row x=0 carries a sub-device-
+        # threshold delta in ONE column; the first staged (ICI) reduce
+        # accumulates them past sqrt(Y) x tol0.
+        cases["host"] = tuple(
+            ((0, y), (1, 3), 0.9 * tol0) for y in range(my))
+    # Global tier: mesh-wide drift — every device sub-threshold, every
+    # ICI row sub-host-threshold, the full reduction over the top.
+    cases["global"] = tuple(
+        ((x, y), (1, 3), 0.9 * tol0 / np.sqrt(my))
+        for x in range(mx) for y in range(my))
+
+    counts = {"device": 0, "host": 0, "global": 0}
+    checks = 0
+    for want, corrupt in cases.items():
+        _, report = tiered(a, b, c, mesh, tile, alpha=1.0, beta=0.0,
+                           tier_corrupt=corrupt, margin=margin,
+                           interpret=interpret, registry=registry)
+        checks += 1
+        if report.detected:
+            counts[report.tier] += 1
+    return {"checks": checks, "detections": counts}
+
+
+def _ladder_rehearsal(registry, *, num_panels=8):
+    """Exercise the recompute ladder host-side: a located single element
+    and a multi-element panel corruption, flops-accounted. Returns rung
+    counts + the panel-recompute flops ratio (the pinned ledger
+    measurement)."""
+    import numpy as np
+
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.resilience.recompute import recover_local
+
+    rng = np.random.default_rng(11)
+    m, n, k = 64, 256, 64
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    clean = a @ b.T
+    rungs: dict = {}
+    ratio = None
+    scenarios = (
+        ("element", [(3, 7, 1000.0)]),
+        ("panel", [(3, 7, 1000.0), (9, 9, -750.0)]),
+    )
+    for name, hits in scenarios:
+        bad = np.array(clean, copy=True)
+        for i, j, d in hits:
+            bad[i, j] += np.float32(d)
+        fixed, outcome = recover_local(a, b, bad,
+                                       num_panels=num_panels)
+        rungs[outcome.rung] = rungs.get(outcome.rung, 0) + 1
+        registry.counter("recovery_ladder",
+                         ladder_rung=outcome.rung).inc()
+        telemetry.record_step_event(
+            "corrected" if outcome.corrected else "uncorrectable",
+            op="recompute",
+            extra={"ladder_rung": outcome.rung,
+                   "attempted": list(outcome.attempted),
+                   "recomputed_flops": outcome.recomputed_flops,
+                   "full_retry_flops": outcome.full_retry_flops,
+                   "flops_ratio": outcome.flops_ratio})
+        if name == "panel" and outcome.rung == "panel_recompute":
+            ratio = outcome.flops_ratio
+        assert np.allclose(fixed, clean, atol=1e-3), \
+            "ladder rehearsal produced a wrong block"
+    return {"rungs": rungs, "panel_recompute_flops_ratio": ratio,
+            "num_panels": num_panels}
+
+
+def run_eviction_drill(*, smoke: bool = False,
+                       devices=None,
+                       evict_device: int = 1,
+                       bucket_sizes=None,
+                       in_dtype: str = "float32",
+                       requests_per_phase: Optional[int] = None,
+                       max_batch: int = 2,
+                       drain_below: float = 0.5,
+                       policy: Optional[EvictionPolicy] = None,
+                       rehearse_tiers: bool = True,
+                       timeline=None,
+                       progress_out=None,
+                       registry=None,
+                       seed: int = 10) -> dict:
+    """The eviction fire drill (``cli drill`` / CI): prove that losing a
+    device is a bounded, measured, local event.
+
+    Four acts, one artifact:
+
+    1. **Baseline** — clean load through a health-steered pool over all
+       local devices; pre-fault goodput recorded, the target device
+       demonstrably serving.
+    2. **Fault + eviction under live traffic** — a persistent fault
+       stream on the target device (synthetic uncorrectable evidence
+       into the shared health tracker — ``mark_sick``'s knob, repeated)
+       while load keeps flowing; the engine's elastic hook evicts it
+       mid-load, migrates its queued batches, and re-confirms the
+       survivors' executables (the re-AOT window). MTTR runs from fault
+       onset to the first correct response after eviction.
+    3. **Recovery proof** — a post-eviction clean load; goodput must
+       recover to > 0.7x the baseline on the surviving devices, with
+       zero incorrect responses anywhere in the drill.
+    4. **Recovery-machinery rehearsal** — every checksum tier fires once
+       on the live mesh (tier-of-detection counts) and the recompute
+       ladder runs its element/panel rungs (flops ratio) — the same
+       artifact carries the whole subsystem's health.
+
+    Returns the stats dict ``bench.py --serve --pool --evict-device=N``
+    emits; ``stats["recovery"]`` is what the ledger ingests
+    (``recovery.mttr_seconds`` / ``recovery.evictions`` /
+    ``recovery.panel_recompute_flops_ratio`` ...).
+    """
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+
+    from ft_sgemm_tpu.serve.buckets import default_bucket_set
+    from ft_sgemm_tpu.serve.engine import ServeEngine
+    from ft_sgemm_tpu.serve.loadgen import LoadSpec
+    from ft_sgemm_tpu.serve.pool import DevicePool
+    from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
+    from ft_sgemm_tpu.telemetry.registry import MetricsRegistry
+
+    def progress(p):
+        if timeline is not None:
+            timeline.point("recovery", "drill", **p)
+        if progress_out is not None:
+            print(f"drill: {p}", file=progress_out, flush=True)
+
+    reg = registry if registry is not None else MetricsRegistry()
+    devices = jax.local_devices() if devices is None else list(devices)
+    if len(devices) < 2:
+        raise ValueError("the eviction drill needs >= 2 devices"
+                         " (an eviction must leave survivors)")
+    evict_device = int(evict_device)
+    if not 0 <= evict_device < len(devices):
+        raise ValueError(f"evict_device={evict_device} outside the"
+                         f" {len(devices)}-device pool")
+    sizes = tuple(bucket_sizes) if bucket_sizes else (
+        (128, 256) if smoke else (256, 512))
+    buckets = default_bucket_set(sizes, in_dtype=in_dtype)
+    n_phase = (16 if smoke else 32) if requests_per_phase is None \
+        else int(requests_per_phase)
+    spec = LoadSpec(num_requests=n_phase, in_dtype=in_dtype, seed=seed)
+    largest = max(sizes)
+    shapes = tuple(s for s in spec.shapes if max(s) <= largest)
+    spec = _dc.replace(spec, shapes=shapes or ((largest // 2,) * 3,))
+    rng = np.random.default_rng(seed)
+
+    health = DeviceHealthTracker()
+    pool = DevicePool(devices, health=health, drain_below=drain_below,
+                      max_in_flight=2)
+    controller = ElasticController(policy or EvictionPolicy(),
+                                   registry=reg, timeline=timeline)
+    target = pool.labels[evict_device]
+
+    t0 = time.monotonic()
+    stats: dict = {"devices": len(devices), "evict_device": target,
+                   "buckets": [b.key for b in buckets],
+                   "smoke": bool(smoke)}
+    with ServeEngine(buckets, max_batch=max_batch, timeline=timeline,
+                     registry=reg, pool=pool,
+                     elastic=controller) as engine:
+        prewarm = engine.prewarm()
+        progress({"prewarmed": prewarm["compiled"]})
+        stats["prewarm"] = prewarm
+
+        # Act 1: baseline.
+        pre = _drive_phase(engine, spec, rng, n_phase)
+        pre_batches = pool.stats()["per_device"][target]["batches"]
+        progress({"phase": "baseline", "goodput_rps": pre["goodput_rps"],
+                  "target_batches": pre_batches})
+
+        # Act 2: persistent fault under live traffic. Evidence lands in
+        # the shared tracker every submission; once the score crosses
+        # the eviction floor with enough calls behind it, the NEXT
+        # placement evicts.
+        t_fault = controller.mark_fault()
+
+        def fault_feed(i):
+            health.observe(target, calls=4, detected=4, uncorrectable=4)
+
+        during = _drive_phase(engine, spec, rng, n_phase,
+                              fault_feed=fault_feed, after_ts=None)
+        evicted = list(controller.evictions)
+        if not evicted:
+            # The load outran the evidence stream (tiny phases): one
+            # more placement pass settles it deterministically.
+            during2 = _drive_phase(engine, spec, rng, 4)
+            during["completed"] += during2["completed"]
+            during["correct"] += during2["correct"]
+            during["incorrect"] += during2["incorrect"]
+            evicted = list(controller.evictions)
+        progress({"phase": "fault", "evictions": len(evicted)})
+
+        # Act 3: recovery proof on the survivors.
+        post = _drive_phase(engine, spec, rng, n_phase)
+        pool_stats = engine.stats()["pool"]
+
+    first_ok = post.get("first_correct_ts")
+    eviction = evicted[0] if evicted else None
+    mttr = controller.mttr_seconds(first_ok) if first_ok else None
+    post_batches = pool_stats["per_device"].get(target, {}) \
+        .get("batches", 0)
+    batches_at_eviction = (eviction or {}).get("target_batches",
+                                               post_batches)
+    ratio = None
+    if pre["goodput_rps"] and post["goodput_rps"]:
+        ratio = round(post["goodput_rps"] / pre["goodput_rps"], 3)
+
+    recovery = {
+        "evictions": len(evicted),
+        "evicted_device": (eviction or {}).get("device"),
+        "reason": (eviction or {}).get("reason"),
+        "migrated_batches": (eviction or {}).get("migrated", 0),
+        "reshard_seconds": (eviction or {}).get("reshard_seconds"),
+        "mttr_seconds": round(mttr, 3) if mttr is not None else None,
+        "goodput_pre_rps": pre["goodput_rps"],
+        "goodput_post_rps": post["goodput_rps"],
+        "goodput_recovery_ratio": ratio,
+        "pre_fault_target_batches": pre_batches,
+        "post_eviction_batches_on_evicted": max(
+            0, post_batches - batches_at_eviction),
+        "incorrect_responses": (pre["incorrect"] + during["incorrect"]
+                                + post["incorrect"]),
+    }
+
+    # Act 4: rehearse the rest of the recovery machinery on the live
+    # mesh so one artifact carries the whole subsystem's health.
+    if rehearse_tiers:
+        from ft_sgemm_tpu.parallel.sharded import make_mesh
+
+        mesh = make_mesh(len(devices))
+        tiers = _tier_rehearsal(mesh, reg)
+        recovery["tier_checks"] = tiers["checks"]
+        recovery["tier_detections"] = tiers["detections"]
+        ladder = _ladder_rehearsal(reg)
+        recovery["ladder"] = ladder["rungs"]
+        recovery["panel_recompute_flops_ratio"] = \
+            ladder["panel_recompute_flops_ratio"]
+        progress({"phase": "rehearsal",
+                  "tiers": tiers["detections"],
+                  "ladder": ladder["rungs"]})
+
+    stats.update({
+        "requests_submitted": 3 * n_phase,
+        "completed": (pre["completed"] + during["completed"]
+                      + post["completed"]),
+        "correct": pre["correct"] + during["correct"] + post["correct"],
+        "pre": pre, "during_fault": during, "post": post,
+        "recovery": recovery,
+        "pool": pool_stats,
+        "seconds_total": round(time.monotonic() - t0, 3),
+        "wall_seconds": post["wall_seconds"],
+        "goodput_rps": post["goodput_rps"],
+        "throughput_rps": (round(post["completed"]
+                                 / post["wall_seconds"], 3)
+                           if post["wall_seconds"] else None),
+    })
+    stats["ok"] = bool(
+        recovery["evictions"] >= 1
+        and recovery["evicted_device"] == target
+        and recovery["incorrect_responses"] == 0
+        and recovery["post_eviction_batches_on_evicted"] == 0
+        and (ratio is None or ratio > 0.7)
+        and (post["goodput_rps"] or 0) > 0)
+    progress({"phase": "done", "ok": stats["ok"],
+              "mttr_seconds": recovery["mttr_seconds"],
+              "goodput_recovery_ratio": ratio})
+    return stats

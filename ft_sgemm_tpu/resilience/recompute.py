@@ -1,0 +1,247 @@
+"""Single-panel recompute: the recovery ladder below a full retry.
+
+Before this module, an ``uncorrectable`` report had exactly one answer:
+re-run everything (``train.resilient_step`` retries the whole step, the
+serve engine re-executes the whole request). But the checksum machinery
+LOCALIZES: the row/col residual pair names the element, the column
+residuals name the output panel, the tier report names the device. The
+ladder spends exactly as many flops as the localization demands —
+cheapest rung first, each rung RE-VERIFIED through the resident
+checksums (:func:`~ft_sgemm_tpu.resilience.tiers.residual_vectors`)
+before the ladder stops, escalating only when the cheaper rung provably
+could not or demonstrably did not suffice:
+
+1. **element_correct** — one bad row x one bad column intersect at a
+   single element whose delta IS the column residual: subtract it.
+   O(m + n) work, the in-kernel correction replayed host-side.
+2. **panel_recompute** — bad columns confined to few output panels:
+   recompute only those panels from the resident A/B shards
+   (``2 * m * k * panel_width`` flops per panel — the arXiv 2112.09017
+   panel as the recovery quantum, ~1/num_panels of a full recompute).
+3. **shard_restore** — localization too wide (or panel recompute did
+   not verify): recompute the device's whole resident output shard.
+4. **full_retry** — even the shard recompute failed to verify (the
+   resident OPERANDS are suspect): the caller must re-run the whole
+   distributed GEMM. The ladder never performs this itself — it
+   returns the verdict and the flops the caller would spend.
+
+``recomputed_flops / full_retry_flops`` is the ledger measurement
+(``recovery.panel_recompute_flops_ratio``) the acceptance criterion
+pins: a panel recompute must cost ~1/num_panels of the full retry it
+replaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ft_sgemm_tpu.resilience.tiers import residual_vectors
+
+# Runtime spelling of contracts.LADDER_RUNGS (lint-cross-checked),
+# cheapest-flops first.
+LADDER_RUNGS = ("element_correct", "panel_recompute", "shard_restore",
+                "full_retry")
+
+
+@dataclasses.dataclass
+class RecoveryOutcome:
+    """What one ladder run did.
+
+    ``rung`` is the rung that produced the returned output (the
+    terminal ``"full_retry"`` means nothing local sufficed);
+    ``attempted`` lists every rung actually RUN, in order — the
+    never-skip pin asserts the list is a prefix-consistent walk of
+    ``LADDER_RUNGS`` restricted to rungs whose localization
+    precondition held. Flops counts are exact multiply-add pairs
+    (2*m*k*width per recomputed panel).
+    """
+
+    rung: str
+    attempted: Tuple[str, ...]
+    corrected: bool
+    recomputed_flops: int
+    full_retry_flops: int
+    flops_ratio: float
+    panels: Optional[list] = None
+    element: Optional[Tuple[int, int]] = None
+    residual_before: float = 0.0
+    residual_after: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def panel_bounds(n: int, num_panels: int) -> list:
+    """Split ``n`` output columns into ``num_panels`` contiguous panels
+    (last panel absorbs the remainder). The panel is the recovery
+    quantum: localization only has to name a panel, never an exact
+    extent."""
+    num_panels = max(1, min(int(num_panels), n))
+    width = max(1, n // num_panels)
+    bounds = []
+    lo = 0
+    while lo < n:
+        hi = n if len(bounds) == num_panels - 1 else min(n, lo + width)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def _verify(a, b, c, alpha, beta, c0, margin, expected=None):
+    """Checksum residuals of ``c``. With ``expected`` (the column/row
+    checksum vectors captured at ENCODE time, i.e. computed from the
+    operands as they were when the kernel ran) the comparison is
+    independent of the resident operands — the only reference that can
+    convict a corrupted resident shard of A/B, since recomputing the
+    expectation from corrupted operands would self-verify."""
+    if expected is None:
+        r_col, r_row, tol = residual_vectors(
+            a, b, c, alpha=alpha, beta=beta, c0=c0, margin=margin)
+    else:
+        exp_col, exp_row = expected
+        c32 = np.asarray(c, np.float32)
+        r_col = c32.sum(axis=0).astype(np.float64) - np.asarray(
+            exp_col, np.float64)
+        r_row = c32.sum(axis=1).astype(np.float64) - np.asarray(
+            exp_row, np.float64)
+        _, _, tol = residual_vectors(a, b, c, alpha=alpha, beta=beta,
+                                     c0=c0, margin=margin)
+    resid = float(max(np.max(np.abs(r_col), initial=0.0),
+                      np.max(np.abs(r_row), initial=0.0)))
+    return r_col, r_row, tol, resid
+
+
+def encode_expected(a, b, *, alpha: float = 1.0, beta: float = 0.0,
+                    c0=None):
+    """The (column, row) checksum expectation vectors of
+    ``alpha * a @ b.T + beta * c0`` — what a caller captures at encode
+    time and hands to :func:`recover_local` as ``expected`` so later
+    recoveries verify against the operands AS THEY WERE, not as they
+    are."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    exp_col = alpha * (a.sum(axis=0) @ b.T)
+    exp_row = alpha * (a @ b.sum(axis=0))
+    if beta != 0.0 and c0 is not None:
+        c0 = np.asarray(c0, np.float32)
+        exp_col = exp_col + beta * c0.sum(axis=0)
+        exp_row = exp_row + beta * c0.sum(axis=1)
+    return exp_col.astype(np.float64), exp_row.astype(np.float64)
+
+
+def recover_local(a, b, c_bad, *, alpha: float = 1.0, beta: float = 0.0,
+                  c0=None, num_panels: int = 8, margin: float = 64.0,
+                  global_flops: Optional[int] = None,
+                  max_panels: Optional[int] = None,
+                  expected=None):
+    """Run the recovery ladder over one device's resident block.
+
+    ``a`` (m, k) and ``b`` (n, k) are the device's RESIDENT operand
+    shards, ``c_bad`` its (m, n) output block that failed a checksum
+    check (tier report or resident verify). ``global_flops`` is what a
+    full distributed retry would cost (defaults to this block's own
+    recompute cost — the single-device degenerate case);
+    ``max_panels`` bounds how many implicated panels rung 2 will
+    recompute before escalating (default: half the panels — past that
+    a shard restore is cheaper bookkeeping for the same flops).
+    ``expected`` (see :func:`encode_expected`) makes verification
+    independent of the resident operands — the configuration that can
+    reach the terminal ``full_retry`` rung when A/B themselves are
+    corrupted.
+
+    Returns ``(c_fixed, RecoveryOutcome)``. ``c_fixed`` is always the
+    best available block; ``outcome.rung == "full_retry"`` tells the
+    caller it is still unverified and the whole GEMM must re-run.
+    """
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    c = np.array(c_bad, np.float32, copy=True)
+    m, k = a.shape
+    n = b.shape[0]
+    if c0 is None and beta != 0.0:
+        raise ValueError("recover_local needs c0 when beta != 0 (the "
+                         "epilogue input is part of the expectation)")
+    full_local = 2 * m * k * n
+    full_flops = int(global_flops) if global_flops else full_local
+    bounds = panel_bounds(n, num_panels)
+    if max_panels is None:
+        max_panels = max(1, len(bounds) // 2)
+
+    def oracle_cols(lo, hi):
+        block = alpha * (a @ b[lo:hi].T)
+        if beta != 0.0:
+            block = block + beta * np.asarray(c0, np.float32)[:, lo:hi]
+        return block
+
+    attempted = []
+    spent = 0
+    r_col, r_row, tol, resid0 = _verify(a, b, c, alpha, beta, c0, margin,
+                                       expected=expected)
+    resid = resid0
+    bad_cols = np.nonzero(np.abs(r_col) > tol)[0]
+    bad_rows = np.nonzero(np.abs(r_row) > tol)[0]
+
+    def outcome(rung, corrected, panels=None, element=None):
+        return RecoveryOutcome(
+            rung=rung, attempted=tuple(attempted), corrected=corrected,
+            recomputed_flops=spent, full_retry_flops=full_flops,
+            flops_ratio=(spent / full_flops if full_flops else 0.0),
+            panels=panels, element=element,
+            residual_before=resid0, residual_after=resid)
+
+    if resid0 <= tol:
+        # Nothing to recover: the clean fast path (rung vocabulary
+        # deliberately not consumed — attempted stays empty).
+        return c, outcome(LADDER_RUNGS[0], True)
+
+    # Rung 1: a single located element. Precondition: exactly one bad
+    # row AND one bad column (the ABFT intersection); the correction is
+    # the residual itself.
+    if len(bad_cols) == 1 and len(bad_rows) == 1:
+        attempted.append("element_correct")
+        i, j = int(bad_rows[0]), int(bad_cols[0])
+        c[i, j] -= np.float32(r_col[j])
+        spent += m + n  # the two checksum sums' worth of work
+        r_col, r_row, tol, resid = _verify(a, b, c, alpha, beta, c0,
+                                           margin, expected=expected)
+        if resid <= tol:
+            return c, outcome("element_correct", True, element=(i, j))
+        bad_cols = np.nonzero(np.abs(r_col) > tol)[0]
+
+    # Rung 2: recompute only the implicated panels. Precondition: the
+    # bad columns are confined to few enough panels that panel work
+    # stays well under a shard restore.
+    hit = sorted({pi for pi, (lo, hi) in enumerate(bounds)
+                  if np.any((bad_cols >= lo) & (bad_cols < hi))})
+    if bad_cols.size and 0 < len(hit) <= max_panels:
+        attempted.append("panel_recompute")
+        for pi in hit:
+            lo, hi = bounds[pi]
+            c[:, lo:hi] = oracle_cols(lo, hi)
+            spent += 2 * m * k * (hi - lo)
+        r_col, r_row, tol, resid = _verify(a, b, c, alpha, beta, c0,
+                                           margin, expected=expected)
+        if resid <= tol:
+            return c, outcome("panel_recompute", True, panels=hit)
+
+    # Rung 3: the whole resident shard.
+    attempted.append("shard_restore")
+    c = oracle_cols(0, n)
+    spent += full_local
+    r_col, r_row, tol, resid = _verify(a, b, c, alpha, beta, c0, margin,
+                                       expected=expected)
+    if resid <= tol:
+        return c, outcome("shard_restore", True)
+
+    # Rung 4: nothing local verifies — the resident operands themselves
+    # are suspect. The caller owns the distributed re-run; we price it.
+    attempted.append("full_retry")
+    spent += full_flops
+    return c, outcome("full_retry", False)
+
+
+__all__ = ["LADDER_RUNGS", "RecoveryOutcome", "encode_expected",
+           "panel_bounds", "recover_local"]

@@ -1,0 +1,317 @@
+"""Hierarchical data-plane checksum tiers over a device mesh.
+
+The in-kernel ABFT check (ops/ft_sgemm.py) verifies what ONE kernel
+produced; the staged counter reduction (parallel/reduce.py) made the
+mesh's DETECTION traffic hierarchical. What neither covers is the data
+plane between kernels: a partial product corrupted after its kernel's
+check, a value torn in the reduction's in-flight buffers, a resident
+shard flipped while it waited. *Large Scale Distributed Linear Algebra
+With TPUs* (PAPERS.md, arXiv 2112.09017) structures its checksums
+hierarchically — per-panel sums combined per host, then globally — and
+this module applies that panel structure to CHECKSUM ROW VECTORS, one
+staged axis at a time (the ``hierarchical_psum`` discipline), instead of
+just the int32 counter plane:
+
+- **device tier** — each device compares the observed column sums of its
+  local K-partial against the encoded expectation
+  (``sum_rows(A_loc) @ B_loc.T``). No collective at all: the cheapest
+  check, and the one with the sharpest localization (device + columns).
+- **host tier** — the signed residual vectors reduce over the first
+  (ICI) staged axis. Corruptions on sibling devices that are each below
+  the per-device tolerance ACCUMULATE here; the combined vector crosses
+  the (wider) host tolerance while every device tier stayed blind.
+- **global tier** — after every axis: one vector for the whole mesh,
+  the only stage whose values cross DCN, catching mesh-wide drift no
+  narrower tier could resolve.
+
+Detection scans tiers cheapest-communication first and records the FIRST
+tier whose residual exceeds that tier's tolerance — the
+``tier-of-detection`` telemetry label (``recovery_tier``, mirrored in
+``contracts.RECOVERY_TIERS``). Unlike the counter tiers the staged
+values are f32, so staged == flat only up to reassociation noise: every
+comparison here is tolerance-gated (:func:`checksum_tolerance`, widening
+by sqrt(fan-in) per stage) where the counter staging is exact — the
+asymmetry DESIGN.md §18 documents.
+
+The mesh-side emission lives in
+:func:`ft_sgemm_tpu.parallel.sharded.make_tiered_ft_step`;
+:func:`verify_resident` is the host-side twin for output that already
+sits in memory (the resident-shard window, and the re-verification the
+recompute ladder runs after every rung).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+# Runtime spelling of contracts.RECOVERY_TIERS (the lint axis-drift pass
+# cross-checks the two), ordered cheapest-communication first.
+TIERS = ("device", "host", "global")
+
+
+def checksum_tolerance(m: int, k: int, amax: float, bmax: float,
+                       *, margin: float = 64.0) -> float:
+    """The f32 noise floor of one device-tier checksum comparison.
+
+    The observed and expected column sums are both f32 reductions over
+    ``m * k`` products of magnitude <= ``amax * bmax``; their clean
+    difference is rounding noise that grows like ``eps * k * sqrt(m)``
+    times the operand scale. ``margin`` is the calibration headroom
+    (the ROC machinery's stance: wide enough for zero false positives
+    on clean traffic, tight enough that a single flipped mantissa bit of
+    consequence lands above it). Higher tiers widen this by
+    ``sqrt(fan-in)`` — independent per-device noise adds in quadrature.
+    """
+    eps = float(np.finfo(np.float32).eps)
+    scale = max(float(amax) * float(bmax), 1e-30)
+    return margin * eps * scale * max(k, 1) * math.sqrt(max(m, 1))
+
+
+@dataclasses.dataclass
+class TierReport:
+    """What one tiered check saw.
+
+    ``tier`` is the tier-of-detection (None when clean): the FIRST tier,
+    scanning cheapest-communication first, whose max-abs residual
+    exceeded that tier's tolerance. ``residuals`` / ``tolerances`` carry
+    every tier's numbers so the caller sees how close the quiet tiers
+    ran. ``device_coords`` names the worst device (mesh coordinates)
+    when the device tier detected; ``columns`` lists implicated GLOBAL
+    output columns at the detecting tier — the localization the
+    recompute ladder starts from.
+    """
+
+    detected: bool
+    tier: Optional[str]
+    residuals: dict
+    tolerances: dict
+    device_coords: Optional[Tuple[int, ...]] = None
+    columns: Optional[list] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def staged_reduce_np(grid: np.ndarray,
+                     axes: Sequence[int]) -> list:
+    """Host-side mirror of the in-mesh staging: reduce a per-device
+    vector grid one axis at a time, keeping every stage's partials.
+    ``grid`` is ``(d0, d1, ..., n)``; each stage sums one device axis
+    (keepdims) so stage ``s`` holds the combined vectors at that tier.
+    The staged END VALUE equals the flat sum up to f32 reassociation —
+    the tolerance-aware equality ``tests/test_resilience.py`` pins.
+    """
+    stages = [grid]
+    cur = grid
+    for ax in axes:
+        cur = cur.sum(axis=ax, keepdims=True)
+        stages.append(cur)
+    return stages
+
+
+def detect_tiers(r_dev: np.ndarray, tol0: float,
+                 *, tier_axes: Sequence[int] = (1, 0),
+                 col_offset: int = 0) -> TierReport:
+    """Scan the staged residuals cheapest tier first.
+
+    ``r_dev`` is the per-device residual grid ``(X, Y, n)`` (signed f32
+    vectors); staging follows ``tier_axes``. Tolerance at stage ``s``
+    is ``tol0 * sqrt(fan-in so far)``.
+    """
+    grid = np.asarray(r_dev, np.float64)
+    stages = staged_reduce_np(grid, tier_axes)
+    # Fan-in at stage s = how many devices each stage-s vector already
+    # combines; independent per-device noise adds in quadrature, so the
+    # tolerance widens by sqrt(fan-in).
+    fanins = [1]
+    for ax in tier_axes:
+        fanins.append(fanins[-1] * grid.shape[ax])
+    residuals = {}
+    tolerances = {}
+    detection = None
+    for name, stage, fanin in zip(TIERS, stages, fanins):
+        tol = tol0 * math.sqrt(fanin)
+        resid = float(np.max(np.abs(stage))) if stage.size else 0.0
+        residuals[name] = resid
+        tolerances[name] = tol
+        if detection is None and resid > tol:
+            flat = np.abs(stage).max(axis=-1)
+            worst = np.unravel_index(int(np.argmax(flat)), flat.shape)
+            vec = np.abs(stage[worst])
+            cols = [int(j) + col_offset
+                    for j in np.nonzero(vec > tol / 2.0)[0]]
+            detection = (name, tuple(int(w) for w in worst), cols)
+    if detection is None:
+        return TierReport(False, None, residuals, tolerances)
+    tier, worst, cols = detection
+    return TierReport(
+        True, tier, residuals, tolerances,
+        device_coords=worst if tier == "device" else None,
+        columns=cols or None)
+
+
+def verify_resident(a, b, c, *, alpha: float = 1.0, beta: float = 0.0,
+                    c0=None, margin: float = 64.0) -> TierReport:
+    """Host-side checksum check of a RESIDENT output block.
+
+    Recomputes the encoded expectation of ``c = alpha * a @ b.T +
+    beta * c0`` from the resident operands (column sums AND row sums —
+    the row/col locator pair) and compares against the observed sums of
+    ``c``. A single-tier (device) report: this is the check a device
+    runs over its own shard between kernels, and the re-verification
+    every recompute-ladder rung must pass. The residual VECTORS needed
+    for localization are attached by :func:`residual_vectors` (the
+    ladder's entry point) — this function answers only detected-or-not
+    plus magnitude.
+    """
+    r_col, r_row, tol = residual_vectors(a, b, c, alpha=alpha, beta=beta,
+                                         c0=c0, margin=margin)
+    resid = float(max(np.max(np.abs(r_col), initial=0.0),
+                      np.max(np.abs(r_row), initial=0.0)))
+    detected = resid > tol
+    cols = [int(j) for j in np.nonzero(np.abs(r_col) > tol)[0]]
+    return TierReport(
+        detected, "device" if detected else None,
+        residuals={"device": resid}, tolerances={"device": tol},
+        columns=cols or None)
+
+
+def residual_vectors(a, b, c, *, alpha: float = 1.0, beta: float = 0.0,
+                     c0=None, margin: float = 64.0):
+    """The (column, row) signed checksum residual vectors of a resident
+    output plus the device-tier tolerance — the localization raw
+    material the recompute ladder consumes.
+
+    Column residual ``r_col[j] = sum_i c[i,j] - expected``; a corrupted
+    element ``(i, j)`` of delta ``d`` shows up as ``r_col[j] == d`` and
+    ``r_row[i] == d`` — the classic ABFT row/col intersection.
+    """
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    c = np.asarray(c, np.float32)
+    m, k = a.shape
+    n = b.shape[0]
+    exp_col = alpha * (a.sum(axis=0) @ b.T)
+    exp_row = alpha * (a @ b.sum(axis=0))
+    if beta != 0.0 and c0 is not None:
+        c0 = np.asarray(c0, np.float32)
+        exp_col = exp_col + beta * c0.sum(axis=0)
+        exp_row = exp_row + beta * c0.sum(axis=1)
+    r_col = c.sum(axis=0) - exp_col
+    r_row = c.sum(axis=1) - exp_row
+    amax = float(np.max(np.abs(a), initial=0.0))
+    bmax = float(np.max(np.abs(b), initial=0.0))
+    tol = checksum_tolerance(max(m, n), k, amax, bmax, margin=margin)
+    return r_col.astype(np.float64), r_row.astype(np.float64), tol
+
+
+def tiered_ft_sgemm(a, b, c, mesh, shape="huge", *,
+                    alpha: float = 1.0, beta: float = -1.5,
+                    inject=None, strategy: str = "weighted",
+                    threshold=None, in_dtype: str = "float32",
+                    interpret: Optional[bool] = None,
+                    inject_coords: Optional[Tuple[int, int]] = None,
+                    tier_corrupt: Sequence = (),
+                    margin: float = 64.0,
+                    registry=None):
+    """Fused-ABFT mesh GEMM WITH hierarchical data-plane checksum tiers.
+
+    The ``sharded_ft_sgemm`` layout (A ``P("x", "y")``, B
+    ``P(None, "y")``, C ``P("x", None)``) with the step swapped for
+    :func:`~ft_sgemm_tpu.parallel.sharded.make_tiered_ft_step`: besides
+    the usual result the call returns a :class:`TierReport` from the
+    staged per-device checksum residual vectors. ``tier_corrupt``
+    entries (``((x, y), (i, j), delta)`` — LOCAL indices into that
+    device's partial) strike the data plane between the in-kernel check
+    and the reduction: the between-kernels corruption self-test.
+
+    On detection the report lands in telemetry (an ``uncorrectable``
+    event, op ``data_tiers``, with the tier-of-detection riding
+    ``extra["recovery_tier"]``) and the registry
+    (``recovery_tier_checks`` / ``recovery_tier_detections``). Returns
+    ``(FtSgemmResult, TierReport)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+    from ft_sgemm_tpu.ops.common import resolve_in_dtype
+    from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+    from ft_sgemm_tpu.parallel.sharded import (
+        _check_divisible,
+        make_tiered_ft_step,
+        shard_map,
+    )
+
+    inject = inject or InjectionSpec.none()
+    threshold = REFERENCE_THRESHOLD if threshold is None else threshold
+    cast_dtype, _ = resolve_in_dtype(in_dtype, "highest")
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    mx, my = mesh.shape["x"], mesh.shape["y"]
+    _check_divisible("M", m, mx)
+    _check_divisible("K", k, my)
+
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy,
+        threshold=threshold, in_dtype=in_dtype, interpret=interpret)
+    step = make_tiered_ft_step(
+        local_ft, alpha, beta, inject, det_axes=("y", "x"),
+        tier_axes=("y", "x"), inject_coords=inject_coords,
+        tier_corrupt=tuple(tier_corrupt))
+
+    vec_spec = P("x", "y", None)
+    fn = shard_map(
+        step, mesh=mesh,
+        in_specs=(P("x", "y"), P(None, "y"), P("x", None)),
+        out_specs=(P("x", None), P(None, None), P(None, None),
+                   P("x", "y"), P("x", "y"),
+                   vec_spec, vec_spec, vec_spec))
+    with telemetry.trace_span("tiered_ft_sgemm"):
+        out, det, unc, dev_det, dev_unc, r_dev, r_host, r_glob = \
+            jax.jit(fn)(a, b, c)
+    result = FtSgemmResult(out, det, unc)
+
+    amax = float(np.max(np.abs(np.asarray(a, np.float32)), initial=0.0))
+    bmax = float(np.max(np.abs(np.asarray(b, np.float32)), initial=0.0))
+    # Per-DEVICE problem: each residual vector covers an
+    # (m/mx, k/my)-shaped partial.
+    tol0 = checksum_tolerance(m // mx, k // my, amax, bmax, margin=margin)
+    report = detect_tiers(np.asarray(r_dev), tol0, tier_axes=(1, 0))
+
+    if registry is None:
+        registry = telemetry.get_registry()
+    registry.counter("recovery_tier_checks").inc()
+    if report.detected:
+        registry.counter("recovery_tier_detections",
+                         recovery_tier=report.tier).inc()
+        telemetry.record_step_event(
+            "uncorrectable", op="data_tiers",
+            extra={"recovery_tier": report.tier,
+                   "residual": report.residuals.get(report.tier),
+                   "tolerance": report.tolerances.get(report.tier),
+                   "device_coords": (list(report.device_coords)
+                                     if report.device_coords else None),
+                   "columns": report.columns,
+                   "mesh": f"mesh{mx}x{my}"})
+    return result, report
+
+
+__all__ = [
+    "TIERS",
+    "TierReport",
+    "checksum_tolerance",
+    "detect_tiers",
+    "residual_vectors",
+    "staged_reduce_np",
+    "tiered_ft_sgemm",
+    "verify_resident",
+]

@@ -207,6 +207,16 @@ class BlockEngine:
     ``kv_checksums=False`` disables the stored-state checksums; the
     compiled executors are byte-identical either way (the cache is
     host-side numpy — pinned in ``tests/test_serve_blocks.py``).
+
+    ``pool=DevicePool(...)`` (serve/pool.py) gives block serving the
+    GEMM engine's multi-device dispatch: the dispatcher PLACES ready
+    batches on health-steered per-device workers, each device runs its
+    own AOT replica of every (bucket, variant) executor, and
+    ``elastic=ElasticController(...)`` adds the PR-15 eviction path —
+    a device crossing the eviction floor is removed from placement with
+    its queued block batches migrated. Pool mode forces ``ring=False``
+    (replicas are single-device by construction) and serializes the
+    host-side KV cache behind one lock.
     """
 
     def __init__(self, buckets: Sequence[BlockBucket], *,
@@ -217,9 +227,17 @@ class BlockEngine:
                  kv_threshold: Optional[float] = None,
                  ring: bool = False,
                  inject_coords: Optional[tuple] = (1,),
-                 timeline=None, registry=None, monitor=None):
+                 timeline=None, registry=None, monitor=None,
+                 pool=None, elastic=None):
         if not buckets:
             raise ValueError("BlockEngine needs at least one bucket")
+        if pool is not None and ring:
+            # A pool dispatches per-device SINGLE-DEVICE replicas; the
+            # ring executor spans the whole mesh — the two placement
+            # models are mutually exclusive by construction.
+            raise ValueError("BlockEngine(pool=) needs ring=False (ring"
+                             " executors span the mesh; pool replicas"
+                             " are single-device)")
         dims = {(b.d, b.dv, b.in_dtype) for b in buckets}
         if len(dims) != 1:
             raise ValueError(
@@ -237,6 +255,17 @@ class BlockEngine:
         self._mesh = None
         self._tl = _as_recorder(timeline)
         self.monitor = monitor
+        # Multi-device dispatch + elastic recovery (serve/pool.py,
+        # resilience/elastic.py): the GEMM engine's placement/drain/
+        # eviction discipline, block-typed. pool=None keeps the
+        # historical single-dispatcher engine exactly.
+        self.pool = pool
+        self.elastic = elastic
+        self._pool_threads: list = []
+        # The KV cache and per-stream source rows are host-side state
+        # shared by every pool worker; one lock serializes stored-state
+        # access (single-dispatcher mode pays an uncontended acquire).
+        self._kv_lock = threading.RLock()
         kv_kw = {} if kv_threshold is None else {"threshold": kv_threshold}
         self.kv = PagedKVCache(self.d, self.dv, page_size=kv_page_size,
                                checksums=kv_checksums, **kv_kw)
@@ -382,18 +411,31 @@ class BlockEngine:
         fn, avals = self._jit_fn(bucket, variant)
         return jax.jit(fn).lower(*avals).as_text()
 
-    def _jit_fn(self, bucket: BlockBucket, variant: str):
+    def _jit_fn(self, bucket: BlockBucket, variant: str, device=None):
         import jax
         import jax.numpy as jnp
 
         fn = self._executor_fn(bucket, variant)
-        avals = (jax.ShapeDtypeStruct((bucket.lq, self.d), jnp.float32),
-                 jax.ShapeDtypeStruct((bucket.lk, self.d), jnp.float32),
-                 jax.ShapeDtypeStruct((bucket.lk, self.dv), jnp.float32))
+        if device is None:
+            def av(shape):
+                return jax.ShapeDtypeStruct(shape, jnp.float32)
+        else:
+            from jax.sharding import SingleDeviceSharding
+
+            sh = SingleDeviceSharding(device)
+
+            def av(shape):
+                return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                            sharding=sh)
+        avals = (av((bucket.lq, self.d)),
+                 av((bucket.lk, self.d)),
+                 av((bucket.lk, self.dv)))
         return fn, avals
 
-    def _get_compiled(self, bucket: BlockBucket, variant: str):
-        key = (bucket.key, variant)
+    def _get_compiled(self, bucket: BlockBucket, variant: str,
+                      device=None):
+        label = None if device is None else str(device)
+        key = (bucket.key, variant, label)
         compiled = self._compiled.get(key)
         if compiled is not None:
             return compiled
@@ -403,24 +445,27 @@ class BlockEngine:
                 return compiled
             import jax
 
-            fn, avals = self._jit_fn(bucket, variant)
-            with self._tl.span(f"compile[{bucket.key}:{variant}]",
-                               kind="compile"):
+            fn, avals = self._jit_fn(bucket, variant, device=device)
+            span = f"compile[{bucket.key}:{variant}]" if label is None \
+                else f"compile[{bucket.key}:{variant}@{label}]"
+            with self._tl.span(span, kind="compile"):
                 compiled = jax.jit(fn).lower(*avals).compile()
             self._compiled[key] = compiled
             return compiled
 
     def prewarm(self, variants=VARIANTS) -> dict:
-        """AOT-compile every (bucket, variant) executor; everything
-        after the ``prewarm_done`` point is the steady state the
-        zero-compile-span pin measures (same contract as the GEMM
+        """AOT-compile every (bucket, variant[, pool device]) executor;
+        everything after the ``prewarm_done`` point is the steady state
+        the zero-compile-span pin measures (same contract as the GEMM
         engine's prewarm)."""
         t0 = time.monotonic()
         compiled = 0
+        devices = (None,) if self.pool is None else self.pool.devices
         for bucket in self.buckets:
             for variant in variants:
-                self._get_compiled(bucket, variant)
-                compiled += 1
+                for device in devices:
+                    self._get_compiled(bucket, variant, device=device)
+                    compiled += 1
         self._prewarmed = True
         seconds = round(time.monotonic() - t0, 3)
         self._tl.point("serve_block", "prewarm_done", compiled=compiled,
@@ -436,6 +481,13 @@ class BlockEngine:
                 target=self._dispatch_loop, daemon=True,
                 name="serve-block-dispatch")
             self._thread.start()
+        if self.pool is not None and not self._pool_threads:
+            for i in range(len(self.pool.devices)):
+                t = threading.Thread(target=self._pool_worker, args=(i,),
+                                     daemon=True,
+                                     name=f"serve-block-pool-{i}")
+                t.start()
+                self._pool_threads.append(t)
         return self
 
     def __enter__(self) -> "BlockEngine":
@@ -452,8 +504,9 @@ class BlockEngine:
         length, or cached-prefix length + the new token for decode."""
         if request.phase == "prefill":
             return request.q.shape[0]
-        return self.kv.length(request.seq_id, request.layer,
-                              request.head) + 1
+        with self._kv_lock:
+            return self.kv.length(request.seq_id, request.layer,
+                                  request.head) + 1
 
     def submit(self, request: BlockRequest) -> _Future:
         length = self.request_length(request)
@@ -522,7 +575,84 @@ class BlockEngine:
                     del q[:len(take)]
                     batches.append((self._by_key[key], take))
             for bucket, entries in batches:
-                self._execute_batch(bucket, entries)
+                if self.pool is not None:
+                    self._place_batch(bucket, entries)
+                else:
+                    self._execute_batch(bucket, entries)
+
+    def _check_elastic(self) -> None:
+        if self.elastic is None or self.pool is None:
+            return
+        decision = self.elastic.should_evict(self.pool)
+        if decision is not None:
+            self.evict_device(decision[0], reason=decision[1])
+
+    def evict_device(self, index: int, reason: str = "manual") -> dict:
+        """The GEMM engine's eviction contract, block-typed: placement
+        stops naming the device, queued block batches migrate through
+        the placer, survivors' executors are confirmed (the re-AOT
+        window — a pure cache walk when prewarmed)."""
+        from ft_sgemm_tpu import telemetry
+
+        label = self.pool.labels[index]
+        t0 = time.monotonic()
+        leftovers = self.pool.evict(index)
+        survivors = [d for i, d in enumerate(self.pool.devices)
+                     if i not in self.pool.evicted]
+        with self._tl.span(f"reshard[{label}]", kind="stage") as info:
+            for bucket in self.buckets:
+                for variant in VARIANTS:
+                    for device in survivors:
+                        self._get_compiled(bucket, variant, device=device)
+            migrated = 0
+            for bucket, entries in leftovers:
+                self._place_batch(bucket, entries)
+                migrated += len(entries)
+            info["value"] = {"device": label, "reason": reason,
+                             "migrated_requests": migrated}
+        seconds = round(time.monotonic() - t0, 6)
+        facts = {"index": index, "device": label, "reason": reason,
+                 "migrated": migrated, "reshard_seconds": seconds,
+                 "survivors": len(survivors), "ts": time.monotonic()}
+        self.registry.counter("recovery_evictions", device=label).inc()
+        telemetry.record_step_event(
+            "evicted", op="serve_pool",
+            extra={"device": label, "reason": reason,
+                   "migrated": migrated, "workload": "block",
+                   "reshard_seconds": seconds})
+        self._tl.point("recovery", "evicted", device=label,
+                       reason=reason, migrated=migrated)
+        if self.elastic is not None:
+            self.elastic.record_eviction(facts)
+        return facts
+
+    def _place_batch(self, bucket: BlockBucket, entries) -> None:
+        self._check_elastic()
+        index = self.pool.choose()
+        label = self.pool.labels[index]
+        depth = self.pool.put(index, (bucket, entries))
+        self.registry.gauge("serve_pool_queue_depth",
+                            device=label).set(depth)
+        self.registry.counter("serve_pool_placements", device=label).inc()
+        self._tl.point("serve_block", "placement", device=label,
+                       pool_placement=self.pool.placement,
+                       bucket=bucket.key,
+                       trace_ids=[e.request.trace_id for e in entries])
+
+    def _pool_worker(self, index: int) -> None:
+        label = self.pool.labels[index]
+        while True:
+            item = self.pool.get(index)
+            if item is None:
+                if self.pool.stopped:
+                    return
+                continue
+            self.registry.gauge("serve_pool_queue_depth", device=label) \
+                .set(self.pool.queue_depth(index))
+            bucket, entries = item
+            self.pool.note_batch(index, len(entries))
+            self.registry.counter("serve_pool_batches", device=label).inc()
+            self._execute_batch(bucket, entries, device_index=index)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -547,6 +677,12 @@ class BlockEngine:
             self._thread.join(timeout=10.0)
             self._thread = None
         leftovers = []
+        if self.pool is not None:
+            for _bucket, entries in self.pool.stop():
+                leftovers.extend(entries)
+            for t in self._pool_threads:
+                t.join(timeout=10.0)
+            self._pool_threads = []
         with self._cond:
             for q in self._pending.values():
                 leftovers.extend(q)
@@ -565,18 +701,22 @@ class BlockEngine:
         """Corrupt one stored page between decode steps (delegates to
         :meth:`PagedKVCache.corrupt`; ``page=None`` targets the last
         written page). Returns the corrupted page index."""
-        if page is None:
-            length = self.kv.length(seq_id, layer, head)
-            if length == 0:
-                raise ValueError(f"sequence {seq_id} has no cached state")
-            page = (length - 1) // self.kv.page_size
-        self.kv.corrupt(seq_id, layer, head, page, row=row, cols=cols,
-                        magnitude=magnitude, which=which, target=target)
+        with self._kv_lock:
+            if page is None:
+                length = self.kv.length(seq_id, layer, head)
+                if length == 0:
+                    raise ValueError(
+                        f"sequence {seq_id} has no cached state")
+                page = (length - 1) // self.kv.page_size
+            self.kv.corrupt(seq_id, layer, head, page, row=row, cols=cols,
+                            magnitude=magnitude, which=which,
+                            target=target)
         return page
 
     # -- execution ----------------------------------------------------------
 
-    def _execute_batch(self, bucket: BlockBucket, entries):
+    def _execute_batch(self, bucket: BlockBucket, entries,
+                       device_index: Optional[int] = None):
         with self._stats_lock:
             self._counts["batches"] += 1
             self._per_bucket[bucket.key]["batches"] += 1
@@ -587,13 +727,16 @@ class BlockEngine:
                            trace_ids=trace_ids) as info:
             det_total = unc_total = 0
             for entry in entries:
-                det, unc = self._execute_one(bucket, entry)
+                det, unc = self._execute_one(bucket, entry,
+                                             device_index=device_index)
                 det_total += det
                 unc_total += unc
             info["value"] = {"batch": len(entries),
                              "detections": det_total,
                              "uncorrectable_final": unc_total,
                              "trace_ids": trace_ids}
+            if device_index is not None:
+                info["value"]["device"] = self.pool.labels[device_index]
 
     def _append_source(self, key: tuple, k_rows, v_rows) -> None:
         src = self._source.setdefault(
@@ -719,10 +862,10 @@ class BlockEngine:
         vp[:length] = V
         return qp, kp, vp, slice(row, row + 1)
 
-    def _run_executor(self, bucket, variant, qp, kp, vp):
+    def _run_executor(self, bucket, variant, qp, kp, vp, device=None):
         """One executor call, normalized to ``(out, det, flags, unc,
         dev_entries)`` with host ints."""
-        compiled = self._get_compiled(bucket, variant)
+        compiled = self._get_compiled(bucket, variant, device=device)
         res = compiled(qp, kp, vp)
         dev_det = dev_unc = None
         if len(res) == 6:  # ring executor: trailing per-device counters
@@ -732,29 +875,36 @@ class BlockEngine:
         return (out, int(np.asarray(det)), int(np.asarray(flags)),
                 int(np.asarray(unc)), dev_det, dev_unc)
 
-    def _execute_one(self, bucket: BlockBucket,
-                     entry: _Entry) -> Tuple[int, int]:
+    def _execute_one(self, bucket: BlockBucket, entry: _Entry,
+                     device_index: Optional[int] = None) -> Tuple[int, int]:
         from ft_sgemm_tpu import telemetry
 
         request = entry.request
         with trace_scope(request.trace_id):
-            return self._execute_one_traced(bucket, entry, telemetry)
+            return self._execute_one_traced(bucket, entry, telemetry,
+                                            device_index=device_index)
 
     def _execute_one_traced(self, bucket: BlockBucket, entry: _Entry,
-                            telemetry) -> Tuple[int, int]:
+                            telemetry,
+                            device_index: Optional[int] = None
+                            ) -> Tuple[int, int]:
         request = entry.request
         trace_id = request.trace_id
         key = (request.seq_id, request.layer, request.head)
+        device = (None if device_index is None
+                  else self.pool.devices[device_index])
         K = V = None
         kv_info = {"faults": 0, "corrected": 0, "restores": 0, "ok": True}
         if request.phase == "decode":
             # New token enters the checked store FIRST (its page is
             # resealed on write), then the whole prefix reads back
-            # through the checksums.
-            self.kv.append(*key, request.k, request.v)
-            self.registry.counter("kv_page_writes").inc()
-            self._append_source(key, request.k, request.v)
-            K, V, kv_info = self._read_kv_verified(request, bucket)
+            # through the checksums. The kv lock serializes stored-state
+            # access across pool workers.
+            with self._kv_lock:
+                self.kv.append(*key, request.k, request.v)
+                self.registry.counter("kv_page_writes").inc()
+                self._append_source(key, request.k, request.v)
+                K, V, kv_info = self._read_kv_verified(request, bucket)
             length = K.shape[0]
             if not (bucket.fits_decode(length)):
                 # The submit-time length raced a concurrent decode of
@@ -770,7 +920,7 @@ class BlockEngine:
         dev_det = dev_unc = None
         while True:
             out, det, flags, unc, dev_det, dev_unc = self._run_executor(
-                bucket, variant, qp, kp, vp)
+                bucket, variant, qp, kp, vp, device=device)
             # Softmax flags are detect-only (no redundancy to correct
             # from): a flagged step re-runs, exactly like an
             # uncorrectable GEMM interval.
@@ -837,9 +987,10 @@ class BlockEngine:
         if request.phase == "prefill" and ok:
             # Verified prefill state enters the checked store: every
             # page seals its checksum rows as it is written.
-            self.kv.append(*key, request.k, request.v)
-            self.registry.counter("kv_page_writes").inc()
-            self._append_source(key, request.k, request.v)
+            with self._kv_lock:
+                self.kv.append(*key, request.k, request.v)
+                self.registry.counter("kv_page_writes").inc()
+                self._append_source(key, request.k, request.v)
         latency = time.monotonic() - entry.t_enqueue
         tokens = request.tokens
         with self._stats_lock:
@@ -931,8 +1082,11 @@ class BlockEngine:
         out["per_bucket"] = per_bucket
         out["prewarmed"] = self._prewarmed
         out["latency"] = self.latency_percentiles()
-        out["kv"] = self.kv.stats()
+        with self._kv_lock:
+            out["kv"] = self.kv.stats()
         out["ring"] = self.ring
+        if self.pool is not None:
+            out["pool"] = self.pool.stats()
         return out
 
 

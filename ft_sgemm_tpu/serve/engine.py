@@ -261,7 +261,8 @@ class ServeEngine:
                  threshold="static",
                  max_batch: int = 4, max_wait: float = 0.05,
                  max_retries: int = 2, retry_backoff: float = 0.01,
-                 timeline=None, registry=None, monitor=None, pool=None):
+                 timeline=None, registry=None, monitor=None, pool=None,
+                 elastic=None):
         if not buckets:
             raise ValueError("ServeEngine needs at least one bucket")
         if max_batch < 1:
@@ -289,6 +290,14 @@ class ServeEngine:
         # executables with a bounded async in-flight window. pool=None
         # keeps the historical single-device engine byte-for-byte.
         self.pool = pool
+        # Elastic recovery (resilience/elastic.py): with an
+        # ElasticController the placer consults the eviction policy on
+        # every batch — a device whose health evidence crosses the
+        # eviction floor (or that keeps forcing panel recomputes) is
+        # removed from placement mid-run via evict_device(), its queued
+        # batches migrating to the survivors. elastic=None (or
+        # pool=None) keeps the historical behavior exactly.
+        self.elastic = elastic
         from ft_sgemm_tpu import telemetry
 
         self.registry = registry if registry is not None \
@@ -554,12 +563,81 @@ class ServeEngine:
                 else:
                     self._execute_batch(bucket, entries)
 
+    def _check_elastic(self) -> None:
+        """Consult the eviction policy before placing (pool mode with an
+        ElasticController only). Re-entrant-safe: a device being evicted
+        is never proposed twice, and the migration re-placement below
+        lands here again harmlessly."""
+        if self.elastic is None or self.pool is None:
+            return
+        decision = self.elastic.should_evict(self.pool)
+        if decision is not None:
+            self.evict_device(decision[0], reason=decision[1])
+
+    def evict_device(self, index: int, reason: str = "manual") -> dict:
+        """Evict one pool device under live traffic: placement stops
+        naming it, its queued batches MIGRATE to the survivors through
+        the ordinary placer (so the trace flow shows where each request
+        went), and the survivors' executables are confirmed through the
+        prewarm machinery — the re-AOT window, the only place a compile
+        span is legitimate after steady state began (with a prewarmed
+        set it is a pure cache walk: zero compile spans). Returns the
+        eviction facts (also recorded on the controller when one is
+        attached)."""
+        label = self.pool.labels[index]
+        batches_before = self.pool.stats()["per_device"][label]["batches"]
+        t0 = time.monotonic()
+        leftovers = self.pool.evict(index)
+        survivors = [d for i, d in enumerate(self.pool.devices)
+                     if i not in self.pool.evicted]
+        compiled = 0
+        with self._tl.span(f"reshard[{label}]", kind="stage") as info:
+            for bucket in self.buckets:
+                for variant in VARIANTS:
+                    for device in survivors:
+                        self._get_compiled(bucket, variant, device=device)
+                        compiled += 1
+            migrated = 0
+            for bucket, entries in leftovers:
+                self._place_batch(bucket, entries)
+                migrated += len(entries)
+            info["value"] = {"device": label, "reason": reason,
+                             "confirmed_executables": compiled,
+                             "migrated_requests": migrated}
+        seconds = round(time.monotonic() - t0, 6)
+        facts = {"index": index, "device": label, "reason": reason,
+                 "migrated": migrated, "migrated_batches": len(leftovers),
+                 "reshard_seconds": seconds,
+                 "target_batches": batches_before,
+                 "survivors": len(survivors), "ts": time.monotonic()}
+        self.registry.counter("recovery_evictions", device=label).inc()
+        self.registry.gauge("recovery_pool_survivors").set(len(survivors))
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.record_step_event(
+            "evicted", op="serve_pool",
+            extra={"device": label, "reason": reason,
+                   "migrated": migrated,
+                   "reshard_seconds": seconds})
+        self._tl.point("recovery", "evicted", device=label, reason=reason,
+                       migrated=migrated, reshard_seconds=seconds)
+        if self.monitor is not None:
+            self.monitor.observe_retry(
+                {"outcome": "evicted", "op": "serve_pool",
+                 "ts": time.time(),
+                 "extra": {"device": label, "reason": reason,
+                           "migrated": migrated}})
+        if self.elastic is not None:
+            self.elastic.record_eviction(facts)
+        return facts
+
     def _place_batch(self, bucket: Bucket, entries: Sequence[_Entry]):
         """Pool mode: the dispatcher only PLACES — the chosen device's
         worker executes. The placement decision lands in the timeline
         (trace flow: WHERE each request ran) and the per-device gauges,
         and the choice itself is the health steer: a drained device's
         queue receives nothing new."""
+        self._check_elastic()
         index = self.pool.choose()
         label = self.pool.labels[index]
         depth = self.pool.put(index, (bucket, entries))

@@ -830,6 +830,7 @@ def run_block_serve_bench(*, smoke: bool = False,
                           kv_page_size: int = 32,
                           ring="auto",
                           inject_coords: Optional[tuple] = (1,),
+                          pool: bool = False,
                           timeline=None,
                           should_stop: Optional[Callable[[], bool]] = None,
                           progress_out=None,
@@ -849,6 +850,13 @@ def run_block_serve_bench(*, smoke: bool = False,
     more local devices exist — injected in-flight faults then carry
     per-ring-position device blame; pass ``ring=False`` to pin
     single-device.
+
+    ``pool=True`` dispatches through a
+    :class:`~ft_sgemm_tpu.serve.pool.DevicePool` over every local
+    device (per-device AOT replicas, health-steered placement sharing
+    the live monitor's tracker) — the GEMM plane's multi-device +
+    eviction path, block-typed. Mutually exclusive with ring executors
+    (the pool wins under ``ring="auto"``).
     """
     from ft_sgemm_tpu.serve.blocks import BlockEngine
     from ft_sgemm_tpu.serve.buckets import default_block_bucket_set
@@ -882,10 +890,17 @@ def run_block_serve_bench(*, smoke: bool = False,
     spec = dataclasses.replace(spec,
                                seq_lengths=lengths or (largest // 2,))
 
+    if pool and ring is True:
+        raise ValueError("--pool block serving uses per-device replicas;"
+                         " ring executors span the mesh (pass"
+                         " ring=False)")
     if ring == "auto":
         import jax
 
-        ring = jax.device_count() >= 2
+        # Pool dispatch and ring executors are mutually exclusive by
+        # construction (BlockEngine refuses the combination): the pool
+        # wins when both would apply.
+        ring = (not pool) and jax.device_count() >= 2
 
     def progress(p):
         if timeline is not None:
@@ -910,17 +925,27 @@ def run_block_serve_bench(*, smoke: bool = False,
             mon_server = MonitorServer(mon, port=monitor_port).start()
             progress({"monitor_url": mon_server.url})
     try:
+        dev_pool = None
+        if pool:
+            from ft_sgemm_tpu.serve.pool import DevicePool
+
+            dev_pool = DevicePool(
+                health=mon.health if mon is not None else None)
+            progress({"pool_devices": len(dev_pool.devices)})
         with BlockEngine(buckets, max_batch=max_batch, max_wait=max_wait,
                          kv_checksums=kv_checksums,
                          kv_page_size=kv_page_size, ring=bool(ring),
                          inject_coords=inject_coords,
-                         timeline=timeline, monitor=mon) as engine:
+                         timeline=timeline, monitor=mon,
+                         pool=dev_pool) as engine:
             t0 = time.monotonic()
             prewarm = engine.prewarm()
             progress({"prewarmed": prewarm["compiled"],
                       "seconds": prewarm["seconds"]})
             stats = run_block_load(engine, spec, should_stop=should_stop,
                                    progress=progress)
+            if dev_pool is not None:
+                stats["pool"] = engine.stats()["pool"]
             stats["prewarm"] = prewarm
             stats["buckets"] = [b.key for b in buckets]
             stats["smoke"] = bool(smoke)

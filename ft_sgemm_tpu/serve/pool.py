@@ -105,6 +105,7 @@ class DevicePool:
         self._rr = itertools.cycle(range(len(self.devices)))
         self._work = threading.Condition(self._lock)
         self._stop = False
+        self._evicted: set = set()
 
     # -- health ------------------------------------------------------------
 
@@ -128,6 +129,42 @@ class DevicePool:
                             detected=unc, uncorrectable=unc)
         return self.labels[index]
 
+    # -- eviction (resilience/elastic.py) ----------------------------------
+
+    @property
+    def evicted(self) -> frozenset:
+        """Indices of evicted devices (never placed on again)."""
+        with self._lock:
+            return frozenset(self._evicted)
+
+    def evict(self, index: int) -> list:
+        """Remove one device from placement PERMANENTLY and hand its
+        queued, unexecuted batches back for migration.
+
+        Eviction is strictly stronger than the drain floor: a drained
+        device can be re-admitted when the fleet median moves (and still
+        serves as the degraded-service fallback when every device is
+        below the floor); an evicted device is never a candidate again
+        and its queue is emptied NOW — the caller (``ServeEngine
+        .evict_device``) re-places the returned items on the survivors.
+        Refuses to evict the last live device. Idempotent: a second
+        eviction of the same index returns [].
+        """
+        with self._lock:
+            if index in self._evicted:
+                return []
+            survivors = [i for i in range(len(self.devices))
+                         if i != index and i not in self._evicted]
+            if not survivors:
+                raise RuntimeError(
+                    "DevicePool.evict would remove the last live device"
+                    f" ({self.labels[index]}) — refusing")
+            self._evicted.add(index)
+            leftovers = list(self._queues[index])
+            self._queues[index].clear()
+            self._work.notify_all()
+            return leftovers
+
     def _drain_floor(self, scores: List[float]) -> float:
         """The eligibility floor for one score snapshot:
         ``drain_below`` x the fleet MEDIAN. Relative, not absolute, on
@@ -143,15 +180,18 @@ class DevicePool:
         return self.drain_below * max(med, 1e-9)
 
     def eligible(self) -> List[int]:
-        """Devices placement may use: ones at or above the relative
-        drain floor; every device when none clears it (degraded service
-        beats refused service)."""
-        idx = list(range(len(self.devices)))
+        """Devices placement may use: non-evicted ones at or above the
+        relative drain floor; every non-evicted device when none clears
+        it (degraded service beats refused service — but an EVICTED
+        device is out even then)."""
+        with self._lock:
+            idx = [i for i in range(len(self.devices))
+                   if i not in self._evicted]
         if self.placement != "health" or self.health is None:
             return idx
         scores = [self.score(i) for i in idx]
         floor = self._drain_floor(scores)
-        ok = [i for i in idx if scores[i] >= floor]
+        ok = [i for i, s in zip(idx, scores) if s >= floor]
         return ok or idx
 
     # -- placement + queues ------------------------------------------------
@@ -162,8 +202,14 @@ class DevicePool:
         devices, least (queued + in-flight) per unit of score."""
         if self.placement == "round_robin":
             with self._lock:
-                return next(self._rr)
+                for _ in range(len(self.devices)):
+                    i = next(self._rr)
+                    if i not in self._evicted:
+                        return i
+                raise RuntimeError("every pool device is evicted")
         cand = self.eligible()
+        if not cand:
+            raise RuntimeError("every pool device is evicted")
         with self._lock:
             return min(cand, key=lambda i: (
                 (len(self._queues[i]) + self._in_flight[i] + 1)
@@ -242,11 +288,14 @@ class DevicePool:
             floor = self._drain_floor(scores)
             drained = [label for i, label in enumerate(self.labels)
                        if scores[i] < floor]
+        with self._lock:
+            evicted = [self.labels[i] for i in sorted(self._evicted)]
         return {"devices": len(self.devices), "devices_used": used,
                 "placement": self.placement,
                 "drain_below": self.drain_below,
                 "max_in_flight": self.max_in_flight,
-                "drained": drained, "per_device": rows}
+                "drained": drained, "evicted": evicted,
+                "per_device": rows}
 
 
 __all__ = ["DevicePool", "PLACEMENTS"]

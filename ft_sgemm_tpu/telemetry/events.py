@@ -36,8 +36,13 @@ from typing import IO, Iterable, Iterator, Optional
 #                  device-health drift — telemetry/monitor.py); carries
 #                  the crossing's facts in ``extra``, counts toward no
 #                  call totals (like the recovery-ladder stream)
+#   evicted        a device was removed from live placement (serve pool
+#                  eviction / training-mesh reshard —
+#                  resilience/elastic.py); carries the device label,
+#                  reason, and migration facts in ``extra``; counts
+#                  toward no call totals
 OUTCOMES = ("clean", "corrected", "uncorrectable", "retry", "restore",
-            "raise", "exhausted", "alert")
+            "raise", "exhausted", "alert", "evicted")
 
 # Kernel-axis label values an event (or the registry series rebuilt from
 # one, :func:`registry_from_events`) may carry: ``strategy`` rides the
@@ -75,6 +80,18 @@ AXIS_LABELS = {
     # runtime spelling); rides pool placement timeline points and
     # serve_gemm event extras when the pool executes the request.
     "pool_placement": ("health", "round_robin"),
+    # Data-plane checksum tier-of-detection (PR 15) — mirrors
+    # contracts.RECOVERY_TIERS (resilience/tiers.py::TIERS is the
+    # runtime spelling); rides ``extra["recovery_tier"]`` on tiered
+    # detection events, ordered cheapest-communication first.
+    "recovery_tier": ("device", "host", "global"),
+    # Recovery-ladder rung chosen by a panel recompute (PR 15) —
+    # mirrors contracts.LADDER_RUNGS (resilience/recompute.py::
+    # LADDER_RUNGS is the runtime spelling); rides
+    # ``extra["ladder_rung"]`` on recovery events, cheapest-flops
+    # first.
+    "ladder_rung": ("element_correct", "panel_recompute",
+                    "shard_restore", "full_retry"),
 }
 
 

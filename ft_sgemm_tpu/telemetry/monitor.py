@@ -403,7 +403,8 @@ STATUSES = ("OK", "DEGRADED", "FAILING")
 # observe_retry) — the telemetry-observer path skips them so one
 # request never lands twice. serve_block and kv_page joined in PR 12
 # (the block engine mirrors the GEMM engine's direct feed).
-_SERVE_OPS = ("serve_gemm", "serve", "serve_block", "kv_page", "monitor")
+_SERVE_OPS = ("serve_gemm", "serve", "serve_block", "kv_page", "monitor",
+              "serve_pool")
 
 
 class Monitor:

@@ -276,6 +276,9 @@ _METRIC_HELP_PREFIXES = {
     "lint_": "Static contract checker facts (ft_sgemm_tpu/lint)",
     "serve_pool_": "Multi-device serve pool: per-device placement/"
                    "queue-depth/in-flight gauges (serve/pool.py)",
+    "recovery_": "Elastic recovery: data-plane checksum tier checks, "
+                 "recompute-ladder rungs, and device evictions "
+                 "(ft_sgemm_tpu/resilience)",
 }
 
 

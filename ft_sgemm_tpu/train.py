@@ -45,19 +45,23 @@ class StepReport:
     Attributes: ``retries`` (attempts beyond the first — every one of
     them forced by a reported fault), ``restored_step`` (checkpoint step
     resumed from, or None), ``uncorrectable`` (the final attempt's
-    count — 0 unless ``raise_on_failure=False``).
+    count — 0 unless ``raise_on_failure=False``), ``evicted`` (True
+    when the ``on_persistent_fault`` hook rebuilt the step on a
+    surviving device set and the recovery attempt ran on it).
     """
 
     def __init__(self, retries: int, restored_step: Optional[int],
-                 uncorrectable: int):
+                 uncorrectable: int, evicted: bool = False):
         self.retries = retries
         self.restored_step = restored_step
         self.uncorrectable = uncorrectable
+        self.evicted = evicted
 
     def __repr__(self):
         return (f"StepReport(retries={self.retries}, "
                 f"restored_step={self.restored_step}, "
-                f"uncorrectable={self.uncorrectable})")
+                f"uncorrectable={self.uncorrectable}, "
+                f"evicted={self.evicted})")
 
 
 def resilient_step(
@@ -68,6 +72,8 @@ def resilient_step(
     checkpointer=None,
     restore_target: Any = None,
     raise_on_failure: bool = True,
+    on_persistent_fault: Optional[Callable[[int, Any],
+                                           Optional[Callable]]] = None,
 ) -> Tuple[Any, Any, StepReport]:
     """Run one training step under the clean-or-reported contract.
 
@@ -95,6 +101,22 @@ def resilient_step(
     Returns ``(new_state, metrics, StepReport)``. ``uncorrectable`` may
     be a scalar, an array, or a pytree — as long as every leaf counts
     uncorrectable intervals.
+
+    ``on_persistent_fault(attempts, unc)`` is the EVICTION hook
+    (resilience/elastic.py — the serving pool's device-eviction path,
+    offered to the training loop): it fires once, after the same-state
+    retries are exhausted but BEFORE any checkpoint restore, because a
+    persistent report usually means a sick DEVICE, not a poisoned
+    history — evicting the device and replaying the same step on the
+    survivors is cheaper than rewinding time. The hook evicts the
+    blamed device, rebuilds the step on the surviving mesh
+    (:func:`~ft_sgemm_tpu.resilience.elastic.surviving_mesh` + the
+    ordinary factories — that recompile is the re-AOT window), and
+    returns the rebuilt ``step_fn`` (or None to decline). One attempt
+    runs on the rebuilt step; success returns with
+    ``report.evicted=True``, failure falls through to the checkpoint
+    ladder USING the rebuilt step for its recovery attempt. The hook's
+    transition lands as an ``evicted`` telemetry event (op ``train``).
     """
 
     def attempt(s):
@@ -114,6 +136,26 @@ def resilient_step(
             telemetry.record_step_event(
                 "retry", uncorrectable=unc, extra={"attempt": attempts})
 
+    evicted = False
+    if on_persistent_fault is not None:
+        rebuilt = on_persistent_fault(attempts, unc)
+        if rebuilt is not None:
+            evicted = True
+            telemetry.record_step_event(
+                "evicted", op="train", uncorrectable=unc,
+                extra={"attempt": attempts})
+
+            def attempt(s, _fn=rebuilt):  # noqa: F811 — the rebuilt step
+                with telemetry.trace_span("resilient_step.attempt"):
+                    new_state, metrics, unc2 = _fn(s)
+                return new_state, metrics, gate_total(unc2)
+
+            new_state, metrics, unc = attempt(state)
+            attempts += 1
+            if unc == 0:
+                return new_state, metrics, StepReport(
+                    attempts - 1, None, 0, evicted=True)
+
     restored_step = None
     if checkpointer is not None:
         restored_step = checkpointer.latest_step
@@ -128,7 +170,7 @@ def resilient_step(
             attempts += 1
             if unc == 0:
                 return new_state, metrics, StepReport(
-                    attempts - 1, restored_step, 0)
+                    attempts - 1, restored_step, 0, evicted=evicted)
 
     telemetry.record_step_event(
         "raise" if raise_on_failure else "exhausted",
@@ -145,4 +187,5 @@ def resilient_step(
                " and no clean checkpoint was available"))
     # metrics from a reporting attempt were computed by unverified GEMMs:
     # suppress them along with new_state.
-    return state, None, StepReport(attempts - 1, restored_step, unc)
+    return state, None, StepReport(attempts - 1, restored_step, unc,
+                                   evicted=evicted)

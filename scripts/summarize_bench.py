@@ -172,6 +172,29 @@ def summarize_artifact(path, obj, ledger_entries=None):
                 dev = min(dh, key=dh.get)
                 worst = f"  (worst: {dev})"
             print(f"   {'device health min':34s} {hmin}{worst}")
+    rec = ctx.get("recovery")
+    if isinstance(rec, dict):
+        # Elastic-recovery drill facts (resilience/elastic.py): the
+        # eviction row — who was evicted, how fast service recovered,
+        # and how cheap the recompute ladder ran.
+        print(f"   {'eviction':34s} "
+              f"{rec.get('evicted_device') or 'none'}"
+              f"  (reason {rec.get('reason') or '?'}; migrated "
+              f"{rec.get('migrated_batches', 0)} queued)")
+        mttr = rec.get("mttr_seconds")
+        ratio = rec.get("goodput_recovery_ratio")
+        print(f"   {'recovery':34s} "
+              f"mttr {mttr if mttr is not None else '?'}s  goodput "
+              f"x{ratio if ratio is not None else '?'} of pre-fault  "
+              f"incorrect {rec.get('incorrect_responses', '?')}")
+        tiers = rec.get("tier_detections")
+        if isinstance(tiers, dict):
+            td = "  ".join(f"{t}={n}" for t, n in tiers.items())
+            print(f"   {'checksum tiers':34s} {td}")
+        flops = rec.get("panel_recompute_flops_ratio")
+        if flops is not None:
+            print(f"   {'panel recompute flops':34s} "
+                  f"{flops} of full retry")
     for name, e in (ctx.get("errors") or {}).items():
         first = str(e).splitlines()[0] if e else ""
         print(f"   {name:34s} ERROR: {first[:90]}")
