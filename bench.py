@@ -2776,9 +2776,114 @@ def smoke_main():
     return 0 if ok_all else 1
 
 
+def fleet_main(argv):
+    """``--fleet [--smoke]``: the multi-process fleet smoke.
+
+    Jax-free on the supervisor side by construction: this function
+    path-loads ``ft_sgemm_tpu/fleet/launch.py`` (stdlib-only by
+    contract) and drives ``launch_fleet`` — N REAL processes, each a
+    jax.distributed rank with its own virtual CPU devices, running the
+    worker's DCN-honesty phases plus the cross-host serve acts
+    (``ft_sgemm_tpu/fleet/worker.py``). Prints ONE JSON line whose
+    ``context.fleet`` block the run ledger ingests as ``fleet.*``
+    measurements. rc 0 iff every rank reported ok AND the acceptance
+    facts hold: a fault injected on a non-coordinator rank detected at
+    the ``global`` checksum tier and attributed to the right
+    (host, device) in the merged fleet view; that host EVICTED (not
+    drained) under load with goodput recovered >= 0.7x baseline and
+    zero incorrect results. Flags: ``--procs=N`` (default 2),
+    ``--vdevs=M`` (default 4), ``--program=NAME`` (default smoke),
+    ``--deadline=SECONDS``, ``--workdir=DIR`` (default: a fresh temp
+    dir; rank logs/timelines/result.json land there either way).
+    """
+    import tempfile
+
+    procs, vdevs = 2, 4
+    program = "smoke"
+    deadline = 540.0
+    workdir = None
+    bad = None
+    for f in argv:
+        try:
+            if f.startswith("--procs="):
+                procs = int(f.split("=", 1)[1])
+            elif f.startswith("--vdevs="):
+                vdevs = int(f.split("=", 1)[1])
+            elif f.startswith("--program="):
+                program = f.split("=", 1)[1]
+            elif f.startswith("--deadline="):
+                deadline = float(f.split("=", 1)[1])
+            elif f.startswith("--workdir="):
+                workdir = f.split("=", 1)[1]
+        except ValueError as e:
+            bad = f"{f}: {e}"
+    if bad:
+        sys.stderr.write(f"bench --fleet: bad flag {bad}\n")
+        return 2
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="ft_sgemm_fleet_")
+
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "ft_sgemm_tpu", "fleet", "launch.py")
+    spec = importlib.util.spec_from_file_location("_ft_fleet_launch", path)
+    launch = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = launch
+    spec.loader.exec_module(launch)
+
+    t0 = time.monotonic()
+    report = launch.launch_fleet(launch.FleetSpec(
+        procs=procs, vdevs=vdevs, program=program, workdir=workdir,
+        deadline_seconds=deadline, wedge_after=max(120.0, deadline / 3)))
+    fleet = ((report.get("result") or {}).get("fleet")
+             if isinstance(report.get("result"), dict) else None) or {}
+    localized = fleet.get("localized") or {}
+    checks = {
+        "ranks_ok": report.get("ok", False),
+        "global_tier_detected": fleet.get("global_tier") == "global",
+        "attributed_cross_host": (
+            localized.get("host") is not None
+            and localized.get("host") != 0
+            and localized.get("device") is not None),
+        "host_evicted_not_drained": (
+            fleet.get("eviction_action") == "evicted"),
+        "goodput_recovered": (
+            (fleet.get("goodput_recovery_ratio") or 0) >= 0.7),
+        "zero_incorrect": fleet.get("incorrect_responses") == 0,
+    }
+    if program != "smoke":
+        # Non-smoke programs (noop/counters/wedge) only promise their
+        # own phases; acceptance is the rank statuses.
+        checks = {"ranks_ok": report.get("ok", False)}
+    ok_all = all(checks.values())
+    context = {
+        "procs": procs, "vdevs": vdevs, "program": program,
+        "workdir": workdir,
+        "coordinator": report.get("coordinator"),
+        "rank_statuses": {r: info.get("status")
+                          for r, info in (report.get("ranks")
+                                          or {}).items()},
+        "checks": checks,
+        "fleet": fleet or None,
+        "wall_seconds": round(time.monotonic() - t0, 3),
+    }
+    artifact = {"metric": "fleet_goodput_recovery_ratio",
+                "value": fleet.get("goodput_recovery_ratio"),
+                "unit": "ratio", "vs_baseline": None, "context": context}
+    print(json.dumps(artifact), flush=True)
+    _ledger_append(artifact)
+    if not ok_all:
+        failed = sorted(k for k, v in checks.items() if not v)
+        sys.stderr.write(f"bench --fleet: FAILED checks: {failed}\n")
+    return 0 if ok_all else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         sys.exit(worker_main(sys.argv[2]))
+    if "--fleet" in sys.argv[1:]:
+        sys.exit(fleet_main(sys.argv[1:]))
     if "--serve" in sys.argv[1:]:
         sys.exit(serve_main(sys.argv[1:]))
     if "--smoke" in sys.argv[1:]:

@@ -69,6 +69,9 @@ Usage:
     python -m ft_sgemm_tpu.cli drill [--smoke] [--evict-device=N] \
         [--requests=N] [--buckets=128,256] [--telemetry=LOG.jsonl] \
         [--out=ARTIFACT.json]
+    python -m ft_sgemm_tpu.cli fleet [--procs=2] [--vdevs=4] \
+        [--program=smoke|counters|noop|wedge] [--deadline=SECONDS] \
+        [--workdir=DIR]
     python -m ft_sgemm_tpu.cli history [LEDGER.jsonl] \
         [--limit=N] [--format=text|json]
     python -m ft_sgemm_tpu.cli trend [LEDGER.jsonl] [--gate] \
@@ -1794,6 +1797,91 @@ def run_drill(flags, out=None) -> int:
     return 0 if stats.get("ok") else 1
 
 
+def run_fleet(flags, out=None) -> int:
+    """``fleet`` subcommand: launch a real multi-process fleet.
+
+    Spawns ``--procs`` local CPU processes (each its own jax.distributed
+    rank with ``--vdevs`` virtual devices) via the kill-safe launcher
+    (``ft_sgemm_tpu/fleet/launch.py``) and runs ``--program`` in every
+    rank — default ``smoke``: the DCN-honesty phases (staged-vs-flat
+    counters across the real process boundary, cross-process fault
+    localization, global-tier detection of in-flight DCN corruption)
+    plus the cross-host serve acts (per-process pools, host-granularity
+    blame, whole-host eviction under load, reshard onto the survivors).
+    Prints the merged fleet view and the per-rank statuses; exit 0 iff
+    every rank reported ok. The supervisor side never imports jax — the
+    ranks own the runtime.
+    """
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    procs, vdevs = 2, 4
+    program = "smoke"
+    deadline = 540.0
+    workdir = None
+    try:
+        for f in flags:
+            if f.startswith("--procs="):
+                procs = int(f.split("=", 1)[1])
+            elif f.startswith("--vdevs="):
+                vdevs = int(f.split("=", 1)[1])
+            elif f.startswith("--program="):
+                program = f.split("=", 1)[1]
+            elif f.startswith("--deadline="):
+                deadline = float(f.split("=", 1)[1])
+            elif f.startswith("--workdir="):
+                workdir = f.split("=", 1)[1]
+    except ValueError as e:
+        print(f"ft_sgemm: fleet: {e}", file=sys.stderr)
+        return 2
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="ft_sgemm_fleet_")
+    from ft_sgemm_tpu.fleet.launch import FleetSpec, launch_fleet
+
+    print(f"fleet: launching {procs} procs x {vdevs} vdevs "
+          f"(program={program}, workdir={workdir})", file=sys.stderr)
+    report = launch_fleet(FleetSpec(
+        procs=procs, vdevs=vdevs, program=program, workdir=workdir,
+        deadline_seconds=deadline, wedge_after=max(120.0, deadline / 3)))
+    for rank in sorted(report["ranks"]):
+        info = report["ranks"][rank]
+        line = (f"  rank{rank}: {info['status']}  rc={info['rc']}  "
+                f"heartbeats={info['heartbeats']}")
+        if info.get("salvage"):
+            line += f"  salvaged_at={info['salvage'].get('killed_at_stage')}"
+        print(line, file=out)
+    result = report.get("result") or {}
+    fleet = result.get("fleet") or {}
+    if not fleet and result.get("dcn_tier"):
+        # counters program: the DCN-honesty facts live at the result's
+        # top level (no serve tier ran, so no fleet block).
+        loc = result.get("localized") or {}
+        print(f"fleet: dcn_tier={result['dcn_tier']}  "
+              f"localized=host{loc.get('host')}:{loc.get('device')} "
+              f"coords={loc.get('coords')}  "
+              f"merged_hosts={result.get('merged_hosts')}  "
+              f"staged_equals_flat={result.get('staged_equals_flat')}",
+              file=out)
+    if fleet:
+        loc = fleet.get("localized") or {}
+        print(f"fleet: global tier={fleet.get('global_tier')}  "
+              f"localized host{loc.get('host')}:{loc.get('device')} "
+              f"coords={loc.get('coords')}", file=out)
+        print(f"  evicted host{fleet.get('evicted_host')} "
+              f"({fleet.get('eviction_action')})  goodput "
+              f"{fleet.get('goodput_pre_rps')} -> "
+              f"{fleet.get('goodput_post_rps')} req/s  mttr "
+              f"{fleet.get('mttr_seconds')}s  incorrect "
+              f"{fleet.get('incorrect_responses')}", file=out)
+    print(_json.dumps({"ok": report["ok"], "procs": procs,
+                       "vdevs": vdevs, "program": program,
+                       "wall_seconds": report["wall_seconds"],
+                       "fleet": fleet or None}), file=out, flush=True)
+    return 0 if report["ok"] else 1
+
+
 def run_telemetry_watch(log_path: str, out=None, interval: float = 0.5,
                         max_seconds=None) -> int:
     """``telemetry --watch``: follow a GROWING fault-event shard.
@@ -2031,6 +2119,8 @@ def main(argv=None) -> int:
         return run_serve_bench_cmd(flags)
     if args and args[0] == "drill":
         return run_drill(flags)
+    if args and args[0] == "fleet":
+        return run_fleet(flags)
     if args and args[0] == "history":
         return run_history(args[1:], flags)
     if args and args[0] == "trend":

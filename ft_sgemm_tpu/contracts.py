@@ -28,6 +28,7 @@ from __future__ import annotations
 # smoke proves it dynamically. Paths are repo-relative.
 STDLIB_ONLY_MODULES = (
     "ft_sgemm_tpu/contracts.py",
+    "ft_sgemm_tpu/fleet/launch.py",
     "ft_sgemm_tpu/lint/core.py",
     "ft_sgemm_tpu/perf/compile_cache.py",
     "ft_sgemm_tpu/perf/ledger.py",
@@ -156,6 +157,25 @@ LADDER_RUNGS = ("element_correct", "panel_recompute", "shard_restore",
 # one of these spellings, and telemetry's
 # ``events.AXIS_LABELS["pool_placement"]`` mirrors this tuple.
 POOL_PLACEMENTS = ("health", "round_robin")
+
+# --- fleet runtime ------------------------------------------------------
+#
+# Interconnect tier of a fleet host slot relative to the dispatching
+# coordinator (``fleet/dispatch.py::HOST_TIERS`` is the runtime spelling
+# — the BLOCK_PHASES mirror discipline; ``events.AXIS_LABELS
+# ["host_tier"]`` mirrors this tuple): "local" = the coordinator's own
+# process (no DCN hop), "dcn" = a remote rank reached over the
+# data-center network. The dispatcher's placement cost multiplies load
+# by the tier's DCN distance, so equal-load ties break toward local.
+HOST_TIERS = ("local", "dcn")
+
+# Placement policies of the cross-host fleet dispatcher
+# (``fleet/dispatch.py::FLEET_PLACEMENTS`` runtime spelling;
+# ``events.AXIS_LABELS["fleet_placement"]`` mirrors): "dcn_cost" scores
+# each host slot by (load+1) * (1 + dcn_distance) / health — the
+# 2112.09017 panel asymmetry as a placement cost term; "round_robin"
+# ignores distance and health (the A/B control).
+FLEET_PLACEMENTS = ("dcn_cost", "round_robin")
 
 # --- kernel-axis declaration sources -----------------------------------
 #

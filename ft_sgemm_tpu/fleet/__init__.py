@@ -1,0 +1,26 @@
+"""Fleet runtime: real multi-process meshes, supervised and served.
+
+- :mod:`ft_sgemm_tpu.fleet.launch` — the stdlib-only launcher/
+  coordinator (spawn N CPU processes, wire ``jax.distributed``,
+  supervise kill-safely, salvage). The jax-free bench supervisor
+  path-loads the file directly; importing it here is equally safe.
+- :mod:`ft_sgemm_tpu.fleet.worker` — the spawned rank program (never
+  imported by the supervisor side).
+- :mod:`ft_sgemm_tpu.fleet.dispatch` — the cross-host serve dispatcher
+  (per-process pools, DCN distance as placement cost, host-granularity
+  eviction).
+"""
+
+from ft_sgemm_tpu.fleet.dispatch import (FLEET_PLACEMENTS, FleetDispatcher,
+                                         HOST_TIERS, HostSlot)
+from ft_sgemm_tpu.fleet.launch import FleetSpec, launch_fleet, pick_port
+
+__all__ = [
+    "FLEET_PLACEMENTS",
+    "FleetDispatcher",
+    "FleetSpec",
+    "HOST_TIERS",
+    "HostSlot",
+    "launch_fleet",
+    "pick_port",
+]

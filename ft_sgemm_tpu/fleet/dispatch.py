@@ -1,0 +1,244 @@
+"""Cross-host serve dispatch: per-process pools behind one placer.
+
+The PR-14/15 serve plane stops at one process: ``serve/pool.py`` places
+batches on the devices ONE process can address, and eviction
+(``resilience/elastic.py``) sheds a chip. A fleet adds a level to both:
+each process runs its own pool (its local vdevs, its own prewarmed
+executables), and this module's :class:`FleetDispatcher` — driven by
+the coordinator rank — places whole requests on those per-process
+pools, with DCN distance as a placement COST TERM rather than a wall:
+the ICI/DCN panel asymmetry of arXiv 2112.09017, applied to serving.
+Placement score per host slot is ``(load + 1) * (1 + dcn_distance) /
+health`` — equal-load ties break toward the coordinator's own process,
+and a remote slot earns traffic exactly when the local one is loaded
+enough to pay the DCN hop.
+
+Eviction here is HOST-granularity (the fleet failure domain is the
+process — its runtime, its NIC, its host memory): repeated device
+blames on one process (``ElasticController.should_evict_host``) evict
+the whole slot — it is removed from placement permanently and its
+queued requests MIGRATE through the ordinary placer, the same
+evicted-not-drained semantics the device-level plane pins, one level
+up.
+
+``HOST_TIERS`` / ``FLEET_PLACEMENTS`` are the runtime spellings of
+``contracts.HOST_TIERS`` / ``contracts.FLEET_PLACEMENTS`` (the lint
+axis-drift pass cross-checks them against ``events.AXIS_LABELS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional, Sequence
+
+# Runtime spellings of contracts.HOST_TIERS / contracts.FLEET_PLACEMENTS
+# (lint axis-drift cross-checks both against events.AXIS_LABELS).
+HOST_TIERS = ("local", "dcn")
+FLEET_PLACEMENTS = ("dcn_cost", "round_robin")
+
+
+@dataclasses.dataclass
+class HostSlot:
+    """One per-process pool the dispatcher can place on.
+
+    ``runner`` executes one request spec on that host's pool and
+    returns the reply dict (for the coordinator's own process a direct
+    call; for remote ranks a TCP round trip — fleet/worker.py wires
+    both). ``dcn_distance`` is the placement cost term: 0.0 for the
+    coordinator's own process, >= 1.0 per DCN hop.
+    """
+
+    host: int
+    runner: Callable[[dict], dict]
+    host_tier: str = "dcn"
+    dcn_distance: float = 1.0
+    workers: int = 2
+
+
+class FleetDispatcher:
+    """Place request specs on host slots; evict whole hosts under load.
+
+    Thread model: ``submit`` (any thread) enqueues on the chosen slot's
+    queue; each slot owns ``workers`` daemon threads draining it. A
+    request found queued on an evicted slot is re-placed through the
+    ordinary scorer instead of executed — queued work MIGRATES, exactly
+    like the device-level pool's eviction. ``on_reply(host, spec,
+    reply)`` runs on the slot worker thread after every completed
+    request — the blame feed (fleet/worker.py inspects replies for
+    detections and calls ``ElasticController.note_device_blame``).
+    """
+
+    def __init__(self, slots: Sequence[HostSlot], *,
+                 placement: str = "dcn_cost", health=None, registry=None,
+                 timeline=None, on_reply=None):
+        if placement not in FLEET_PLACEMENTS:
+            raise ValueError(f"unknown fleet placement {placement!r};"
+                             f" expected one of {FLEET_PLACEMENTS}")
+        self.slots = list(slots)
+        self.placement = placement
+        self.health = health
+        self.registry = registry
+        self.timeline = timeline
+        self.on_reply = on_reply
+        self._lock = threading.Lock()
+        self._queues = {s.host: queue.Queue() for s in self.slots}
+        self._inflight = {s.host: 0 for s in self.slots}
+        self._batches = {s.host: 0 for s in self.slots}
+        self._evicted: set = set()
+        self._rr = 0
+        self._stop = threading.Event()
+        self._threads = []
+        for slot in self.slots:
+            for i in range(max(1, slot.workers)):
+                t = threading.Thread(
+                    target=self._slot_worker, args=(slot,),
+                    name=f"fleet-host{slot.host}-w{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- placement ---------------------------------------------------------
+
+    def _score(self, slot: HostSlot) -> float:
+        with self._lock:
+            load = self._queues[slot.host].qsize() \
+                + self._inflight[slot.host]
+        hscore = 1.0
+        if self.health is not None:
+            hscore = max(float(self.health.score(f"host{slot.host}")),
+                         1e-6)
+        return (load + 1.0) * (1.0 + float(slot.dcn_distance)) / hscore
+
+    def eligible(self) -> list:
+        with self._lock:
+            evicted = set(self._evicted)
+        return [s for s in self.slots if s.host not in evicted]
+
+    def choose(self) -> HostSlot:
+        cands = self.eligible()
+        if not cands:
+            raise RuntimeError("fleet dispatcher: every host is evicted")
+        if self.placement == "round_robin":
+            with self._lock:
+                slot = cands[self._rr % len(cands)]
+                self._rr += 1
+            return slot
+        return min(cands, key=self._score)
+
+    def submit(self, spec: dict) -> Future:
+        slot = self.choose()
+        fut: Future = Future()
+        if self.registry is not None:
+            self.registry.counter("fleet_dispatch_requests",
+                                  host_tier=slot.host_tier).inc()
+        self._queues[slot.host].put((spec, fut))
+        return fut
+
+    # -- slot workers ------------------------------------------------------
+
+    def _slot_worker(self, slot: HostSlot) -> None:
+        q = self._queues[slot.host]
+        while not self._stop.is_set():
+            try:
+                spec, fut = q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            with self._lock:
+                dead = slot.host in self._evicted
+            if dead:
+                # Migrate, never execute: the evicted-host analog of the
+                # pool's queued-batch migration.
+                try:
+                    other = self.choose()
+                    self._queues[other.host].put((spec, fut))
+                except RuntimeError as e:
+                    fut.set_exception(e)
+                continue
+            with self._lock:
+                self._inflight[slot.host] += 1
+            try:
+                reply = slot.runner(spec)
+            except Exception as e:  # noqa: BLE001 — reply path owns errors
+                reply = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                         "host": slot.host}
+            finally:
+                with self._lock:
+                    self._inflight[slot.host] -= 1
+                    self._batches[slot.host] += 1
+            if self.on_reply is not None:
+                try:
+                    self.on_reply(slot.host, spec, reply)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+            fut.set_result(reply)
+
+    # -- host eviction -----------------------------------------------------
+
+    def evict_host(self, host: int, reason: str = "host_blame") -> dict:
+        """Remove one host slot from placement permanently and migrate
+        its queued requests — evicted, NOT drained: the slot never
+        becomes a candidate again, unlike a pool drain (which re-admits
+        on recovery). Returns the eviction facts."""
+        with self._lock:
+            self._evicted.add(int(host))
+        q = self._queues[int(host)]
+        migrated = 0
+        while True:
+            try:
+                spec, fut = q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                other = self.choose()
+                self._queues[other.host].put((spec, fut))
+                migrated += 1
+            except RuntimeError as e:
+                fut.set_exception(e)
+        survivors = len(self.eligible())
+        facts = {"host": int(host), "reason": reason,
+                 "action": "evicted", "migrated": migrated,
+                 "surviving_hosts": survivors, "ts": time.monotonic()}
+        if self.registry is not None:
+            self.registry.counter("fleet_host_evictions").inc()
+            self.registry.gauge("fleet_hosts_eligible").set(survivors)
+        if self.timeline is not None:
+            self.timeline.point("fleet", f"evict_host{host}",
+                                reason=reason, migrated=migrated,
+                                survivors=survivors)
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.record_step_event(
+            "evicted", op="fleet_dispatch",
+            extra={"host": int(host), "host_tier": "dcn",
+                   "reason": reason, "action": "evicted",
+                   "migrated": migrated,
+                   "surviving_hosts": survivors})
+        return facts
+
+    # -- introspection / shutdown -----------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "placement": self.placement,
+                "evicted_hosts": sorted(self._evicted),
+                "per_host": {
+                    s.host: {"host_tier": s.host_tier,
+                             "dcn_distance": s.dcn_distance,
+                             "queued": self._queues[s.host].qsize(),
+                             "inflight": self._inflight[s.host],
+                             "batches": self._batches[s.host]}
+                    for s in self.slots},
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+__all__ = ["FLEET_PLACEMENTS", "FleetDispatcher", "HOST_TIERS",
+           "HostSlot"]

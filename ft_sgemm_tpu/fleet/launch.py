@@ -1,0 +1,317 @@
+"""Fleet launcher/coordinator: real multi-process CPU meshes, kill-safe.
+
+Everything below ``parallel/multihost.py`` is honest about a DCN only
+when there IS one: this module spawns N local CPU processes (each with
+its own ``XLA_FLAGS --xla_force_host_platform_device_count`` vdev set),
+wires ``jax.distributed.initialize`` coordination (address, process_id,
+num_processes) through the ``FT_SGEMM_FLEET_*`` environment, and
+supervises the ranks the way bench.py's monitor supervises its worker:
+per-rank timelines, heartbeat watching, a named degradation — never a
+hang — when a rank wedges, and salvage of whatever each rank completed
+when it dies. ``2 procs x 4 vdevs`` is the CI shape; the same launcher
+runs any local fleet (``cli fleet --procs --vdevs``).
+
+HARD CONSTRAINT — stdlib only, no package-relative imports: the jax-free
+bench supervisor (``bench.py --fleet``) loads this file directly via
+``importlib.util.spec_from_file_location`` (the timeline.py discipline;
+declared in ``contracts.STDLIB_ONLY_MODULES``, proven by
+``scripts/stdlib_smoke.py``). The jax side lives entirely in the
+spawned workers (``fleet/worker.py``); the package timeline module is
+itself stdlib-only and is loaded here BY PATH so this module works both
+imported normally and path-loaded under ``python -S``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+_WORKER_PATH = os.path.join(_PKG_DIR, "worker.py")
+
+
+def _load_timeline():
+    """Path-load telemetry/timeline.py (stdlib-only by contract) so the
+    recorder works identically when this module itself was path-loaded
+    by the jax-free supervisor (a package import would pull jax in)."""
+    path = os.path.join(_PKG_DIR, os.pardir, "telemetry", "timeline.py")
+    spec = importlib.util.spec_from_file_location("_fleet_timeline",
+                                                  os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def pick_port() -> int:
+    """A free TCP port on localhost for the jax.distributed coordinator."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class FleetSpec:
+    """One fleet launch: N local processes x M virtual CPU devices.
+
+    ``program`` names the worker program (fleet/worker.py dispatches on
+    it): "noop" (init + report), "counters" (cross-process staged
+    counters, localization, DCN tiers), "smoke" (counters + the serve/
+    host-eviction acts), "wedge" (a deliberately hung rank — the
+    kill-salvage self-test; never inits jax). ``wedge_after`` is the
+    max heartbeat gap before a live rank is declared wedged and killed
+    (named degradation); ``deadline_seconds`` bounds the whole launch.
+    """
+
+    procs: int = 2
+    vdevs: int = 4
+    program: str = "smoke"
+    workdir: str = "fleet_run"
+    coordinator_port: int = 0
+    deadline_seconds: float = 600.0
+    wedge_after: float = 30.0
+    poll_seconds: float = 0.2
+    python: Optional[str] = None
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    program_args: dict = dataclasses.field(default_factory=dict)
+
+
+class _HeartbeatTail:
+    """Incremental heartbeat reader over one rank's timeline JSONL:
+    byte offsets advance only past complete lines (the LiveAggregator
+    discipline, stdlib-side), so a torn tail from a dying rank is
+    re-read once completed, never half-parsed."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.last_beat: Optional[float] = None
+        self.beats = 0
+
+    def poll(self) -> None:
+        try:
+            with open(self.path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                fh.seek(self.offset)
+                chunk = fh.read()
+        except OSError:
+            return
+        cut = chunk.rfind("\n")
+        if cut < 0:
+            return
+        complete = chunk[:cut + 1]
+        self.offset += len(complete.encode("utf-8", errors="replace"))
+        for line in complete.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "heartbeat":
+                t = rec.get("t")
+                if isinstance(t, (int, float)):
+                    self.last_beat = t
+                    self.beats += 1
+
+
+def _rank_env(spec: FleetSpec, rank: int, port: int,
+              rankdir: str) -> dict:
+    env = dict(os.environ)
+    # REPLACE, never append: the parent may pin its own vdev count
+    # (pytest runs with 8) and the rank must get exactly spec.vdevs.
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={spec.vdevs}")
+    env["JAX_PLATFORMS"] = "cpu"
+    # ``python fleet/worker.py`` puts fleet/ — not the repo root — on
+    # sys.path; the rank imports the package via PYTHONPATH instead.
+    pp = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (_REPO_ROOT if not pp
+                         else _REPO_ROOT + os.pathsep + pp)
+    env.setdefault("FT_SGEMM_COMPILE_CACHE", "0")
+    env.setdefault("FT_SGEMM_TUNER_CACHE",
+                   os.path.join(rankdir, "tuner_cache.json"))
+    env["FT_SGEMM_FLEET_RANK"] = str(rank)
+    env["FT_SGEMM_FLEET_NPROCS"] = str(spec.procs)
+    env["FT_SGEMM_FLEET_COORD"] = f"127.0.0.1:{port}"
+    env["FT_SGEMM_FLEET_VDEVS"] = str(spec.vdevs)
+    env["FT_SGEMM_FLEET_PROGRAM"] = spec.program
+    env["FT_SGEMM_FLEET_DIR"] = rankdir
+    env["FT_SGEMM_FLEET_WORKDIR"] = os.path.dirname(rankdir)
+    env["FT_SGEMM_FLEET_ARGS"] = json.dumps(spec.program_args)
+    env.update(spec.env)
+    return env
+
+
+def _salvage(timeline_mod, timeline_path: str) -> dict:
+    """What a dead rank completed: its timeline's stage values and
+    heartbeat health (the bench supervisor's salvage contract, per
+    rank)."""
+    try:
+        records = timeline_mod.read_timeline(timeline_path)
+    except OSError:
+        return {"heartbeats": 0, "stage_values": {}}
+    summary = timeline_mod.summarize_timeline(records)
+    return {"heartbeats": summary["heartbeats"],
+            "max_heartbeat_gap": summary["max_heartbeat_gap"],
+            "killed_at_stage": summary["killed_at_stage"],
+            "stage_values": summary["stage_values"]}
+
+
+def _terminate(proc, grace: float = 3.0) -> None:
+    if proc.poll() is not None:
+        return
+    try:
+        proc.send_signal(signal.SIGTERM)
+    except OSError:
+        return
+    deadline = time.monotonic() + grace
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if proc.poll() is None:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        proc.wait(timeout=5.0)
+
+
+def launch_fleet(spec: FleetSpec) -> dict:
+    """Spawn, supervise, and collect one fleet. Returns the report::
+
+        {"ok": bool, "procs", "vdevs", "program", "wall_seconds",
+         "coordinator": "127.0.0.1:PORT",
+         "ranks": {rank: {"status": "ok"|"failed"|"wedged"|"deadline",
+                          "rc": int|None, "heartbeats": int,
+                          "result": dict|None, "salvage": dict|None}},
+         "result": <rank 0's result dict>|None}
+
+    Kill-safe by construction: any exit path terminates every still-live
+    rank; a wedged rank (heartbeat gap > ``wedge_after``) is killed by
+    name with a ``kill`` marker in the fleet timeline — the run DEGRADES
+    to a named partial report, it never hangs.
+    """
+    tl_mod = _load_timeline()
+    workdir = os.path.abspath(spec.workdir)
+    os.makedirs(workdir, exist_ok=True)
+    fleet_tl = tl_mod.TimelineRecorder(
+        os.path.join(workdir, "fleet.timeline.jsonl"))
+    port = spec.coordinator_port or pick_port()
+    python = spec.python or sys.executable
+    t0 = time.monotonic()
+
+    procs: Dict[int, subprocess.Popen] = {}
+    tails: Dict[int, _HeartbeatTail] = {}
+    logs = []
+    status: Dict[int, str] = {}
+    spawned_at: Dict[int, float] = {}
+    try:
+        for rank in range(spec.procs):
+            rankdir = os.path.join(workdir, f"rank{rank}")
+            os.makedirs(rankdir, exist_ok=True)
+            log = open(os.path.join(rankdir, "log.txt"), "w",
+                       encoding="utf-8")
+            logs.append(log)
+            procs[rank] = subprocess.Popen(
+                [python, _WORKER_PATH],
+                env=_rank_env(spec, rank, port, rankdir),
+                cwd=_REPO_ROOT, stdout=log, stderr=subprocess.STDOUT)
+            tails[rank] = _HeartbeatTail(
+                os.path.join(rankdir, "timeline.jsonl"))
+            spawned_at[rank] = time.monotonic()
+            fleet_tl.point("fleet", f"spawn:rank{rank}",
+                           pid=procs[rank].pid, program=spec.program)
+
+        deadline = t0 + spec.deadline_seconds
+        live = set(procs)
+        while live:
+            now = time.monotonic()
+            for rank in sorted(live):
+                proc = procs[rank]
+                tails[rank].poll()
+                if proc.poll() is not None:
+                    live.discard(rank)
+                    status[rank] = ("exited" if proc.returncode == 0
+                                    else "failed")
+                    fleet_tl.point("fleet", f"exit:rank{rank}",
+                                   rc=proc.returncode)
+                    continue
+                last = tails[rank].last_beat
+                # Wall-clock basis for the gap: beats carry time.time()
+                # stamps, so compare against time.time(), with the spawn
+                # moment (monotonic) covering the never-beat case.
+                gap = (time.time() - last if last is not None
+                       else now - spawned_at[rank])
+                if gap > spec.wedge_after:
+                    status[rank] = "wedged"
+                    fleet_tl.point(
+                        "kill", f"rank{rank}:wedged",
+                        heartbeat_gap=round(gap, 3),
+                        beats=tails[rank].beats)
+                    _terminate(proc)
+                    live.discard(rank)
+            if live and now > deadline:
+                for rank in sorted(live):
+                    status[rank] = "deadline"
+                    fleet_tl.point("kill", f"rank{rank}:deadline",
+                                   deadline_seconds=spec.deadline_seconds)
+                    _terminate(procs[rank])
+                live.clear()
+            if live:
+                time.sleep(spec.poll_seconds)
+    finally:
+        for proc in procs.values():
+            _terminate(proc)
+        for log in logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+
+    ranks = {}
+    for rank in range(spec.procs):
+        rankdir = os.path.join(workdir, f"rank{rank}")
+        tails[rank].poll()
+        result = None
+        rpath = os.path.join(rankdir, "result.json")
+        try:
+            with open(rpath, "r", encoding="utf-8") as fh:
+                result = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            result = None
+        st = status.get(rank, "failed")
+        if st == "exited":
+            st = "ok" if (result is not None
+                          and result.get("ok", False)) else "failed"
+        salvage = None
+        if result is None:
+            salvage = _salvage(tl_mod,
+                               os.path.join(rankdir, "timeline.jsonl"))
+        ranks[rank] = {"status": st,
+                       "rc": procs[rank].returncode,
+                       "heartbeats": tails[rank].beats,
+                       "result": result, "salvage": salvage}
+    report = {
+        "ok": all(r["status"] == "ok" for r in ranks.values()),
+        "procs": spec.procs, "vdevs": spec.vdevs,
+        "program": spec.program,
+        "coordinator": f"127.0.0.1:{port}",
+        "wall_seconds": round(time.monotonic() - t0, 3),
+        "ranks": ranks,
+        "result": ranks.get(0, {}).get("result"),
+    }
+    fleet_tl.point("fleet", "collected",
+                   ok=report["ok"],
+                   statuses={r: ranks[r]["status"] for r in ranks})
+    fleet_tl.close()
+    return report
+
+
+__all__ = ["FleetSpec", "launch_fleet", "pick_port"]

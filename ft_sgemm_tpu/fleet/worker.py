@@ -1,0 +1,794 @@
+"""Fleet rank program: one process of the multi-process mesh.
+
+Spawned by ``fleet/launch.py`` (never imported by the supervisor side)
+with its coordinates in the ``FT_SGEMM_FLEET_*`` environment. Module
+scope imports ONLY the standard library on purpose: the "wedge"
+program — the launcher's kill-salvage self-test — must hang without
+ever touching jax, so jax and the package load lazily inside the
+programs that need them.
+
+Programs (``FT_SGEMM_FLEET_PROGRAM``):
+
+- ``wedge``    — write a couple of heartbeats, then stop beating and
+  sleep: a deliberately wedged rank the supervisor must detect by
+  heartbeat gap, kill by name, and salvage.
+- ``noop``     — bring up ``jax.distributed`` (gloo CPU collectives),
+  report the global device view, exit: the spawn/collect path.
+- ``counters`` — the DCN-honesty phases every rank runs SPMD on the
+  real 2-proc mesh: staged-vs-flat counter equality across the process
+  boundary, cross-process ``inject_coords`` localization into per-rank
+  event shards, and the fleet checksum tiers with an in-flight DCN
+  corruption detected at — only at — the global tier.
+- ``smoke``    — ``counters`` plus the serve acts: per-process pools
+  behind the coordinator's :class:`~ft_sgemm_tpu.fleet.dispatch.
+  FleetDispatcher` (DCN distance as placement cost), host-granularity
+  blame on injected faults from the non-coordinator rank, whole-HOST
+  eviction under load, reshard onto the survivor process, and goodput
+  recovery — the ``bench.py --fleet --smoke`` acceptance run.
+
+Every rank heartbeats its own timeline (the supervisor's liveness
+feed), spans each phase (the salvage payload), streams telemetry events
+to per-rank JSONL shards, and writes ``result.json`` atomically at the
+end. Rank 0 is the coordinator: it additionally tails + merges every
+rank's shards live (``telemetry.aggregate.LiveAggregator``) so the
+merged fleet view — and the ``DeviceHealthTracker`` behind
+``/metrics`` / ``cli top`` — covers devices it cannot address.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _env(name: str, default=None):
+    return os.environ.get(f"FT_SGEMM_FLEET_{name}", default)
+
+
+def _load_timeline():
+    path = os.path.abspath(
+        os.path.join(_HERE, os.pardir, "telemetry", "timeline.py"))
+    spec = importlib.util.spec_from_file_location("_worker_timeline", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Ctx:
+    """One rank's coordinates + recorders (threaded: the heartbeat
+    thread and the serve handler threads all write through here; the
+    timeline recorder is internally locked, the rest is read-only after
+    construction)."""
+
+    def __init__(self):
+        self.rank = int(_env("RANK", "0"))
+        self.nprocs = int(_env("NPROCS", "1"))
+        self.coord = _env("COORD", "127.0.0.1:12321")
+        self.vdevs = int(_env("VDEVS", "4"))
+        self.program = _env("PROGRAM", "noop")
+        self.rankdir = _env("DIR", ".")
+        self.workdir = _env("WORKDIR", os.path.dirname(self.rankdir) or ".")
+        try:
+            self.args = json.loads(_env("ARGS", "{}") or "{}")
+        except json.JSONDecodeError:
+            self.args = {}
+        tl_mod = _load_timeline()
+        self.tl = tl_mod.TimelineRecorder(
+            os.path.join(self.rankdir, "timeline.jsonl"))
+        self._beat_stop = threading.Event()
+        self._beat_thread = None
+
+    def start_heartbeat(self, period: float = 0.5) -> None:
+        def beat():
+            while not self._beat_stop.wait(period):
+                self.tl.point("heartbeat", f"rank{self.rank}")
+
+        self._beat_thread = threading.Thread(target=beat, daemon=True,
+                                             name="fleet-heartbeat")
+        self._beat_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._beat_stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+
+    def write_result(self, result: dict) -> None:
+        path = os.path.join(self.rankdir, "result.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Programs
+# ---------------------------------------------------------------------------
+
+
+def run_wedge(ctx: _Ctx) -> int:
+    """Beat twice, then wedge: alive but silent — the supervisor must
+    kill this rank by heartbeat gap and salvage the finished span."""
+    with ctx.tl.span("wedge_warmup", kind="stage") as info:
+        ctx.tl.point("heartbeat", f"rank{ctx.rank}")
+        time.sleep(0.1)
+        ctx.tl.point("heartbeat", f"rank{ctx.rank}")
+        info["value"] = {"beats": 2}
+    time.sleep(float(ctx.args.get("wedge_sleep", 3600.0)))
+    return 0
+
+
+def _init_distributed(ctx: _Ctx):
+    """Bring up jax with this rank's coordinates: gloo CPU collectives
+    must be selected BEFORE ``jax.distributed.initialize``."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if ctx.nprocs > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        from ft_sgemm_tpu.parallel.multihost import initialize
+
+        initialize(coordinator_address=ctx.coord,
+                   num_processes=ctx.nprocs, process_id=ctx.rank)
+    return jax
+
+
+def run_noop(ctx: _Ctx) -> int:
+    with ctx.tl.span("distributed_init", kind="stage") as info:
+        jax = _init_distributed(ctx)
+        info["value"] = {"process_count": jax.process_count()}
+    ctx.write_result({
+        "ok": jax.process_count() == ctx.nprocs
+        and len(jax.local_devices()) == ctx.vdevs,
+        "rank": ctx.rank,
+        "process_count": jax.process_count(),
+        "device_count": len(jax.devices()),
+        "local_devices": [str(d) for d in jax.local_devices()],
+    })
+    return 0
+
+
+def _verify_local_shards(c_global, want_np) -> int:
+    """Verify the LOCAL shards of a multi-process global array against
+    the full numpy oracle (fetching the whole array would touch
+    non-addressable devices); returns the bad-element count."""
+    import numpy as np
+
+    from ft_sgemm_tpu.utils import verify_matrix
+
+    bad = 0
+    for shard in c_global.addressable_shards:
+        got = np.asarray(shard.data)
+        ok, nbad, _ = verify_matrix(want_np[shard.index], got,
+                                    verbose=False)
+        bad += 0 if ok else nbad
+    return bad
+
+
+def _counters_phases(ctx: _Ctx, jax) -> dict:
+    """The SPMD DCN-honesty phases (every rank runs these in lockstep).
+
+    Returns the facts dict; raises AssertionError on any pinned
+    property failing — the rank's result then reports ok=False.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ft_sgemm_tpu import sgemm_reference, telemetry
+    from ft_sgemm_tpu.configs import KernelShape
+    from ft_sgemm_tpu.injection import InjectionSpec
+    from ft_sgemm_tpu.parallel import (hierarchical_psum,
+                                       make_multihost_mesh,
+                                       multihost_ft_sgemm)
+    from ft_sgemm_tpu.parallel.sharded import shard_map
+    from ft_sgemm_tpu.resilience import fleet_tiered_ft_sgemm
+    from ft_sgemm_tpu.resilience.tiers import checksum_tolerance
+    from ft_sgemm_tpu.utils import generate_random_matrix
+    from jax.sharding import PartitionSpec as P
+
+    facts: dict = {}
+    tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+
+    with ctx.tl.span("mesh", kind="stage") as info:
+        mesh = make_multihost_mesh(hosts=ctx.nprocs)
+        # The satellite-1 pin, on the REAL process boundary: a multiple
+        # of process_count subdivides each process's devices into
+        # contiguous slots.
+        mesh_multi = make_multihost_mesh(hosts=2 * ctx.nprocs)
+        facts["mesh"] = dict(mesh.shape)
+        facts["mesh_multiple"] = dict(mesh_multi.shape)
+        info["value"] = facts["mesh"]
+
+    h, mx, my = (mesh.shape["host"], mesh.shape["x"], mesh.shape["y"])
+    ndev = h * mx * my
+
+    with ctx.tl.span("staged_vs_flat", kind="stage") as info:
+        # Integer counters staged one axis at a time vs the flat psum,
+        # across a REAL process boundary: must agree EXACTLY.
+        def count_step(v):
+            idx = (jax.lax.axis_index("host") * 100
+                   + jax.lax.axis_index("x") * 10
+                   + jax.lax.axis_index("y"))
+            mine = v[0, 0] + idx.astype(jnp.int32)
+            staged = hierarchical_psum(mine, ("y", "x", "host"))
+            flat = jax.lax.psum(mine, ("host", "x", "y"))
+            return (staged.reshape(1, 1), flat.reshape(1, 1))
+
+        fn = shard_map(count_step, mesh=mesh,
+                       in_specs=(P(("host", "x"), "y"),),
+                       out_specs=(P(None, None), P(None, None)))
+        seed = jnp.ones((h * mx, my), jnp.int32)
+        staged, flat = jax.jit(fn)(seed)
+        facts["staged"] = int(staged[0, 0])
+        facts["flat"] = int(flat[0, 0])
+        facts["staged_equals_flat"] = facts["staged"] == facts["flat"]
+        assert facts["staged_equals_flat"], (facts["staged"],
+                                             facts["flat"])
+        info["value"] = {"staged": facts["staged"], "flat": facts["flat"]}
+
+    m, n, k = 512, 128, 256
+    rng = np.random.default_rng(int(ctx.args.get("seed", 7)))
+    a = generate_random_matrix(m, k, rng=rng)
+    b = generate_random_matrix(n, k, rng=rng)
+    c = generate_random_matrix(m, n, rng=rng)
+    want = None
+
+    with telemetry.session(os.path.join(ctx.rankdir, "events.jsonl")):
+        with ctx.tl.span("multihost_inject_all", kind="stage") as info:
+            inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+            res = multihost_ft_sgemm(a, b, c, mesh, tile, alpha=1.0,
+                                     beta=-1.5, inject=inj,
+                                     threshold="adaptive")
+            want = np.asarray(sgemm_reference(a, b, c, 1.0, -1.5))
+            bad = _verify_local_shards(res.c, want)
+            det = int(res.num_detected)
+            facts["inject_all_detections"] = det
+            facts["inject_all_bad_elements"] = bad
+            assert bad == 0 and det == ndev, (bad, det, ndev)
+            info["value"] = {"detections": det}
+
+    # Localization gets its OWN shard so the cross-process attribution
+    # assert reads an unambiguous stream.
+    target = tuple(ctx.args.get("inject_coords", (h - 1, 0, 0)))
+    with telemetry.session(
+            os.path.join(ctx.rankdir, "events_localize.jsonl")):
+        with ctx.tl.span("localize", kind="stage") as info:
+            inj = InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+            res = multihost_ft_sgemm(a, b, c, mesh, tile, alpha=1.0,
+                                     beta=-1.5, inject=inj,
+                                     inject_coords=target)
+            bad = _verify_local_shards(res.c, want)
+            det = int(res.num_detected)
+            facts["localize_target"] = list(target)
+            facts["localize_detections"] = det
+            assert bad == 0 and det == 1, (bad, det)
+            info["value"] = {"detections": det, "target": list(target)}
+
+    with telemetry.session(
+            os.path.join(ctx.rankdir, "events_tiers.jsonl")) as registry:
+        with ctx.tl.span("dcn_tiers", kind="stage") as info:
+            amax = float(np.abs(a).max())
+            bmax = float(np.abs(b).max())
+            tol0 = checksum_tolerance(m // (h * mx), k // my, amax, bmax)
+            # In-flight corruption of the DCN hop, struck on the
+            # non-coordinator host: every pre-DCN stage is clean, so
+            # tier-of-detection MUST be "global".
+            res, report = fleet_tiered_ft_sgemm(
+                a, b, c, mesh, tile, alpha=1.0, beta=-1.5,
+                dcn_corrupt=(((h - 1, 0, 0), 3, 50.0 * tol0),),
+                registry=registry)
+            facts["dcn_tier"] = report.tier
+            facts["dcn_residuals"] = {
+                t: float(v) for t, v in report.residuals.items()}
+            assert report.detected and report.tier == "global", report
+            info["value"] = {"tier": report.tier}
+
+    if ctx.rank == 0:
+        with ctx.tl.span("merged_view", kind="stage") as info:
+            from ft_sgemm_tpu.telemetry.aggregate import LiveAggregator
+            from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
+
+            agg = LiveAggregator()
+            for r in range(ctx.nprocs):
+                agg.add_shard(os.path.join(ctx.workdir, f"rank{r}",
+                                           "events.jsonl"), host=r)
+            # Ranks finish their phases at different moments; wait for
+            # every rank's inject-all attribution to land.
+            deadline = time.monotonic() + 120.0
+            view = None
+            while time.monotonic() < deadline:
+                agg.poll()
+                view = agg.fleet_view()
+                if len(view["hosts"]) >= ctx.nprocs and \
+                        len(view["devices"]) >= ndev:
+                    break
+                time.sleep(0.2)
+            hosts = sorted(kk for kk in view["hosts"] if kk is not None)
+            facts["merged_hosts"] = hosts
+            facts["merged_devices"] = len(view["devices"])
+            assert hosts == list(range(ctx.nprocs)), view["hosts"]
+
+            # The cross-process localization, read from the MERGED view
+            # of the localize shards: exactly one faulty device, on the
+            # host inject_coords named, with its mesh coordinates.
+            loc = LiveAggregator()
+            for r in range(ctx.nprocs):
+                loc.add_shard(os.path.join(ctx.workdir, f"rank{r}",
+                                           "events_localize.jsonl"),
+                              host=r)
+            deadline = time.monotonic() + 120.0
+            faulty = []
+            while time.monotonic() < deadline:
+                loc.poll()
+                # Only rows with mesh coordinates are per-DEVICE
+                # attributions; a clean rank's call event still carries
+                # the global psum'd count as a synthetic mesh-label row.
+                faulty = [((hh, dd), row) for (hh, dd), row
+                          in loc.device_table()["devices"].items()
+                          if row["detected"] > 0
+                          and row.get("coords") is not None]
+                if faulty:
+                    break
+                time.sleep(0.2)
+            assert len(faulty) == 1, faulty
+            (fh, fdev), frow = faulty[0]
+            facts["localized"] = {"host": fh, "device": fdev,
+                                  "coords": frow["coords"],
+                                  "detected": frow["detected"]}
+            assert fh == target[0], (fh, target)
+            assert frow["coords"] == list(target), (frow, target)
+
+            # The live merge feeds device_health for non-addressable
+            # ranks: every faulty fleet device gets a tracked label.
+            tracker = DeviceHealthTracker()
+            agg.feed_health(tracker)
+            covered = sorted(tracker.rows())
+            facts["health_labels"] = covered
+            assert any(lbl.startswith(f"host{ctx.nprocs - 1}:")
+                       for lbl in covered), covered
+            info["value"] = {"hosts": hosts,
+                             "devices": facts["merged_devices"],
+                             "localized": facts["localized"]}
+    return facts
+
+
+def run_counters(ctx: _Ctx) -> int:
+    jax = _init_distributed(ctx)
+    facts = _counters_phases(ctx, jax)
+    facts.update({"ok": True, "rank": ctx.rank,
+                  "process_count": jax.process_count()})
+    ctx.write_result(facts)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# The serve tier (smoke program)
+# ---------------------------------------------------------------------------
+
+
+class _PoolExecutor:
+    """One rank's per-process pool: the local vdevs behind the
+    device-level placement machinery, executing deterministic request
+    specs (seed -> matrices) through the fused-ABFT kernel, verified
+    against the numpy oracle before the reply leaves the rank."""
+
+    def __init__(self, ctx: _Ctx, *, devices=None, bucket: int = 128):
+        import jax
+
+        from ft_sgemm_tpu.configs import KernelShape
+        from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+        from ft_sgemm_tpu.serve.pool import DevicePool
+        from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
+
+        self.ctx = ctx
+        self.bucket = int(bucket)
+        devs = list(devices if devices is not None
+                    else jax.local_devices()[:2])
+        self.health = DeviceHealthTracker()
+        self.pool = DevicePool(devs, health=self.health)
+        tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+        self._fn = make_ft_sgemm(tile, alpha=1.0, beta=0.0)
+        self._lock = threading.Lock()
+        self._compiled: dict = {}
+        self._served = 0
+        self._served_detections = 0
+
+    def _get_compiled(self, index: int, injected: bool):
+        import jax
+
+        from ft_sgemm_tpu.injection import InjectionSpec
+
+        key = (index, injected)
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        spec = (InjectionSpec(enabled=True, every=1, magnitude=10000.0)
+                if injected else None)
+        fn = jax.jit(lambda a, b, c: self._fn(a, b, c, inject=spec))
+        with self._lock:
+            self._compiled[key] = fn
+        return fn
+
+    def run(self, spec: dict) -> dict:
+        import jax
+        import numpy as np
+
+        from ft_sgemm_tpu.utils import verify_matrix
+
+        rng = np.random.default_rng(int(spec.get("seed", 0)))
+        nn = self.bucket
+        a = rng.standard_normal((nn, nn), dtype=np.float32)
+        b = rng.standard_normal((nn, nn), dtype=np.float32)
+        c = np.zeros((nn, nn), np.float32)
+        injected = bool(spec.get("inject")) or (
+            spec.get("inject_host") is not None
+            and int(spec["inject_host"]) == self.ctx.rank)
+        index = self.pool.choose()
+        device = self.pool.devices[index]
+        fn = self._get_compiled(index, injected)
+        aj = jax.device_put(a, device)
+        bj = jax.device_put(b, device)
+        cj = jax.device_put(c, device)
+        t0 = time.monotonic()
+        res = fn(aj, bj, cj)
+        got = np.asarray(res.c)
+        det = int(res.num_detected)
+        unc = int(res.num_uncorrectable)
+        want = (a.astype(np.float64) @ b.astype(np.float64).T).astype(
+            np.float32)
+        ok_v, _, _ = verify_matrix(want, got, verbose=False)
+        self.pool.note_batch(index, 1)
+        self.health.observe(self.pool.labels[index], calls=1,
+                            detected=det, uncorrectable=unc)
+        with self._lock:
+            self._served += 1
+            self._served_detections += det
+        return {"ok": bool(ok_v and unc == 0), "correct": bool(ok_v),
+                "detections": det, "uncorrectable": unc,
+                "host": self.ctx.rank,
+                "device": self.pool.labels[index],
+                "seconds": round(time.monotonic() - t0, 6)}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"served": self._served,
+                    "detections": self._served_detections}
+
+
+def _serve_remote(ctx: _Ctx, executor: _PoolExecutor) -> dict:
+    """Non-coordinator serve loop: a JSON-lines TCP server over the
+    rank's pool; runs until the coordinator sends ``{"op": "stop"}``."""
+    stop = threading.Event()
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                spec = json.loads(line.decode("utf-8"))
+            except json.JSONDecodeError:
+                return
+            if spec.get("op") == "stop":
+                reply = {"ok": True, "op": "stop"}
+                stop.set()
+            else:
+                reply = executor.run(spec)
+            self.wfile.write((json.dumps(reply) + "\n").encode("utf-8"))
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Handler)
+    port = srv.server_address[1]
+    with open(os.path.join(ctx.rankdir, "serve.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump({"port": port, "rank": ctx.rank}, fh)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="fleet-serve")
+    t.start()
+    ctx.tl.point("serve", f"rank{ctx.rank}:listening", port=port)
+    deadline = time.monotonic() + float(ctx.args.get(
+        "serve_deadline", 420.0))
+    while not stop.is_set() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    srv.shutdown()
+    srv.server_close()
+    return {"port": port, "stopped": stop.is_set(), **executor.stats()}
+
+
+def _remote_runner(port: int):
+    def run(spec: dict) -> dict:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=120.0) as conn:
+            conn.sendall((json.dumps(spec) + "\n").encode("utf-8"))
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        return json.loads(buf.decode("utf-8"))
+
+    return run
+
+
+def _drive(dispatcher, n_requests: int, seed0: int,
+           inject_host=None, timeout: float = 240.0) -> dict:
+    """Burst-submit ``n_requests`` specs, wait for every reply, return
+    the phase stats (the drill's _drive_phase shape, fleet-side)."""
+    t0 = time.monotonic()
+    futs = [dispatcher.submit({"seed": seed0 + i,
+                               "inject_host": inject_host})
+            for i in range(n_requests)]
+    first_ok = None
+    correct = incorrect = 0
+    by_host: dict = {}
+    for fut in futs:
+        reply = fut.result(timeout=timeout)
+        hh = reply.get("host")
+        by_host[hh] = by_host.get(hh, 0) + 1
+        if reply.get("ok") and reply.get("correct"):
+            correct += 1
+            if first_ok is None:
+                first_ok = time.monotonic()
+        else:
+            incorrect += 1
+    wall = time.monotonic() - t0
+    return {"submitted": n_requests, "correct": correct,
+            "incorrect": incorrect, "by_host": by_host,
+            "wall_seconds": round(wall, 3),
+            "first_correct_ts": first_ok,
+            "goodput_rps": round(correct / wall, 3) if wall > 0 else None}
+
+
+def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
+    """Rank 0's serve acts: dispatch across per-process pools, blame
+    the faulty host, evict it under load, reshard onto the survivor
+    process, and measure goodput recovery."""
+    import numpy as np
+
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.fleet.dispatch import FleetDispatcher, HostSlot
+    from ft_sgemm_tpu.resilience import (ElasticController,
+                                         EvictionPolicy, surviving_mesh)
+    from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
+
+    facts: dict = {}
+    n_req = int(ctx.args.get("requests", 24))
+    faulty_host = ctx.nprocs - 1
+
+    with ctx.tl.span("serve_wire", kind="stage") as info:
+        slots = [HostSlot(host=0, runner=executor.run,
+                          host_tier="local", dcn_distance=0.0)]
+        ports = {}
+        deadline = time.monotonic() + 180.0
+        for r in range(1, ctx.nprocs):
+            path = os.path.join(ctx.workdir, f"rank{r}", "serve.json")
+            while time.monotonic() < deadline:
+                try:
+                    with open(path, "r", encoding="utf-8") as fh:
+                        ports[r] = json.load(fh)["port"]
+                    break
+                except (OSError, json.JSONDecodeError, KeyError):
+                    time.sleep(0.1)
+            if r not in ports:
+                raise TimeoutError(f"rank{r} never published its port")
+            slots.append(HostSlot(host=r, runner=_remote_runner(ports[r]),
+                                  host_tier="dcn", dcn_distance=1.0))
+        info["value"] = {"ports": ports}
+
+    fleet_health = DeviceHealthTracker()
+    policy = EvictionPolicy(host_blame_limit=int(
+        ctx.args.get("host_blame_limit", 3)))
+    controller = ElasticController(policy, timeline=ctx.tl)
+    registry = telemetry.get_registry()
+    blamed: dict = {}
+    blame_lock = threading.Lock()
+
+    def on_reply(host, spec, reply):
+        if reply.get("detections", 0) > 0 or not reply.get("ok", False):
+            controller.note_device_blame(host,
+                                         reply.get("device", "unknown"))
+            registry.counter("fleet_device_blames").inc()
+            with blame_lock:
+                blamed[host] = blamed.get(host, 0) + 1
+
+    dispatcher = FleetDispatcher(slots, health=fleet_health,
+                                 registry=registry, timeline=ctx.tl,
+                                 on_reply=on_reply)
+    try:
+        with ctx.tl.span("serve_baseline", kind="stage") as info:
+            base = _drive(dispatcher, n_req, seed0=1000)
+            facts["baseline"] = base
+            assert base["incorrect"] == 0, base
+            assert len(base["by_host"]) == ctx.nprocs, base["by_host"]
+            info["value"] = {"goodput_rps": base["goodput_rps"],
+                             "by_host": base["by_host"]}
+
+        with ctx.tl.span("serve_fault", kind="stage") as info:
+            controller.mark_fault()
+            # Injected (ABFT-corrected: still zero incorrect results)
+            # faults on the non-coordinator host; its replies carry
+            # detections, the blame feed accumulates on that host.
+            rounds = 0
+            decision = None
+            while decision is None and rounds < 6:
+                fault = _drive(dispatcher, max(6, n_req // 3),
+                               seed0=5000 + 100 * rounds,
+                               inject_host=faulty_host)
+                facts["fault"] = fault
+                assert fault["incorrect"] == 0, fault
+                rounds += 1
+                decision = controller.should_evict_host(
+                    total_hosts=ctx.nprocs,
+                    evicted_hosts=dispatcher.stats()["evicted_hosts"])
+            assert decision is not None, (
+                "blame never crossed the host_blame_limit",
+                controller.host_blames(faulty_host))
+            facts["eviction_decision"] = {"host": decision[0],
+                                          "reason": decision[1]}
+            facts["host_blames"] = controller.host_blames(faulty_host)
+            assert decision[0] == faulty_host, decision
+            info["value"] = facts["eviction_decision"]
+
+        with ctx.tl.span("host_evict", kind="stage") as info:
+            ev = dispatcher.evict_host(decision[0], reason=decision[1])
+            controller.record_host_eviction(ev)
+            facts["eviction"] = {kk: vv for kk, vv in ev.items()
+                                 if kk != "ts"}
+            assert ev["action"] == "evicted", ev
+            info["value"] = facts["eviction"]
+
+        with ctx.tl.span("host_reshard", kind="stage") as info:
+            # Reshard the mesh paths onto the SURVIVOR processes: every
+            # remaining device is addressable to them, so the rebuilt
+            # mesh is immediately usable without the dead rank.
+            t0 = time.monotonic()
+            mesh2 = surviving_mesh(devices=list(jax.devices()),
+                                   exclude_hosts=(decision[0],))
+            survivors = [d for d in mesh2.devices.flat]
+            assert all(d.process_index != decision[0]
+                       for d in survivors), mesh2
+            from ft_sgemm_tpu.configs import KernelShape
+            from ft_sgemm_tpu.parallel import sharded_ft_sgemm
+            from ft_sgemm_tpu.utils import (generate_random_matrix,
+                                            verify_matrix)
+
+            tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+            rng = np.random.default_rng(11)
+            msz = 128 * mesh2.shape["x"]
+            ksz = 128 * mesh2.shape["y"]
+            aa = generate_random_matrix(msz, ksz, rng=rng)
+            bb = generate_random_matrix(128, ksz, rng=rng)
+            cc = np.zeros((msz, 128), np.float32)
+            res = sharded_ft_sgemm(aa, bb, cc, mesh2, tile, alpha=1.0,
+                                   beta=0.0)
+            want = (aa.astype(np.float64) @ bb.astype(np.float64).T
+                    ).astype(np.float32)
+            ok_v, _, _ = verify_matrix(want, np.asarray(res.c),
+                                       verbose=False)
+            facts["reshard"] = {
+                "devices": len(survivors),
+                "mesh": dict(mesh2.shape),
+                "seconds": round(time.monotonic() - t0, 3),
+                "ok": bool(ok_v)}
+            assert ok_v
+            info["value"] = facts["reshard"]
+
+        with ctx.tl.span("serve_recovery", kind="stage") as info:
+            rec = _drive(dispatcher, n_req, seed0=9000)
+            facts["recovery"] = rec
+            assert rec["incorrect"] == 0, rec
+            assert list(rec["by_host"]) == [0], rec["by_host"]
+            mttr = (controller.mttr_seconds(rec["first_correct_ts"])
+                    if rec["first_correct_ts"] else None)
+            ratio = (round(rec["goodput_rps"] / base["goodput_rps"], 3)
+                     if base["goodput_rps"] else None)
+            facts["goodput_recovery_ratio"] = ratio
+            facts["mttr_seconds"] = (round(mttr, 3)
+                                     if mttr is not None else None)
+            assert ratio is not None and ratio >= 0.7, ratio
+            info["value"] = {"goodput_rps": rec["goodput_rps"],
+                             "ratio": ratio}
+    finally:
+        for slot in slots[1:]:
+            try:
+                slot.runner({"op": "stop"})
+            except OSError:
+                pass
+        dispatcher.stop()
+    facts["dispatcher"] = dispatcher.stats()
+    return facts
+
+
+def run_smoke(ctx: _Ctx) -> int:
+    jax = _init_distributed(ctx)
+    facts = _counters_phases(ctx, jax)
+    with ctx.tl.span("serve_pool", kind="stage") as info:
+        executor = _PoolExecutor(ctx)
+        info["value"] = {"devices": list(executor.pool.labels)}
+    from ft_sgemm_tpu import telemetry
+
+    with telemetry.session(os.path.join(ctx.rankdir,
+                                        "events_serve.jsonl")):
+        if ctx.rank == 0:
+            serve = _serve_coordinator(ctx, executor, jax)
+        else:
+            serve = _serve_remote(ctx, executor)
+    result = {"ok": True, "rank": ctx.rank,
+              "process_count": jax.process_count(), **facts,
+              "serve": serve}
+    if ctx.rank == 0:
+        result["fleet"] = _fleet_facts(ctx, facts, serve)
+    ctx.write_result(result)
+    return 0
+
+
+def _fleet_facts(ctx: _Ctx, facts: dict, serve: dict) -> dict:
+    """The artifact context block bench.py --fleet ingests as fleet.*
+    ledger measurements."""
+    base = serve.get("baseline", {})
+    rec = serve.get("recovery", {})
+    return {
+        "processes": ctx.nprocs,
+        "vdevs_per_process": ctx.vdevs,
+        "staged_equals_flat": facts.get("staged_equals_flat"),
+        "global_tier": facts.get("dcn_tier"),
+        "global_tier_detections": int(facts.get("dcn_tier") == "global"),
+        "localized": facts.get("localized"),
+        "merged_hosts": facts.get("merged_hosts"),
+        "goodput_pre_rps": base.get("goodput_rps"),
+        "goodput_post_rps": rec.get("goodput_rps"),
+        "goodput_recovery_ratio": serve.get("goodput_recovery_ratio"),
+        "mttr_seconds": serve.get("mttr_seconds"),
+        "incorrect_responses": (base.get("incorrect", 0)
+                                + serve.get("fault", {}).get(
+                                    "incorrect", 0)
+                                + rec.get("incorrect", 0)),
+        "evicted_host": serve.get("eviction", {}).get("host"),
+        "eviction_action": serve.get("eviction", {}).get("action"),
+        "host_blames": serve.get("host_blames"),
+        "reshard": serve.get("reshard"),
+    }
+
+
+PROGRAMS = {"wedge": run_wedge, "noop": run_noop,
+            "counters": run_counters, "smoke": run_smoke}
+
+
+def main() -> int:
+    ctx = _Ctx()
+    program = PROGRAMS.get(ctx.program)
+    if program is None:
+        ctx.write_result({"ok": False, "rank": ctx.rank,
+                          "error": f"unknown program {ctx.program!r}"})
+        return 2
+    if ctx.program != "wedge":
+        ctx.start_heartbeat()
+    try:
+        with ctx.tl.span(f"program:{ctx.program}", kind="stage"):
+            return program(ctx)
+    except BaseException as e:  # noqa: BLE001 — the rank's last words
+        ctx.write_result({"ok": False, "rank": ctx.rank,
+                          "error": f"{type(e).__name__}: {e}"})
+        return 1
+    finally:
+        ctx.stop_heartbeat()
+        ctx.tl.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

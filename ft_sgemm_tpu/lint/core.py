@@ -85,7 +85,9 @@ THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
                     "ft_sgemm_tpu/serve/kv_cache.py",
                     "ft_sgemm_tpu/serve/pool.py",
                     "ft_sgemm_tpu/resilience/elastic.py",
-                    "ft_sgemm_tpu/telemetry/monitor.py")
+                    "ft_sgemm_tpu/telemetry/monitor.py",
+                    "ft_sgemm_tpu/fleet/dispatch.py",
+                    "ft_sgemm_tpu/fleet/worker.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -281,6 +283,9 @@ class Declarations:
         self.pool_placements = tuple(contracts.get("POOL_PLACEMENTS", ()))
         self.recovery_tiers = tuple(contracts.get("RECOVERY_TIERS", ()))
         self.ladder_rungs = tuple(contracts.get("LADDER_RUNGS", ()))
+        self.host_tiers = tuple(contracts.get("HOST_TIERS", ()))
+        self.fleet_placements = tuple(
+            contracts.get("FLEET_PLACEMENTS", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -503,6 +508,8 @@ AXIS_VAR_SETS = {
     "pool_placement": "pool_placements",
     "recovery_tier": "recovery_tiers",
     "ladder_rung": "ladder_rungs",
+    "host_tier": "host_tiers",
+    "fleet_placement": "fleet_placements",
 }
 
 
@@ -747,6 +754,12 @@ def check_axis_drift(repo: Repo, decls: Declarations):
         mirror["recovery_tier"] = decls.recovery_tiers
     if decls.ladder_rungs:
         mirror["ladder_rung"] = decls.ladder_rungs
+    # The fleet axes (PR 16): host-tier placement + fleet placement
+    # policy, contracts-direct like the serve/recovery planes.
+    if decls.host_tiers:
+        mirror["host_tier"] = decls.host_tiers
+    if decls.fleet_placements:
+        mirror["fleet_placement"] = decls.fleet_placements
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -815,7 +828,9 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                              "ring_overlap", ())) | {"auto"},
                      "pool_placement": set(decls.pool_placements),
                      "recovery_tier": set(decls.recovery_tiers),
-                     "ladder_rung": set(decls.ladder_rungs)}
+                     "ladder_rung": set(decls.ladder_rungs),
+                     "host_tier": set(decls.host_tiers),
+                     "fleet_placement": set(decls.fleet_placements)}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

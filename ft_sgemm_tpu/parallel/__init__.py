@@ -6,6 +6,7 @@ from ft_sgemm_tpu.parallel.multihost import (
     make_multihost_ring_mesh,
     multihost_ft_sgemm,
 )
+from ft_sgemm_tpu.parallel.reduce import hierarchical_psum
 from ft_sgemm_tpu.parallel.ring import (
     make_ring_mesh,
     ring_ft_sgemm,
@@ -20,6 +21,7 @@ from ft_sgemm_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "hierarchical_psum",
     "initialize",
     "make_mesh",
     "make_multihost_mesh",

@@ -116,17 +116,47 @@ def make_multihost_mesh(
         raise ValueError(
             f"ici_axes {ici_axes} != {per_host} devices per host")
     devs = sorted(devs, key=lambda d: (d.process_index, d.id))
-    arr = np.asarray(devs).reshape(h, x, y)
     if jax.process_count() > 1:
+        slots = _host_slots(devs, h, per_host)
+        arr = np.asarray(slots, dtype=object).reshape(h, x, y)
         for slot in range(h):
             procs = {d.process_index for d in arr[slot].flat}
-            if len(procs) > 1:
-                raise ValueError(
-                    f"host slot {slot} mixes devices from processes"
-                    f" {sorted(procs)}: the y-axis psum would cross DCN."
-                    f" Use hosts=jax.process_count() (or a multiple of it)"
-                    f" so every slot stays within one process.")
+            assert len(procs) == 1, (slot, sorted(procs))
+    else:
+        arr = np.asarray(devs).reshape(h, x, y)
     return Mesh(arr, ("host", "x", "y"))
+
+
+def _host_slots(devs, h, per_host):
+    """Group ``devs`` into ``h`` process-pure slots of ``per_host``.
+
+    Devices are grouped by ``process_index`` and each process's devices
+    are subdivided into contiguous slots (global device ids are NOT
+    contiguous across processes, so a flat reshape of the sorted list
+    can straddle a process boundary whenever per-process counts are
+    uneven — grouping first is the only ordering that is always pure).
+    Valid exactly when every process's device count is a multiple of
+    ``per_host``; ``hosts = jax.process_count()`` and any multiple of it
+    that divides each process's count evenly both work.
+    """
+    by_proc: dict = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    slots = []
+    for proc in sorted(by_proc):
+        local = by_proc[proc]
+        if len(local) % per_host:
+            raise ValueError(
+                f"host slots of {per_host} devices cannot subdivide"
+                f" process {proc} ({len(local)} local devices): the"
+                f" y-axis psum would cross DCN. Pick hosts= so that"
+                f" every process's device count is a multiple of"
+                f" devices-per-slot (hosts=jax.process_count() when"
+                f" counts are uneven).")
+        for i in range(0, len(local), per_host):
+            slots.append(local[i:i + per_host])
+    assert len(slots) == h, (len(slots), h)
+    return slots
 
 
 def make_multihost_ring_mesh() -> Mesh:
@@ -173,13 +203,15 @@ def multihost_ft_sgemm(
     beta: float = -1.5,
     inject: Optional[InjectionSpec] = None,
     strategy: str = "weighted",
-    threshold: float = REFERENCE_THRESHOLD,
+    encode: str = "vpu",
+    threshold: "float | str" = REFERENCE_THRESHOLD,
     precision: str = "highest",
     in_dtype: str = "float32",
     scatter_output: bool = False,
     interpret: Optional[bool] = None,
     inject_coords: Optional[Tuple[int, int, int]] = None,
     donate_c: bool = False,
+    variant=None,
 ) -> FtSgemmResult:
     """Fused-ABFT ``C = alpha*A@B.T + beta*C`` over a ("host", "x", "y") mesh.
 
@@ -215,9 +247,15 @@ def multihost_ft_sgemm(
     if scatter_output:
         _check_divisible("N", n, my, "y")
 
+    # encode= / threshold="adaptive" / variant= ride through exactly as on
+    # the single-host paths; make_ft_sgemm consults tuner.lookup_winner at
+    # trace time with the LOCAL c.shape, which inside shard_map is the
+    # per-device shard — so tuned winners are keyed by shard shape, not
+    # the global problem size.
     local_ft = make_ft_sgemm(
-        shape, alpha=1.0, beta=0.0, strategy=strategy, threshold=threshold,
-        precision=precision, in_dtype=in_dtype, interpret=interpret,
+        shape, alpha=1.0, beta=0.0, strategy=strategy, encode=encode,
+        threshold=threshold, precision=precision, in_dtype=in_dtype,
+        interpret=interpret, variant=variant,
     )
     # K-partials psum over "y" (ICI only). Detection counters reduce in
     # STAGES (parallel/reduce.py): per-device -> "y" (ICI ring) -> "x"

@@ -163,7 +163,8 @@ def make_ft_step(local_ft, alpha, beta, inject, scatter_output, det_axes,
 
 def make_tiered_ft_step(local_ft, alpha, beta, inject, det_axes,
                         *, mesh_axes=("x", "y"), tier_axes=("y", "x"),
-                        inject_coords=None, tier_corrupt=()):
+                        inject_coords=None, tier_corrupt=(),
+                        dcn_corrupt=(), gather_stages=False):
     """:func:`make_ft_step` + per-device DATA-PLANE checksum residual
     vectors staged one mesh axis at a time — the tier emission half of
     ``resilience/tiers.py`` (the arXiv 2112.09017 panel structure
@@ -193,11 +194,24 @@ def make_tiered_ft_step(local_ft, alpha, beta, inject, det_axes,
     window: trace-time ``((mesh coords), (i, j), delta)`` entries added
     to the named device's local partial AFTER the kernel check and
     BEFORE the reduction (the data-plane analog of ``inject_coords``).
+    ``dcn_corrupt`` entries (``((mesh coords), j, delta)``) instead
+    strike the staged residual IN FLIGHT between the last ICI stage and
+    the final ``tier_axes`` hop — on a multihost mesh that final hop is
+    the DCN ``host`` axis, so the corruption is invisible to every
+    narrower stage and detectable ONLY at the post-DCN (global) tier:
+    the fleet localization self-test for "seen only across DCN".
 
     The step returns ``(out, det, unc, dev_det, dev_unc, r_dev, *r_stages)``
     with every ``r_*`` reshaped to one vector per device
     (``P(*mesh_axes, None)`` grids — ``telemetry._device_entries``'s
     shard-placement trick, applied to f32 vectors).
+
+    ``gather_stages=True`` instead all-gathers each stage into a fully
+    REPLICATED ``(*mesh extents, n)`` grid (out_specs all-None): on a
+    real multi-process mesh the sharded grids span non-addressable
+    devices, and replication is what lets EVERY rank run host-side tier
+    detection on the complete grid — the residual vectors are the
+    detection plane's few KB, the traffic DCN is budgeted for.
     """
     run_local = shard_local_ft(local_ft, inject, inject_coords, mesh_axes)
     dev_shape = (1,) * len(mesh_axes)
@@ -219,11 +233,33 @@ def make_tiered_ft_step(local_ft, alpha, beta, inject, det_axes,
             b_loc.astype(jnp.float32).T
         r = (obs - exp).astype(jnp.float32)
         vec_shape = dev_shape + (r.shape[0],)
-        r_stages = [r.reshape(vec_shape)]
+
+        def emit(v):
+            if not gather_stages:
+                return v.reshape(vec_shape)
+            g = v
+            for axis in reversed(mesh_axes):
+                g = jax.lax.all_gather(g, axis)
+            return g
+
+        r_stages = [emit(r)]
         staged = r
-        for ax in tier_axes:
+        for si, ax in enumerate(tier_axes):
+            if si == len(tier_axes) - 1:
+                # In-flight corruption of the final (DCN on multihost
+                # meshes) hop: struck AFTER every narrower stage was
+                # recorded clean, BEFORE the last psum carries it into
+                # the post-DCN residual.
+                for coords, cj, delta in dcn_corrupt:
+                    on = jnp.bool_(True)
+                    for axis, cc in zip(mesh_axes, coords):
+                        on = jnp.logical_and(
+                            on, jax.lax.axis_index(axis) == cc)
+                    staged = staged.at[cj].add(
+                        jnp.where(on, jnp.float32(delta),
+                                  jnp.float32(0.0)))
             staged = jax.lax.psum(staged, ax)
-            r_stages.append(staged.reshape(vec_shape))
+            r_stages.append(emit(staged))
         partial = jax.lax.psum(part, "y")
         out = alpha * partial + beta * c_loc
         dev_det = jnp.sum(res.detections).reshape(dev_shape)

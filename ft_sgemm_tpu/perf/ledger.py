@@ -362,6 +362,27 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
                 "tier_checks", "tier_detections", "ladder",
                 "incorrect_responses")
         entry["recovery"] = {k: rec.get(k) for k in keep if k in rec}
+    # Fleet runtime (PR 16): the 2-proc smoke's acceptance facts land
+    # as fleet.* measurements — same recovery.* stance. The trend plane
+    # carries the monotone health series (goodput recovery, MTTR,
+    # global-tier detection count, incorrect responses must stay 0);
+    # categorical facts (which host, the localization) ride the entry
+    # body.
+    fleet = ctx.get("fleet")
+    if isinstance(fleet, dict):
+        for key, hib in (("goodput_recovery_ratio", True),
+                         ("mttr_seconds", False),
+                         ("global_tier_detections", True),
+                         ("incorrect_responses", False),
+                         ("goodput_post_rps", True)):
+            s = _measurement(fleet.get(key), higher_is_better=hib)
+            if s:
+                entry["measurements"][f"fleet.{key}"] = s
+        keep = ("processes", "vdevs_per_process", "evicted_host",
+                "eviction_action", "localized", "merged_hosts",
+                "global_tier", "staged_equals_flat", "host_blames",
+                "reshard")
+        entry["fleet"] = {k: fleet.get(k) for k in keep if k in fleet}
 
     if entry["kind"] == "multichip" and not entry["measurements"] \
             and entry["value"] is None:

@@ -32,6 +32,7 @@ from ft_sgemm_tpu.resilience.tiers import (
     TIERS,
     TierReport,
     checksum_tolerance,
+    fleet_tiered_ft_sgemm,
     tiered_ft_sgemm,
     verify_resident,
 )
@@ -44,6 +45,7 @@ __all__ = [
     "TIERS",
     "TierReport",
     "checksum_tolerance",
+    "fleet_tiered_ft_sgemm",
     "recover_local",
     "run_eviction_drill",
     "surviving_mesh",

@@ -60,6 +60,15 @@ class EvictionPolicy:
     min_calls: int = 8
     panel_recompute_limit: int = 3
     min_survivors: int = 1
+    # Host granularity (the fleet plane): repeated device blames landing
+    # on ONE process evict the whole host — the failure domain on a real
+    # multi-process mesh is the process (its runtime, its NIC, its host
+    # memory), not the chip. ``host_blame_limit`` counts blame events
+    # (faulty replies, tier detections, ladder escalations attributed to
+    # any device of that host); ``min_surviving_hosts`` is the hard
+    # floor on fleet width after a host eviction.
+    host_blame_limit: int = 3
+    min_surviving_hosts: int = 1
 
 
 class ElasticController:
@@ -82,6 +91,9 @@ class ElasticController:
         self._deciding: set = set()
         self.evictions: list = []
         self.fault_marked_at: Optional[float] = None
+        self._host_blames: dict = {}   # host -> {device: count}
+        self._host_deciding: set = set()
+        self.host_evictions: list = []
 
     # -- evidence feeds ----------------------------------------------------
 
@@ -102,6 +114,53 @@ class ElasticController:
     def recompute_count(self, device: str) -> int:
         with self._lock:
             return self._recomputes.get(str(device), 0)
+
+    def note_device_blame(self, host: int, device: str) -> int:
+        """One fault blamed on ``device`` of process ``host`` (a faulty
+        serve reply, a tier detection, a ladder escalation) — the
+        host-granularity evidence feed. Returns the host's total."""
+        with self._lock:
+            row = self._host_blames.setdefault(int(host), {})
+            row[str(device)] = row.get(str(device), 0) + 1
+            return sum(row.values())
+
+    def host_blames(self, host: int) -> dict:
+        with self._lock:
+            return dict(self._host_blames.get(int(host), {}))
+
+    # -- the host-granularity decision -------------------------------------
+
+    def should_evict_host(self, *, total_hosts: int,
+                          evicted_hosts=()) -> Optional[Tuple[int, str]]:
+        """``(host, reason)`` when one process has accumulated
+        ``host_blame_limit`` device blames, else None. Mirrors
+        :meth:`should_evict` one failure-domain up: never proposes a
+        host already evicted (or handed out), never shrinks the fleet
+        below ``min_surviving_hosts`` processes. The worst-blamed
+        eligible host wins a tie-free decision."""
+        pol = self.policy
+        with self._lock:
+            blocked = set(evicted_hosts) | self._host_deciding
+            if total_hosts - len(set(evicted_hosts)) - 1 \
+                    < pol.min_surviving_hosts:
+                return None
+            worst = None
+            for host, row in self._host_blames.items():
+                if host in blocked:
+                    continue
+                total = sum(row.values())
+                if total >= pol.host_blame_limit and (
+                        worst is None or total > worst[1]):
+                    worst = (host, total)
+            if worst is None:
+                return None
+            self._host_deciding.add(worst[0])
+            return (worst[0], "host_blame")
+
+    def record_host_eviction(self, facts: dict) -> None:
+        with self._lock:
+            self.host_evictions.append(dict(facts))
+            self._host_deciding.discard(facts.get("host"))
 
     # -- the decision ------------------------------------------------------
 
@@ -153,17 +212,24 @@ class ElasticController:
             return max(0.0, recovered_at - self.fault_marked_at)
 
 
-def surviving_mesh(exclude, devices=None, *, axis_names=("x", "y")):
+def surviving_mesh(exclude=(), devices=None, *, axis_names=("x", "y"),
+                   exclude_hosts=()):
     """A fresh 2-D mesh over the survivors — the reshard target.
 
     ``exclude`` is a device, its label string, its index into
-    ``devices``, or an iterable of those. The mesh spans the largest
-    POWER-OF-TWO count of surviving devices (power-of-two keeps the
-    existing divisibility contracts of the sharded entry points intact
-    through a reshard: a 256-row M that divided 8 devices still divides
-    4), most-square split — the ``make_mesh`` rule. The caller re-AOTs
-    its step over the returned mesh through the ordinary factories;
-    that recompile IS the re-AOT window.
+    ``devices``, or an iterable of those. ``exclude_hosts`` drops every
+    device of the named process indices FIRST — the host-eviction
+    reshard: on a fleet mesh an evicted HOST takes all its devices out
+    of placement at once, and the survivor processes rebuild over what
+    remains (all of it addressable to them when one process of two
+    died, which is exactly what makes the reshard executable without
+    the dead rank). The mesh spans the largest POWER-OF-TWO count of
+    surviving devices (power-of-two keeps the existing divisibility
+    contracts of the sharded entry points intact through a reshard: a
+    256-row M that divided 8 devices still divides 4), most-square
+    split — the ``make_mesh`` rule. The caller re-AOTs its step over
+    the returned mesh through the ordinary factories; that recompile IS
+    the re-AOT window.
     """
     import jax
     import numpy as np
@@ -172,7 +238,11 @@ def surviving_mesh(exclude, devices=None, *, axis_names=("x", "y")):
     devices = list(jax.devices()) if devices is None else list(devices)
     if not isinstance(exclude, (list, tuple, set, frozenset)):
         exclude = (exclude,)
-    excluded = set()
+    if not isinstance(exclude_hosts, (list, tuple, set, frozenset)):
+        exclude_hosts = (exclude_hosts,)
+    dead_hosts = {int(h) for h in exclude_hosts}
+    excluded = {i for i, d in enumerate(devices)
+                if getattr(d, "process_index", 0) in dead_hosts}
     for e in exclude:
         if isinstance(e, int):
             excluded.add(e)

@@ -115,12 +115,21 @@ def staged_reduce_np(grid: np.ndarray,
 
 def detect_tiers(r_dev: np.ndarray, tol0: float,
                  *, tier_axes: Sequence[int] = (1, 0),
-                 col_offset: int = 0) -> TierReport:
+                 col_offset: int = 0,
+                 tier_stages: Optional[Sequence[int]] = None) -> TierReport:
     """Scan the staged residuals cheapest tier first.
 
     ``r_dev`` is the per-device residual grid ``(X, Y, n)`` (signed f32
     vectors); staging follows ``tier_axes``. Tolerance at stage ``s``
     is ``tol0 * sqrt(fan-in so far)``.
+
+    With more staged axes than tiers (the 3-axis fleet mesh stages
+    device -> y -> x -> host, four values for three tier names),
+    ``tier_stages`` names which stage each tier reads: the fleet
+    mapping is ``(0, 2, 3)`` — "device" the raw grid, "host" after ALL
+    intra-process ICI axes, "global" after the DCN ``host`` axis, so a
+    global-tier detection means the corruption was seen ONLY across
+    DCN. Default: tier ``i`` reads stage ``i`` (the 2-axis meshes).
     """
     grid = np.asarray(r_dev, np.float64)
     stages = staged_reduce_np(grid, tier_axes)
@@ -130,10 +139,35 @@ def detect_tiers(r_dev: np.ndarray, tol0: float,
     fanins = [1]
     for ax in tier_axes:
         fanins.append(fanins[-1] * grid.shape[ax])
+    return detect_tiers_from_stages(stages, tol0, fanins=fanins,
+                                    tier_stages=tier_stages,
+                                    col_offset=col_offset)
+
+
+def detect_tiers_from_stages(stages: Sequence, tol0: float,
+                             *, fanins: Sequence[int],
+                             tier_stages: Optional[Sequence[int]] = None,
+                             col_offset: int = 0) -> TierReport:
+    """Tier scan over ACTUAL staged residual grids (one per stage).
+
+    :func:`detect_tiers` recomputes the staging host-side from the
+    per-device grid — correct for corruption resident in the partials,
+    but blind to corruption that struck a staged value IN FLIGHT (the
+    DCN hop): that only exists in the stage grids the mesh itself
+    emitted (``make_tiered_ft_step``'s ``r_stages``). This variant
+    scans those emitted grids directly, so a clean ``r_dev`` with a
+    dirty post-DCN stage is detected at — and only at — the global
+    tier. ``fanins[s]`` is the device fan-in each stage-``s`` vector
+    combines (its tolerance widens by ``sqrt(fanin)``).
+    """
+    if tier_stages is None:
+        tier_stages = range(min(len(TIERS), len(stages)))
     residuals = {}
     tolerances = {}
     detection = None
-    for name, stage, fanin in zip(TIERS, stages, fanins):
+    for name, si in zip(TIERS, tier_stages):
+        stage = np.asarray(stages[si], np.float64)
+        fanin = fanins[si]
         tol = tol0 * math.sqrt(fanin)
         resid = float(np.max(np.abs(stage))) if stage.size else 0.0
         residuals[name] = resid
@@ -305,11 +339,123 @@ def tiered_ft_sgemm(a, b, c, mesh, shape="huge", *,
     return result, report
 
 
+def fleet_tiered_ft_sgemm(a, b, c, mesh, shape="huge", *,
+                          alpha: float = 1.0, beta: float = -1.5,
+                          inject=None, strategy: str = "weighted",
+                          threshold=None, in_dtype: str = "float32",
+                          interpret: Optional[bool] = None,
+                          inject_coords: Optional[Tuple[int, int, int]] = None,
+                          tier_corrupt: Sequence = (),
+                          dcn_corrupt: Sequence = (),
+                          margin: float = 64.0,
+                          registry=None):
+    """:func:`tiered_ft_sgemm` on the 3-axis ("host", "x", "y") fleet
+    mesh — the checksum tiers made DCN-honest.
+
+    Staging runs device -> ``y`` -> ``x`` -> ``host``: four staged
+    values for three tier names, mapped ``tier_stages=(0, 2, 3)`` so
+    "host" reads the post-ICI stage and "global" the post-DCN stage —
+    on a real multi-process mesh a global-tier detection now means the
+    corruption was SEEN ONLY ACROSS DCN. ``dcn_corrupt`` entries
+    (``((h, x, y), col, delta)``) strike the staged residual in flight
+    on the DCN hop itself (see
+    :func:`~ft_sgemm_tpu.parallel.sharded.make_tiered_ft_step`) — the
+    self-test that pins that meaning. Stage grids are emitted fully
+    REPLICATED (all-gathered in-step) so every rank — including ones
+    that cannot address the faulty device — runs the same host-side
+    detection on the complete grid. Returns ``(FtSgemmResult,
+    TierReport)``; works identically single-process (tests) and across
+    real processes (fleet/worker.py).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.injection import InjectionSpec, REFERENCE_THRESHOLD
+    from ft_sgemm_tpu.ops.common import resolve_in_dtype
+    from ft_sgemm_tpu.ops.ft_sgemm import FtSgemmResult, make_ft_sgemm
+    from ft_sgemm_tpu.parallel.sharded import (
+        _check_divisible,
+        make_tiered_ft_step,
+        shard_map,
+    )
+
+    inject = inject or InjectionSpec.none()
+    threshold = REFERENCE_THRESHOLD if threshold is None else threshold
+    cast_dtype, _ = resolve_in_dtype(in_dtype, "highest")
+    a = jnp.asarray(a, cast_dtype)
+    b = jnp.asarray(b, cast_dtype)
+    c = jnp.asarray(c, jnp.float32)
+    (m, k), (n, _) = a.shape, b.shape
+    h, mx, my = mesh.shape["host"], mesh.shape["x"], mesh.shape["y"]
+    _check_divisible("M", m, h * mx)
+    _check_divisible("K", k, my)
+
+    local_ft = make_ft_sgemm(
+        shape, alpha=1.0, beta=0.0, strategy=strategy,
+        threshold=threshold, in_dtype=in_dtype, interpret=interpret)
+    step = make_tiered_ft_step(
+        local_ft, alpha, beta, inject, det_axes=("y", "x", "host"),
+        mesh_axes=("host", "x", "y"), tier_axes=("y", "x", "host"),
+        inject_coords=inject_coords, tier_corrupt=tuple(tier_corrupt),
+        dcn_corrupt=tuple(dcn_corrupt), gather_stages=True)
+
+    grid_spec = P(None, None, None, None)  # replicated (h, x, y, n)
+    fn = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(("host", "x"), "y"), P(None, "y"),
+                  P(("host", "x"), None)),
+        out_specs=(P(("host", "x"), None), P(None, None), P(None, None),
+                   P("host", "x", "y"), P("host", "x", "y"),
+                   grid_spec, grid_spec, grid_spec, grid_spec))
+    with telemetry.trace_span("fleet_tiered_ft_sgemm"):
+        out, det, unc, dev_det, dev_unc, r_dev, r_y, r_ici, r_glob = \
+            jax.jit(fn)(a, b, c)
+    result = FtSgemmResult(out, det, unc)
+
+    amax = float(np.max(np.abs(np.asarray(a, np.float32)), initial=0.0))
+    bmax = float(np.max(np.abs(np.asarray(b, np.float32)), initial=0.0))
+    tol0 = checksum_tolerance(m // (h * mx), k // my, amax, bmax,
+                              margin=margin)
+    # The grids are replicated: every rank materializes all four staged
+    # (h, x, y, n) grids locally — no cross-process fetch. Detection
+    # scans the ACTUAL emitted stages (not a host-side re-staging of
+    # r_dev) so in-flight DCN corruption — present only in the post-DCN
+    # stage — is seen, at the global tier alone.
+    report = detect_tiers_from_stages(
+        [np.asarray(r_dev), np.asarray(r_y), np.asarray(r_ici),
+         np.asarray(r_glob)],
+        tol0, fanins=[1, my, mx * my, h * mx * my], tier_stages=(0, 2, 3))
+
+    if registry is None:
+        registry = telemetry.get_registry()
+    registry.counter("recovery_tier_checks").inc()
+    if report.detected:
+        registry.counter("recovery_tier_detections",
+                         recovery_tier=report.tier).inc()
+        host = (report.device_coords[0]
+                if report.device_coords is not None else None)
+        telemetry.record_step_event(
+            "uncorrectable", op="data_tiers",
+            extra={"recovery_tier": report.tier,
+                   "residual": report.residuals.get(report.tier),
+                   "tolerance": report.tolerances.get(report.tier),
+                   "device_coords": (list(report.device_coords)
+                                     if report.device_coords else None),
+                   "host": host,
+                   "columns": report.columns,
+                   "mesh": f"mesh{h}x{mx}x{my}"})
+    return result, report
+
+
 __all__ = [
     "TIERS",
     "TierReport",
     "checksum_tolerance",
     "detect_tiers",
+    "detect_tiers_from_stages",
+    "fleet_tiered_ft_sgemm",
     "residual_vectors",
     "staged_reduce_np",
     "tiered_ft_sgemm",

@@ -22,9 +22,12 @@ host, including one with no accelerator attached.
 
 from __future__ import annotations
 
+import dataclasses
+import os
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from ft_sgemm_tpu.telemetry.events import FaultEvent, read_events
+from ft_sgemm_tpu.telemetry.events import (FaultEvent, parse_event_line,
+                                           read_events)
 
 DeviceKey = Tuple[Optional[int], str]
 
@@ -148,5 +151,113 @@ def format_device_table(table: dict, *, ranked: bool = False) -> str:
     return "\n".join(lines)
 
 
-__all__ = ["device_table", "format_device_table", "merge_shards",
-           "rank_devices"]
+class LiveAggregator:
+    """Incremental tail+merge of per-rank JSONL event shards — the fleet
+    coordinator's LIVE view (post-hoc :func:`merge_shards` promoted to a
+    poll loop; fleet/worker.py rank 0 drives one of these).
+
+    Each registered shard is tailed from a per-file byte offset that
+    only ever advances past COMPLETE lines (a torn tail — a rank killed
+    mid-write — is left in place and re-read once its newline lands), so
+    the merged stream is strictly append-only: counters derived from it
+    are monotone non-decreasing across :meth:`poll` calls, and an event
+    is delivered exactly once. A shard file that does not exist yet
+    (rank still booting) is polled silently until it appears.
+
+    :meth:`feed_health` bridges the merged stream into a
+    ``DeviceHealthTracker``: every per-device attribution row observed
+    since the previous feed becomes one ``observe()`` call labeled
+    ``host{h}:{device}`` — which is how ``device_health`` gauges (and
+    the pool's drain logic behind ``/metrics`` / ``cli top``) come to
+    cover devices the coordinator process cannot address.
+    """
+
+    def __init__(self):
+        self._offsets: dict = {}    # path -> byte offset past complete lines
+        self._hosts: dict = {}      # path -> declared host (rank) or None
+        self._events: List[FaultEvent] = []
+        self._fed = 0               # events already pushed to feed_health
+
+    def add_shard(self, path, host: Optional[int] = None) -> None:
+        path = os.fspath(path)
+        if path not in self._offsets:
+            self._offsets[path] = 0
+            self._hosts[path] = host
+
+    def poll(self) -> int:
+        """Drain every shard's complete new lines; returns the number of
+        events appended to the merged stream."""
+        new = 0
+        for path, offset in list(self._offsets.items()):
+            try:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read()
+            except OSError:
+                continue  # not written yet / transiently unreadable
+            # Only consume up to the last newline: a torn tail stays
+            # unparsed AND unconsumed until the writer completes it.
+            cut = chunk.rfind("\n")
+            if cut < 0:
+                continue
+            complete, consumed = chunk[:cut + 1], cut + 1
+            self._offsets[path] = offset + len(
+                complete.encode("utf-8", errors="replace"))
+            for line in complete.splitlines():
+                ev = parse_event_line(line)
+                if ev is None:
+                    continue
+                if ev.host is None and self._hosts.get(path) is not None:
+                    ev = dataclasses.replace(ev, host=self._hosts[path])
+                self._events.append(ev)
+                new += 1
+        return new
+
+    def events(self) -> List[FaultEvent]:
+        return list(self._events)
+
+    def device_table(self) -> dict:
+        return device_table(self._events)
+
+    def fleet_view(self) -> dict:
+        """The merged per-host rollup ``cli fleet`` prints: which ranks
+        have reported, their per-device rows, and the stream totals."""
+        table = self.device_table()
+        hosts: dict = {}
+        for (host, dev), row in table["devices"].items():
+            h = hosts.setdefault(host, {"devices": 0, "detected": 0,
+                                        "uncorrectable": 0})
+            h["devices"] += 1
+            h["detected"] += row["detected"]
+            h["uncorrectable"] += row["uncorrectable"]
+        declared = sorted({h for h in self._hosts.values()
+                           if h is not None})
+        return {"events": len(self._events), "calls": table["calls"],
+                "hosts": hosts, "ranks": declared,
+                "devices": table["devices"]}
+
+    def feed_health(self, tracker) -> int:
+        """Push events merged since the last feed into a
+        ``DeviceHealthTracker`` (one ``observe`` per attribution row,
+        labeled ``host{h}:{device}``); returns rows fed."""
+        fed_rows = 0
+        call_outcomes = ("clean", "corrected", "uncorrectable")
+        for ev in self._events[self._fed:]:
+            if ev.outcome not in call_outcomes:
+                continue
+            for entry in _entry_rows(ev):
+                host = entry.get("host")
+                label = (f"host{host}:{entry['device']}"
+                         if host is not None else str(entry["device"]))
+                det = int(entry.get("detected") or 0)
+                unc = int(entry.get("uncorrectable") or 0)
+                tracker.observe(label, calls=1, detected=det,
+                                uncorrectable=unc, residual=ev.residual)
+                fed_rows += 1
+        self._fed = len(self._events)
+        return fed_rows
+
+
+__all__ = ["LiveAggregator", "device_table", "format_device_table",
+           "merge_shards", "rank_devices"]

@@ -92,6 +92,16 @@ AXIS_LABELS = {
     # first.
     "ladder_rung": ("element_correct", "panel_recompute",
                     "shard_restore", "full_retry"),
+    # Fleet host-slot interconnect tier (PR 16) — mirrors
+    # contracts.HOST_TIERS (fleet/dispatch.py::HOST_TIERS is the runtime
+    # spelling); rides ``extra["host_tier"]`` on fleet dispatch events:
+    # "local" = the coordinator's own process, "dcn" = a remote rank.
+    "host_tier": ("local", "dcn"),
+    # Cross-host fleet dispatcher placement policy (PR 16) — mirrors
+    # contracts.FLEET_PLACEMENTS (fleet/dispatch.py::FLEET_PLACEMENTS is
+    # the runtime spelling); rides fleet timeline points and dispatch
+    # event extras.
+    "fleet_placement": ("dcn_cost", "round_robin"),
 }
 
 

@@ -279,6 +279,9 @@ _METRIC_HELP_PREFIXES = {
     "recovery_": "Elastic recovery: data-plane checksum tier checks, "
                  "recompute-ladder rungs, and device evictions "
                  "(ft_sgemm_tpu/resilience)",
+    "fleet_": "Fleet runtime: cross-host dispatch, host-slot blame/"
+              "eviction, and live shard-merge counters "
+              "(ft_sgemm_tpu/fleet)",
 }
 
 
