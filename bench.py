@@ -19,6 +19,14 @@ throughput, and the retry/fault counters in context. SIGTERM drains and
 emits a ``partial`` artifact; the streamed timeline carries per-batch
 spans and progress points for harder kills.
 
+``--chaos [--smoke]`` runs the fault-model coverage CAMPAIGN instead
+(``chaos_main``): every declared fault model (``contracts.FAULT_MODELS``)
+compiled onto the existing actuators, swept across the serve / block /
+train / pool workloads, and the JSON line reports overall detection rate
+with the per-model coverage matrix + MTBF-derived policy picks in
+``context.chaos`` (the ledger ingests them as ``chaos.*`` measurements
+for ``cli trend`` gating).
+
 ``--tuned`` adds an ``ft_tuned`` stage: the same injected headline kernel
 dispatched through the autotuner's tile cache (``ft_sgemm_tpu.tuner`` —
 seed it with ``python -m ft_sgemm_tpu.cli tune 4096`` in a prior window),
@@ -2879,6 +2887,64 @@ def fleet_main(argv):
     return 0 if ok_all else 1
 
 
+def chaos_main(argv):
+    """``--chaos [--smoke]``: the fault-model coverage campaign.
+
+    Sweeps every declared fault model (``contracts.FAULT_MODELS``)
+    across its workloads via :class:`ft_sgemm_tpu.chaos.ChaosCampaign`
+    and prints ONE JSON line: the ``chaos_coverage`` artifact (overall
+    detection rate as the metric, the full per-model matrix + policy
+    recommendations in ``context.chaos``). The run ledger ingests the
+    per-model ``chaos.*`` measurements, so ``cli trend --gate``
+    thereafter fails a model whose detection rate regresses. The
+    human-readable coverage table goes to stderr; ``--coverage-out=``
+    additionally writes COVERAGE.json. rc per
+    :func:`ft_sgemm_tpu.cli.chaos_verdict` — every model measured,
+    correctable models at detection 1.0, zero incorrect results, zero
+    clean-twin false positives.
+    """
+    from ft_sgemm_tpu.chaos.campaign import (
+        ChaosCampaign,
+        render_coverage,
+    )
+    from ft_sgemm_tpu.cli import chaos_verdict
+
+    kw = {}
+    coverage_path = None
+    for f in argv:
+        try:
+            if f.startswith("--models="):
+                kw["models"] = tuple(
+                    v for v in f.split("=", 1)[1].split(",") if v)
+            elif f.startswith("--episodes="):
+                kw["episodes"] = int(f.split("=", 1)[1])
+            elif f.startswith("--clean-episodes="):
+                kw["clean_episodes"] = int(f.split("=", 1)[1])
+            elif f.startswith("--seed="):
+                kw["seed"] = int(f.split("=", 1)[1])
+            elif f.startswith("--coverage-out="):
+                coverage_path = f.split("=", 1)[1]
+        except ValueError as e:
+            sys.stderr.write(f"bench --chaos: {e}\n")
+            return 2
+    if "--smoke" in argv:
+        kw.setdefault("episodes", 2)
+        kw.setdefault("clean_episodes", 1)
+    try:
+        doc = ChaosCampaign(**kw).run()
+    except ValueError as e:
+        sys.stderr.write(f"bench --chaos: {e}\n")
+        return 2
+    sys.stderr.write(render_coverage(doc) + "\n")
+    if coverage_path:
+        with open(coverage_path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+    print(json.dumps(doc), flush=True)
+    _ledger_append(doc)
+    return 0 if chaos_verdict(doc) else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         sys.exit(worker_main(sys.argv[2]))
@@ -2886,6 +2952,8 @@ if __name__ == "__main__":
         sys.exit(fleet_main(sys.argv[1:]))
     if "--serve" in sys.argv[1:]:
         sys.exit(serve_main(sys.argv[1:]))
+    if "--chaos" in sys.argv[1:]:
+        sys.exit(chaos_main(sys.argv[1:]))
     if "--smoke" in sys.argv[1:]:
         sys.exit(smoke_main())
     if "--tuned" in sys.argv[1:]:

@@ -1,0 +1,42 @@
+"""Chaos campaign plane: declarative fault models, the measured
+coverage matrix, and MTBF-driven policy selection.
+
+Import surface stays light: ``models``/``policy`` are stdlib-only; the
+campaign runner (which pulls in numpy and, lazily, jax workloads) only
+loads when :class:`ChaosCampaign` is first touched.
+"""
+
+from __future__ import annotations
+
+from ft_sgemm_tpu.chaos.models import (
+    FAULT_MODELS,
+    MODELS,
+    WORKLOADS,
+    FaultModel,
+    draw_episode,
+)
+from ft_sgemm_tpu.chaos.policy import (
+    recommend,
+    recommend_cadence,
+)
+
+__all__ = [
+    "FAULT_MODELS",
+    "MODELS",
+    "WORKLOADS",
+    "ChaosCampaign",
+    "FaultModel",
+    "draw_episode",
+    "recommend",
+    "recommend_cadence",
+    "render_coverage",
+    "run_campaign",
+]
+
+
+def __getattr__(name):
+    if name in ("ChaosCampaign", "run_campaign", "render_coverage"):
+        from ft_sgemm_tpu.chaos import campaign as _campaign
+
+        return getattr(_campaign, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

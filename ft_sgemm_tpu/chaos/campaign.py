@@ -1,0 +1,852 @@
+"""The chaos campaign runner: fault model x workload -> measured coverage.
+
+Sweeps the declared fault models (``chaos/models.py``) across the real
+workloads — the GEMM serve engine, the transformer-block engine with
+its checked KV cache, ``train.resilient_step``, and the health-steered
+device pool — and measures, per (model, workload) cell:
+
+- **detection rate** and **detection latency** (injection-to-event wall
+  time, observed live through :func:`telemetry.add_observer` and
+  recorded into the ``fault_detection_latency_seconds`` histogram);
+- **tier-of-detection** distribution (device / host / global /
+  kv_page / health — where the stack first saw the fault);
+- **correction rate** and **MTTR** (injection to verified-correct
+  output, whatever the recovery path: in-kernel correction, retry,
+  eviction, recompute);
+- **false-positive rate** on CLEAN TWINS (the same harness, no fault —
+  any detection there is a false alarm);
+- **goodput retention** (faulted throughput relative to clean).
+
+The result is the coverage matrix artifact (``COVERAGE.json``): an
+artifact-shaped doc (``metric: chaos_coverage``) whose context carries
+the full matrix, so ``perf/ledger.py`` ingests it directly and
+``perf/trend.py`` gates per-model regressions. ``chaos/policy.py``
+turns each model's measurements into a recommended (cadence, threshold
+mode, tier config) recorded alongside.
+
+Threading note (``lint.core.THREADED_MODULES`` lists this file): the
+telemetry observer runs on whatever thread records the event — engine
+workers included — so the event buffer lives on the instance behind
+``self._lock``; episodes themselves run sequentially on the caller's
+thread, which is what makes injection-to-event matching unambiguous.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ft_sgemm_tpu import telemetry
+from ft_sgemm_tpu.chaos import policy as _policy
+from ft_sgemm_tpu.chaos.models import (
+    FAULT_MODELS,
+    MODELS,
+    WORKLOADS,
+    draw_episode,
+)
+from ft_sgemm_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+)
+
+# Detection outcomes a recovery-plane event may carry: any of these in
+# an episode's window counts as "the stack saw the fault".
+_FAULT_OUTCOMES = ("uncorrectable", "retry", "restore", "exhausted",
+                   "evicted")
+
+# COVERAGE.json schema version (bumped on breaking layout changes; the
+# render and the ledger chaos block read this).
+COVERAGE_SCHEMA = 1
+
+
+def _is_detection(ev) -> bool:
+    """Does one observed FaultEvent indicate a fault finding (as opposed
+    to a clean call report)?"""
+    if (getattr(ev, "detected", 0) or 0) > 0:
+        return True
+    if (getattr(ev, "uncorrectable", 0) or 0) > 0:
+        return True
+    return getattr(ev, "outcome", None) in _FAULT_OUTCOMES
+
+
+class _CellStats:
+    """Accumulator for one (model, workload) cell's episodes."""
+
+    def __init__(self):
+        self.faults = 0
+        self.detections = 0
+        self.corrections = 0
+        self.recoveries = 0
+        self.incorrect = 0
+        self.latencies: list = []
+        self.mttrs: list = []
+        self.fault_walls: list = []
+        self.clean_walls: list = []
+        self.clean_episodes = 0
+        self.false_positives = 0
+        self.tiers: dict = {}
+        self.extra: dict = {}
+
+    def add_fault(self, *, detected: bool, corrected: bool,
+                  recovered: bool, latency: Optional[float],
+                  mttr: Optional[float], tier: Optional[str],
+                  incorrect: bool, wall: float) -> None:
+        self.faults += 1
+        self.fault_walls.append(wall)
+        if detected:
+            self.detections += 1
+            if latency is not None:
+                self.latencies.append(float(latency))
+            if tier:
+                self.tiers[tier] = self.tiers.get(tier, 0) + 1
+        if corrected:
+            self.corrections += 1
+        if recovered:
+            self.recoveries += 1
+        if mttr is not None:
+            self.mttrs.append(float(mttr))
+        if incorrect:
+            self.incorrect += 1
+
+    def add_clean(self, *, false_positive: bool, wall: float) -> None:
+        self.clean_episodes += 1
+        self.clean_walls.append(wall)
+        if false_positive:
+            self.false_positives += 1
+
+    def _goodput_retention(self) -> Optional[float]:
+        if "goodput_retention" in self.extra:
+            return self.extra["goodput_retention"]
+        if not self.fault_walls or not self.clean_walls:
+            return None
+        clean = float(np.mean(self.clean_walls))
+        fault = float(np.mean(self.fault_walls))
+        if fault <= 0:
+            return 1.0
+        return round(min(1.0, clean / fault), 4)
+
+    def finalize(self) -> dict:
+        lat = np.asarray(self.latencies, dtype=np.float64)
+        cell = {
+            "episodes": self.faults + self.clean_episodes,
+            "faults_injected": self.faults,
+            "detections": self.detections,
+            "detection_rate": (round(self.detections / self.faults, 4)
+                               if self.faults else None),
+            "corrections": self.corrections,
+            "correction_rate": (round(self.corrections / self.faults, 4)
+                                if self.faults else None),
+            "recoveries": self.recoveries,
+            "detection_latency_seconds": (
+                {"mean": round(float(lat.mean()), 6),
+                 "p95": round(float(np.percentile(lat, 95.0)), 6),
+                 "max": round(float(lat.max()), 6)}
+                if lat.size else None),
+            "mttr_seconds": (round(float(np.mean(self.mttrs)), 6)
+                             if self.mttrs else None),
+            "clean_episodes": self.clean_episodes,
+            "false_positives": self.false_positives,
+            "false_positive_rate": (
+                round(self.false_positives / self.clean_episodes, 4)
+                if self.clean_episodes else None),
+            "goodput_retention": self._goodput_retention(),
+            "tier_of_detection": dict(self.tiers),
+            "incorrect_results": self.incorrect,
+        }
+        for k, v in self.extra.items():
+            if k != "goodput_retention":
+                cell[k] = v
+        return cell
+
+
+class ChaosCampaign:
+    """One campaign: selected fault models across their workloads.
+
+    ``episodes`` faulted + ``clean_episodes`` clean-twin runs per cell,
+    all drawn from one ``random.Random(seed)`` stream per cell (seeded
+    determinism: same seed, same schedule). ``registry`` receives the
+    ``chaos_*`` counters, the ``coverage_*`` gauges, and the
+    ``fault_detection_latency_seconds`` histogram; ``timeline`` (a
+    :class:`~ft_sgemm_tpu.telemetry.timeline.TimelineRecorder`) gets
+    one ``chaos`` span per cell.
+    """
+
+    def __init__(self, *, models: Optional[Iterable[str]] = None,
+                 workloads: Optional[Iterable[str]] = None,
+                 episodes: int = 3, clean_episodes: int = 2,
+                 seed: int = 10,
+                 registry: Optional[MetricsRegistry] = None,
+                 timeline=None):
+        names = tuple(models) if models else FAULT_MODELS
+        for name in names:
+            if name not in MODELS:
+                raise ValueError(
+                    f"unknown fault model {name!r} (declared:"
+                    f" {FAULT_MODELS})")
+        self.models = names
+        self.workloads = tuple(workloads) if workloads else WORKLOADS
+        for w in self.workloads:
+            if w not in WORKLOADS:
+                raise ValueError(
+                    f"unknown workload {w!r} (known: {WORKLOADS})")
+        if episodes < 1:
+            raise ValueError(f"episodes={episodes} must be >= 1")
+        self.episodes = int(episodes)
+        self.clean_episodes = int(clean_episodes)
+        self.seed = int(seed)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.timeline = timeline
+        self._lock = threading.Lock()
+        self._events: list = []
+
+    # -- live detection observation -------------------------------------
+
+    def _observe(self, ev) -> None:
+        # Runs on whatever thread recorded the event (engine workers
+        # included) — append-only under the instance lock, scanned by
+        # the sequential episode loop.
+        ts = time.time()
+        with self._lock:
+            self._events.append((ts, ev))
+
+    def _detection_ts(self, t0: float,
+                      ops: Optional[Sequence[str]] = None
+                      ) -> Optional[float]:
+        """Wall timestamp of the first fault-indicating event at or
+        after ``t0`` (optionally restricted to ops), or None."""
+        with self._lock:
+            snapshot = list(self._events)
+        for ts, ev in snapshot:
+            if ts < t0:
+                continue
+            if ops is not None and getattr(ev, "op", None) not in ops:
+                continue
+            if _is_detection(ev):
+                return ts
+        return None
+
+    def _saw_detection(self, t0: float) -> bool:
+        return self._detection_ts(t0) is not None
+
+    # -- per-episode bookkeeping ----------------------------------------
+
+    def _note_detection(self, model_name: str, workload: str,
+                        latency: float) -> None:
+        """One measured detection: histogram observation + the campaign
+        event (``alert``) whose extra lets ``registry_from_events``
+        rebuild the same histogram from the JSONL log."""
+        self.registry.histogram(
+            "fault_detection_latency_seconds", buckets=LATENCY_BUCKETS,
+            fault_model=model_name).observe(float(latency))
+        self.registry.counter("chaos_detections", fault_model=model_name,
+                              workload=workload).inc()
+        telemetry.record_step_event(
+            "alert", op="chaos",
+            extra={"fault_model": model_name, "workload": workload,
+                   "detection_latency_seconds": round(float(latency), 6)})
+
+    def _span(self, name: str):
+        if self.timeline is None:
+            return contextlib.nullcontext({})
+        return self.timeline.span(name, kind="chaos")
+
+    # -- workload harnesses ---------------------------------------------
+
+    def _cell_gemm_serve(self, model, rng) -> dict:
+        from ft_sgemm_tpu.serve.buckets import default_bucket_set
+        from ft_sgemm_tpu.serve.engine import ServeEngine, ServeRequest
+
+        stats = _CellStats()
+        engine = ServeEngine(default_bucket_set(sizes=(256,)),
+                             threshold="static", max_batch=1,
+                             max_wait=0.01, registry=self.registry)
+        engine.start()
+        engine.prewarm(variants=("clean", "inject"))
+        try:
+            for i in range(self.episodes):
+                draw_episode(model, rng)  # keep the stream aligned
+                a, b = _operands(self.seed + i, 64, 64, 256)
+                t0 = time.time()
+                res = engine.submit(
+                    ServeRequest(a, b, variant="inject")).result(300.0)
+                wall = time.time() - t0
+                detected = res.detections > 0
+                det_ts = self._detection_ts(t0)
+                latency = ((det_ts - t0) if det_ts is not None
+                           else (res.latency_seconds if detected
+                                 else None))
+                # atol=1.0: ABFT correction subtracts a checksum
+                # estimate of a ~1e4 fault, leaving float noise well
+                # under 1; an UNcorrected fault leaves ~1e4.
+                incorrect = bool(
+                    res.ok and not np.allclose(
+                        res.c, a.astype(np.float64)
+                        @ b.astype(np.float64).T,
+                        rtol=1e-3, atol=1.0))
+                stats.add_fault(
+                    detected=detected,
+                    corrected=bool(res.corrected and res.ok),
+                    recovered=bool(res.ok), latency=latency,
+                    mttr=res.latency_seconds if res.ok else None,
+                    tier="device" if detected else None,
+                    incorrect=incorrect, wall=wall)
+                if detected and latency is not None:
+                    self._note_detection(model.name, "gemm_serve",
+                                         latency)
+            for i in range(self.clean_episodes):
+                a, b = _operands(self.seed + 100 + i, 64, 64, 256)
+                t0 = time.time()
+                res = engine.submit(
+                    ServeRequest(a, b, variant="clean")).result(300.0)
+                wall = time.time() - t0
+                stats.add_clean(
+                    false_positive=bool(res.detections > 0
+                                        or self._saw_detection(t0)),
+                    wall=wall)
+        finally:
+            engine.close()
+        return stats.finalize()
+
+    def _cell_block_serve(self, model, rng) -> dict:
+        from ft_sgemm_tpu.ops.attention import attention_reference
+        from ft_sgemm_tpu.serve.blocks import BlockEngine, BlockRequest
+        from ft_sgemm_tpu.serve.buckets import default_block_bucket_set
+
+        stats = _CellStats()
+        engine = BlockEngine(
+            default_block_bucket_set((128,), d=64, dv=64),
+            max_batch=1, max_wait=0.01, kv_page_size=16,
+            registry=self.registry)
+        engine.start()
+        engine.prewarm(variants=("clean",))
+
+        def one_sequence(ep_seed, corrupt):
+            nrng = np.random.default_rng(ep_seed)
+            L = 24
+            q = nrng.standard_normal((L, 64)).astype(np.float32)
+            k = nrng.standard_normal((L, 64)).astype(np.float32)
+            v = nrng.standard_normal((L, 64)).astype(np.float32)
+            pre = BlockRequest("prefill", q, k, v)
+            sid = pre.seq_id
+            engine.submit(pre).result(300.0)
+            t0 = time.time()
+            if corrupt is not None:
+                engine.corrupt_kv(
+                    sid, row=corrupt["row"], cols=(corrupt["col"],),
+                    magnitude=corrupt["magnitude"],
+                    which=corrupt["which"])
+            dq = nrng.standard_normal((1, 64)).astype(np.float32)
+            dk = nrng.standard_normal((1, 64)).astype(np.float32)
+            dv = nrng.standard_normal((1, 64)).astype(np.float32)
+            res = engine.submit(
+                BlockRequest("decode", dq, dk, dv,
+                             seq_id=sid)).result(300.0)
+            wall = time.time() - t0
+            k_all = np.concatenate([k, dk])
+            v_all = np.concatenate([v, dv])
+            want = np.asarray(attention_reference(dq, k_all, v_all,
+                                                  causal=True))
+            correct = bool(np.allclose(np.asarray(res.out), want,
+                                       rtol=1e-3, atol=1e-3))
+            return t0, res, wall, correct
+
+        try:
+            for i in range(self.episodes):
+                draw = draw_episode(model, rng)
+                t0, res, wall, correct = one_sequence(
+                    self.seed + i, draw)
+                detected = res.kv_faults > 0
+                det_ts = self._detection_ts(t0, ops=("kv_page",))
+                latency = ((det_ts - t0) if det_ts is not None
+                           else (res.latency_seconds if detected
+                                 else None))
+                stats.add_fault(
+                    detected=detected,
+                    corrected=bool(res.kv_corrected > 0 and res.ok),
+                    recovered=bool(res.ok and correct), latency=latency,
+                    mttr=res.latency_seconds if res.ok else None,
+                    tier="kv_page" if detected else None,
+                    incorrect=bool(res.ok and not correct), wall=wall)
+                if detected and latency is not None:
+                    self._note_detection(model.name, "block_serve",
+                                         latency)
+            for i in range(self.clean_episodes):
+                t0, res, wall, correct = one_sequence(
+                    self.seed + 100 + i, None)
+                stats.add_clean(
+                    false_positive=bool(res.kv_faults > 0
+                                        or res.detections > 0),
+                    wall=wall)
+        finally:
+            engine.close()
+        return stats.finalize()
+
+    def _cell_train_step(self, model, rng) -> dict:
+        if model.name == "multi_device_burst":
+            return self._train_burst(model, rng)
+        if model.name == "residual_drift":
+            return self._train_drift(model, rng)
+        return self._train_inject(model, rng)
+
+    def _train_inject(self, model, rng) -> dict:
+        """bit_flip / stuck_device through ``resilient_step``: a real
+        FT-GEMM step whose injection spec realizes the model; the
+        persistent model survives retries and recovers through the
+        eviction hook (the rebuilt step drops the sick device's
+        injection)."""
+        from ft_sgemm_tpu.configs import KernelShape
+        from ft_sgemm_tpu.injection import InjectionSpec
+        from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+        from ft_sgemm_tpu.train import resilient_step
+
+        tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+        persistent = model.temporal == "persistent"
+        # Persistent same-column faults need several K-steps landing in
+        # one column; the transient upset needs exactly one.
+        k_dim = 512 if persistent else 128
+        ft = make_ft_sgemm(tile, alpha=1.0, beta=0.0,
+                           threshold="static")
+        stats = _CellStats()
+        # Uncounted warm-up: keep first-call jit compile out of the
+        # faulted episode's wall (goodput retention compares walls).
+        wa, wb = _operands(self.seed + 999, 128, 128, k_dim)
+        ft(wa, wb, np.zeros((128, 128), np.float32))
+
+        def run_episode(ep_seed, spec, allow_evict):
+            a, b = _operands(ep_seed, 128, 128, k_dim)
+            c0 = np.zeros((128, 128), np.float32)
+            seen = {"det": 0, "out": None}
+            live = {"spec": spec}
+
+            def step_fn(state):
+                r = ft(a, b, c0, live["spec"])
+                seen["det"] += int(r.num_detected)
+                seen["out"] = np.asarray(r.c)
+                return state, {"detections": int(r.num_detected)}, \
+                    int(r.num_uncorrectable)
+
+            def on_persistent(attempts, unc):
+                # The eviction hook: drop the sick device (here: its
+                # injection) and hand back the rebuilt step.
+                live["spec"] = None
+                return step_fn
+
+            t0 = time.time()
+            _, metrics, report = resilient_step(
+                step_fn, (0,), max_retries=1,
+                on_persistent_fault=(on_persistent if allow_evict
+                                     else None),
+                raise_on_failure=False)
+            wall = time.time() - t0
+            return t0, metrics, report, seen, wall, (a, b)
+
+        for i in range(self.episodes):
+            draw = draw_episode(model, rng)
+            spec = InjectionSpec(enabled=True, every=int(draw["every"]),
+                                 magnitude=float(draw["magnitude"]),
+                                 col_stride=int(draw["col_stride"]))
+            t0, metrics, report, seen, wall, (a, b) = run_episode(
+                self.seed + i, spec, allow_evict=persistent)
+            detected = seen["det"] > 0 or report.retries > 0 \
+                or report.evicted
+            recovered = metrics is not None \
+                and report.uncorrectable == 0
+            corrected = (not persistent) and recovered \
+                and report.retries == 0 and seen["det"] > 0
+            det_ts = self._detection_ts(t0)
+            latency = ((det_ts - t0) if det_ts is not None
+                       else (wall if detected else None))
+            # atol=1.0 vs the ~1e4 fault: correction noise is < 1,
+            # a silently missed fault is not.
+            incorrect = bool(recovered and seen["out"] is not None
+                             and not np.allclose(
+                                 seen["out"],
+                                 a.astype(np.float64)
+                                 @ b.astype(np.float64).T,
+                                 rtol=1e-3, atol=1.0))
+            stats.add_fault(
+                detected=detected, corrected=corrected,
+                recovered=recovered, latency=latency,
+                mttr=wall if recovered else None, tier="device",
+                incorrect=incorrect, wall=wall)
+            if detected and latency is not None:
+                self._note_detection(model.name, "train_step", latency)
+            if persistent and report.evicted:
+                stats.extra["evictions"] = \
+                    stats.extra.get("evictions", 0) + 1
+        for i in range(self.clean_episodes):
+            t0, metrics, report, seen, wall, _ = run_episode(
+                self.seed + 100 + i, None, allow_evict=False)
+            stats.add_clean(
+                false_positive=bool(seen["det"] > 0
+                                    or report.retries > 0),
+                wall=wall)
+        return stats.finalize()
+
+    def _train_drift(self, model, rng) -> dict:
+        """residual_drift: the same sub-static-threshold fault under the
+        shipped static threshold (expected miss) and the adaptive
+        variance-scaled bound (expected catch) — the A/B that justifies
+        the policy picker's threshold recommendation."""
+        from ft_sgemm_tpu.configs import KernelShape
+        from ft_sgemm_tpu.injection import InjectionSpec
+        from ft_sgemm_tpu.ops.ft_sgemm import make_ft_sgemm
+
+        tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+        ft_static = make_ft_sgemm(tile, alpha=1.0, beta=0.0,
+                                  threshold="static")
+        ft_adaptive = make_ft_sgemm(tile, alpha=1.0, beta=0.0,
+                                    threshold="adaptive")
+        stats = _CellStats()
+        static_hits = 0
+        # Uncounted warm-up (see _train_inject).
+        wa, wb = _operands(self.seed + 999, 128, 128, 128)
+        w0 = np.zeros((128, 128), np.float32)
+        ft_static(wa, wb, w0)
+        ft_adaptive(wa, wb, w0)
+
+        for i in range(self.episodes):
+            draw = draw_episode(model, rng)
+            spec = InjectionSpec(enabled=True, every=int(draw["every"]),
+                                 magnitude=float(draw["magnitude"]),
+                                 col_stride=int(draw["col_stride"]))
+            a, b = _operands(self.seed + i, 128, 128, 128)
+            c0 = np.zeros((128, 128), np.float32)
+            r_static = ft_static(a, b, c0, spec)
+            if int(r_static.num_detected) > 0:
+                static_hits += 1
+            t0 = time.time()
+            r = ft_adaptive(a, b, c0, spec)
+            detected = int(r.num_detected) > 0
+            wall = time.time() - t0
+            det_ts = self._detection_ts(t0)
+            latency = ((det_ts - t0) if det_ts is not None
+                       else (wall if detected else None))
+            recovered = detected and int(r.num_uncorrectable) == 0
+            incorrect = bool(recovered and not np.allclose(
+                np.asarray(r.c),
+                a.astype(np.float64) @ b.astype(np.float64).T,
+                rtol=1e-3, atol=1.0))
+            stats.add_fault(
+                detected=detected, corrected=recovered,
+                recovered=recovered, latency=latency,
+                mttr=wall if recovered else None, tier="device",
+                incorrect=incorrect, wall=wall)
+            if detected and latency is not None:
+                self._note_detection(model.name, "train_step", latency)
+        for i in range(self.clean_episodes):
+            a, b = _operands(self.seed + 100 + i, 128, 128, 128)
+            c0 = np.zeros((128, 128), np.float32)
+            t0 = time.time()
+            r = ft_adaptive(a, b, c0)
+            wall = time.time() - t0
+            stats.add_clean(
+                false_positive=int(r.num_detected) > 0, wall=wall)
+        stats.extra["static_detection_rate"] = (
+            round(static_hits / self.episodes, 4))
+        return stats.finalize()
+
+    def _train_burst(self, model, rng) -> dict:
+        """multi_device_burst: correlated sub-threshold data-plane
+        corruption across one mesh row's sibling devices — invisible to
+        each device's own residual, crossed at the staged host/global
+        reduce (``tiered_ft_sgemm``). Recovery = recompute (a clean
+        re-run), so MTTR covers detection plus the rerun."""
+        from ft_sgemm_tpu.configs import KernelShape
+        from ft_sgemm_tpu.parallel.sharded import make_mesh
+        from ft_sgemm_tpu.resilience.tiers import (
+            checksum_tolerance,
+            tiered_ft_sgemm,
+        )
+
+        tile = KernelShape("t128", 128, 128, 128, (0,) * 7)
+        mesh = make_mesh(8)
+        mx, my = mesh.shape["x"], mesh.shape["y"]
+        m, n, k = 256, 128, 512
+        a, b = _operands(self.seed, m, n, k)
+        c = np.zeros((m, n), np.float32)
+        tol0 = checksum_tolerance(m // mx, k // my,
+                                  float(np.abs(a).max()),
+                                  float(np.abs(b).max()))
+        stats = _CellStats()
+
+        for i in range(self.episodes):
+            draw = draw_episode(model, rng)
+            x = int(draw["row"]) % mx
+            coord = tuple(draw["coord"])
+            corrupt = tuple(((x, y), coord, float(draw["frac"]) * tol0)
+                            for y in range(my))
+            t0 = time.time()
+            _, rep = tiered_ft_sgemm(a, b, c, mesh, tile,
+                                     registry=self.registry,
+                                     tier_corrupt=corrupt)
+            t_detect = time.time()
+            detected = rep.detected
+            det_ts = self._detection_ts(t0, ops=("data_tiers",))
+            latency = ((det_ts - t0) if det_ts is not None
+                       else ((t_detect - t0) if detected else None))
+            recovered = False
+            if detected:
+                # Recompute: the clean re-run IS the recovery path for
+                # a data-plane strike (nothing resident to repair).
+                _, rep2 = tiered_ft_sgemm(a, b, c, mesh, tile,
+                                          registry=self.registry)
+                recovered = not rep2.detected
+            wall = time.time() - t0
+            stats.add_fault(
+                detected=detected, corrected=False,
+                recovered=recovered, latency=latency,
+                mttr=wall if recovered else None,
+                tier=rep.tier if detected else None,
+                incorrect=False, wall=wall)
+            if detected and latency is not None:
+                self._note_detection(model.name, "train_step", latency)
+        for i in range(self.clean_episodes):
+            t0 = time.time()
+            _, rep = tiered_ft_sgemm(a, b, c, mesh, tile,
+                                     registry=self.registry)
+            wall = time.time() - t0
+            stats.add_clean(false_positive=rep.detected, wall=wall)
+        return stats.finalize()
+
+    def _cell_pool_evict(self, model, rng) -> dict:
+        """throughput_sag (drain) / stuck_device (evict) against the
+        health-steered device pool: the fault is health decay, detection
+        is the device leaving ``eligible()``, goodput retention is the
+        surviving placement fraction."""
+        from ft_sgemm_tpu.serve.pool import DevicePool
+
+        n_dev = 8
+        labels = tuple(f"vdev:{i}" for i in range(n_dev))
+        evict = model.name == "stuck_device"
+        stats = _CellStats()
+        surviving: list = []
+
+        for i in range(self.episodes):
+            draw = draw_episode(model, rng)
+            pool = DevicePool(labels, placement="health",
+                              drain_below=0.5)
+            idx = int(draw["device"]) % n_dev
+            t0 = time.time()
+            pool.mark_sick(idx, calls=int(draw.get("calls", 100)))
+            detected = idx not in pool.eligible()
+            t_detect = time.time()
+            latency = (t_detect - t0) if detected else None
+            recovered = detected
+            if evict and detected:
+                pool.evict(idx)
+                recovered = idx in pool.evicted
+            wall = time.time() - t0
+            surviving.append(len(pool.eligible()) / n_dev)
+            stats.add_fault(
+                detected=detected, corrected=False,
+                recovered=recovered, latency=latency,
+                mttr=wall if recovered else None,
+                tier="health" if detected else None,
+                incorrect=False, wall=wall)
+            if detected and latency is not None:
+                self._note_detection(model.name, "pool_evict", latency)
+        for i in range(self.clean_episodes):
+            pool = DevicePool(labels, placement="health",
+                              drain_below=0.5)
+            t0 = time.time()
+            ok = len(pool.eligible()) == n_dev
+            stats.add_clean(false_positive=not ok,
+                            wall=time.time() - t0)
+        stats.extra["goodput_retention"] = (
+            round(float(np.mean(surviving)), 4) if surviving else None)
+        if evict:
+            stats.extra["evictions"] = sum(
+                1 for s in surviving if s < 1.0)
+        return stats.finalize()
+
+    # -- the sweep -------------------------------------------------------
+
+    def _run_cell(self, model, workload: str) -> dict:
+        # str seeding is SHA-512-derived — deterministic across
+        # processes, unlike hash() of a str tuple.
+        rng = random.Random(f"{self.seed}:{model.name}:{workload}")
+        runner = {
+            "gemm_serve": self._cell_gemm_serve,
+            "block_serve": self._cell_block_serve,
+            "train_step": self._cell_train_step,
+            "pool_evict": self._cell_pool_evict,
+        }[workload]
+        with self._span(f"{model.name}:{workload}") as info:
+            cell = runner(model, rng)
+            if isinstance(info, dict):
+                info["value"] = {
+                    "detection_rate": cell.get("detection_rate"),
+                    "faults": cell.get("faults_injected"),
+                    "incorrect": cell.get("incorrect_results")}
+        self.registry.counter(
+            "chaos_episodes", fault_model=model.name,
+            workload=workload).inc(cell["episodes"])
+        if cell["false_positives"]:
+            self.registry.counter(
+                "chaos_false_positives", fault_model=model.name,
+                workload=workload).inc(cell["false_positives"])
+        return cell
+
+    def run(self) -> dict:
+        """Run the sweep; returns the COVERAGE artifact doc."""
+        t_start = time.time()
+        own_session = not telemetry.enabled()
+        if own_session:
+            telemetry.configure(registry=self.registry)
+        telemetry.add_observer(self._observe)
+        matrix: dict = {}
+        used_workloads: set = set()
+        try:
+            for name in self.models:
+                model = MODELS[name]
+                cells = {}
+                for workload in model.workloads:
+                    if workload not in self.workloads:
+                        continue
+                    cells[workload] = self._run_cell(model, workload)
+                    used_workloads.add(workload)
+                if not cells:
+                    continue
+                rollup = _rollup(cells)
+                spec = model.to_dict()
+                matrix[name] = {
+                    "spec": spec,
+                    "mtbf_seconds": spec["mtbf_seconds"],
+                    "cells": cells,
+                    "rollup": rollup,
+                    "policy": _policy.recommend(spec, rollup),
+                }
+                self.registry.gauge(
+                    "coverage_detection_rate", fault_model=name).set(
+                    rollup.get("detection_rate") or 0.0)
+                self.registry.gauge(
+                    "coverage_goodput_retention", fault_model=name).set(
+                    rollup.get("goodput_retention") or 0.0)
+        finally:
+            telemetry.remove_observer(self._observe)
+            if own_session:
+                telemetry.disable()
+
+        rates = [m["rollup"]["detection_rate"] for m in matrix.values()
+                 if m["rollup"].get("detection_rate") is not None]
+        overall = round(float(np.mean(rates)), 4) if rates else None
+        return {
+            "schema": COVERAGE_SCHEMA,
+            "metric": "chaos_coverage",
+            "value": overall,
+            "unit": "rate",
+            "vs_baseline": None,
+            "context": {
+                "chaos": {
+                    "models": matrix,
+                    "workloads": sorted(used_workloads),
+                    "seed": self.seed,
+                    "episodes": self.episodes,
+                    "clean_episodes": self.clean_episodes,
+                    "wall_seconds": round(time.time() - t_start, 3),
+                },
+            },
+        }
+
+
+def _rollup(cells: dict) -> dict:
+    """Per-model rollup across workload cells — worst case on purpose
+    (a model 'covered' only where it is easiest is not covered)."""
+    def vals(key):
+        return [c[key] for c in cells.values()
+                if c.get(key) is not None]
+
+    def worst_min(key):
+        v = vals(key)
+        return min(v) if v else None
+
+    def worst_max(key):
+        v = vals(key)
+        return max(v) if v else None
+
+    tiers: dict = {}
+    for c in cells.values():
+        for t, n in (c.get("tier_of_detection") or {}).items():
+            tiers[t] = tiers.get(t, 0) + n
+    p95s = [c["detection_latency_seconds"]["p95"]
+            for c in cells.values()
+            if c.get("detection_latency_seconds")]
+    rollup = {
+        "detection_rate": worst_min("detection_rate"),
+        "correction_rate": worst_min("correction_rate"),
+        "p95_detection_latency_seconds": (max(p95s) if p95s else None),
+        "mttr_seconds": worst_max("mttr_seconds"),
+        "false_positive_rate": worst_max("false_positive_rate"),
+        "goodput_retention": worst_min("goodput_retention"),
+        "incorrect_results": sum(vals("incorrect_results")),
+        "tier_of_detection": tiers,
+    }
+    static = vals("static_detection_rate")
+    if static:
+        rollup["static_detection_rate"] = min(static)
+    return rollup
+
+
+def _operands(seed: int, m: int, n: int, k: int):
+    from ft_sgemm_tpu.utils.matrices import generate_random_matrix
+
+    rng = np.random.default_rng(seed)
+    return (generate_random_matrix(m, k, rng=rng),
+            generate_random_matrix(n, k, rng=rng))
+
+
+def run_campaign(**kwargs) -> dict:
+    """One-call convenience: build a :class:`ChaosCampaign`, run it,
+    return the COVERAGE artifact doc."""
+    return ChaosCampaign(**kwargs).run()
+
+
+def render_coverage(doc: dict) -> str:
+    """Human rendering of a COVERAGE artifact doc (``cli coverage``)."""
+    chaos = (doc.get("context") or {}).get("chaos") or {}
+    models = chaos.get("models") or {}
+    lines = [
+        f"chaos coverage: {len(models)} models x"
+        f" {len(chaos.get('workloads') or [])} workloads"
+        f"  (overall detection {doc.get('value')})",
+        f"{'model':<20s} {'workload':<12s} {'det':>5s} {'corr':>5s}"
+        f" {'p95 lat':>9s} {'mttr':>8s} {'fp':>5s} {'goodput':>8s}"
+        f"  tier",
+    ]
+
+    def fmt(v, pat="{:.2f}", none="-"):
+        return pat.format(v) if isinstance(v, (int, float)) else none
+
+    for name, entry in models.items():
+        for workload, cell in (entry.get("cells") or {}).items():
+            lat = (cell.get("detection_latency_seconds") or {})
+            tiers = ",".join(
+                f"{t}:{n}" for t, n in sorted(
+                    (cell.get("tier_of_detection") or {}).items()))
+            lines.append(
+                f"{name:<20s} {workload:<12s}"
+                f" {fmt(cell.get('detection_rate')):>5s}"
+                f" {fmt(cell.get('correction_rate')):>5s}"
+                f" {fmt(lat.get('p95'), '{:.4f}'):>9s}"
+                f" {fmt(cell.get('mttr_seconds'), '{:.3f}'):>8s}"
+                f" {fmt(cell.get('false_positive_rate')):>5s}"
+                f" {fmt(cell.get('goodput_retention')):>8s}"
+                f"  {tiers or '-'}")
+        pol = entry.get("policy") or {}
+        lines.append(
+            f"{'':<20s} policy: every={pol.get('check_every')}"
+            f" threshold={pol.get('threshold_mode')}"
+            f" tiers={pol.get('tier_config')}"
+            f" evict={pol.get('evict')}")
+    return "\n".join(lines)
+
+
+__all__ = ["COVERAGE_SCHEMA", "ChaosCampaign", "render_coverage",
+           "run_campaign"]

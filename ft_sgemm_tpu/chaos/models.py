@@ -1,0 +1,219 @@
+"""Fault models as first-class specs: the chaos campaign's declaration
+layer.
+
+Every detection/recovery knob in the repro was validated against its
+OWN synthetic fault (the injection spec against the kernels, the tier
+corruptor against the staged reduce, ``mark_sick`` against the pool...),
+so "handles faults" really meant "handles the fault each subsystem
+injects for itself". This module declares a SHARED family of fault
+models — each a :class:`FaultModel` naming its site, magnitude
+distribution, and temporal process — that the campaign runner
+(``chaos/campaign.py``) compiles onto the EXISTING actuators
+(:class:`~ft_sgemm_tpu.injection.InjectionSpec`, ``tier_corrupt``,
+``BlockEngine.corrupt_kv``, ``DevicePool.mark_sick``); no kernel
+changes, no new injection machinery.
+
+``FAULT_MODELS`` is the runtime spelling of ``contracts.FAULT_MODELS``
+(the BLOCK_PHASES import-free mirror discipline; the lint axis-drift
+pass cross-checks this tuple, the contracts declaration, and
+``events.AXIS_LABELS["fault_model"]`` against each other).
+
+HARD CONSTRAINT — stdlib only, no package-relative imports
+(``contracts.STDLIB_ONLY_MODULES`` lists this file): every draw is a
+plain dict of actuator parameters; the campaign (which may import jax)
+materializes them. Seeded determinism is the contract: the same
+``random.Random(seed)`` produces the same episode schedule, so a
+coverage regression is a CODE change, never draw noise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional, Tuple
+
+# Mirror of contracts.FAULT_MODELS (lint-cross-checked; keep literal).
+FAULT_MODELS = ("bit_flip", "stuck_device", "multi_device_burst",
+                "residual_drift", "kv_rot", "throughput_sag")
+
+# The campaign's workload axis (not a lint-declared axis: workloads are
+# harness names, not event labels — they ride ``extra["workload"]``).
+WORKLOADS = ("gemm_serve", "block_serve", "train_step", "pool_evict")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultModel:
+    """One declarative fault model.
+
+    ``site`` names WHERE the fault physically lands (accumulator
+    element, whole device, mesh-wide data plane, stored KV page, device
+    health); ``actuator`` names WHICH existing injection knob realizes
+    it; ``workloads`` lists the campaign harnesses that exercise it.
+    ``magnitude`` is ``(kind, lo, hi)`` — ``"absolute"`` draws a raw
+    value, ``"tolerance"`` draws a multiple of the workload's detection
+    tolerance (how sub-threshold models like the burst and the drift
+    stay sub-threshold at any operand scale). ``temporal`` is the
+    arrival process: ``"transient"`` (one upset per episode),
+    ``"persistent"`` (present on every attempt until evicted/repaired),
+    ``"burst"`` (one correlated multi-site instant), ``"drift"``
+    (a slow creep below the static threshold). ``rate_per_hour`` is the
+    model's assumed field arrival rate — the MTBF prior the policy
+    picker scales by measured goodput (DESIGN.md §20).
+    ``correctable`` marks models whose faults the existing machinery
+    must CORRECT (not merely detect) — the CI grep pins their measured
+    detection rate at 1.0.
+    """
+
+    name: str
+    site: str
+    actuator: str
+    workloads: Tuple[str, ...]
+    magnitude: Tuple
+    temporal: str
+    rate_per_hour: float
+    correctable: bool
+    description: str
+
+    def __post_init__(self):
+        if self.name not in FAULT_MODELS:
+            raise ValueError(
+                f"FaultModel.name={self.name!r} must be one of"
+                f" {FAULT_MODELS} (contracts.FAULT_MODELS is the"
+                " declared axis)")
+        for w in self.workloads:
+            if w not in WORKLOADS:
+                raise ValueError(
+                    f"FaultModel {self.name}: unknown workload {w!r}"
+                    f" (must be one of {WORKLOADS})")
+        if self.rate_per_hour <= 0:
+            raise ValueError(
+                f"FaultModel {self.name}: rate_per_hour"
+                f" {self.rate_per_hour} must be > 0")
+
+    def mtbf_seconds(self) -> float:
+        """The model's prior mean-time-between-faults."""
+        return 3600.0 / self.rate_per_hour
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["workloads"] = list(self.workloads)
+        d["magnitude"] = list(self.magnitude)
+        d["mtbf_seconds"] = self.mtbf_seconds()
+        return d
+
+
+def _uniform(rng: random.Random, lo: float, hi: float) -> float:
+    return lo + (hi - lo) * rng.random()
+
+
+def draw_episode(model: FaultModel, rng: random.Random) -> dict:
+    """One seeded episode's actuator parameters, as a plain dict.
+
+    Deterministic given the ``random.Random`` state — the campaign
+    feeds one shared stream per (model, workload) cell, so episode i of
+    cell (m, w) draws identically across runs with the same seed.
+    Dict keys are actuator-specific; the campaign's harnesses consume
+    them (``magnitude``/``every``/``col_stride`` for the injection
+    spec, ``frac`` for tolerance-relative data-plane strikes, ``row``/
+    ``col``/``which`` for KV pages, ``device``/``calls`` for health).
+    """
+    kind, lo, hi = model.magnitude
+    mag = _uniform(rng, float(lo), float(hi))
+    if model.name == "bit_flip":
+        return {"actuator": model.actuator, "magnitude": mag,
+                "every": 1, "col_stride": 61}
+    if model.name == "stuck_device":
+        # col_stride=0 pins every fault to one column — the adversarial
+        # schedule that defeats per-column localization (persistent).
+        return {"actuator": model.actuator, "magnitude": mag,
+                "every": 1, "col_stride": 0,
+                "device": rng.randrange(8)}
+    if model.name == "multi_device_burst":
+        # Correlated sub-threshold strike: one mesh row, every sibling
+        # device, each below the device tolerance (frac < 1) so only a
+        # staged (host/global) reduce crosses threshold.
+        return {"actuator": model.actuator, "frac": mag,
+                "row": rng.randrange(2), "coord": (1, 3)}
+    if model.name == "residual_drift":
+        # Far below the shipped static threshold, far above the
+        # in-kernel adaptive (variance-scaled) bound.
+        return {"actuator": model.actuator, "magnitude": mag,
+                "every": 1, "col_stride": 61}
+    if model.name == "kv_rot":
+        return {"actuator": model.actuator, "magnitude": mag,
+                "row": rng.randrange(8), "col": rng.randrange(8),
+                "which": "k" if rng.random() < 0.5 else "v"}
+    if model.name == "throughput_sag":
+        return {"actuator": model.actuator,
+                "device": rng.randrange(8),
+                "calls": int(round(mag))}
+    raise ValueError(f"unknown fault model {model.name!r}")
+
+
+def _build_models() -> dict:
+    return {m.name: m for m in (
+        FaultModel(
+            name="bit_flip", site="accumulator",
+            actuator="injection_spec",
+            workloads=("gemm_serve", "train_step"),
+            magnitude=("absolute", 8000.0, 12000.0),
+            temporal="transient", rate_per_hour=60.0, correctable=True,
+            description=("transient single accumulator upset — the"
+                         " reference's SDC; in-kernel located and"
+                         " corrected, zero retries")),
+        FaultModel(
+            name="stuck_device", site="device",
+            actuator="injection_spec",
+            workloads=("train_step", "pool_evict"),
+            magnitude=("absolute", 8000.0, 12000.0),
+            temporal="persistent", rate_per_hour=0.2, correctable=False,
+            description=("persistent same-column fault pinned to one"
+                         " device — defeats per-column localization,"
+                         " survives retries; the eviction path")),
+        FaultModel(
+            name="multi_device_burst", site="mesh",
+            actuator="tier_corrupt",
+            workloads=("train_step",),
+            magnitude=("tolerance", 0.85, 0.95),
+            temporal="burst", rate_per_hour=1.0, correctable=False,
+            description=("correlated sub-threshold corruption across"
+                         " sibling devices in one instant — invisible"
+                         " per device, crosses threshold at the staged"
+                         " host/global reduce")),
+        FaultModel(
+            name="residual_drift", site="accumulator",
+            actuator="injection_spec",
+            workloads=("train_step",),
+            magnitude=("absolute", 200.0, 600.0),
+            temporal="drift", rate_per_hour=6.0, correctable=True,
+            description=("slow sub-static-threshold residual creep —"
+                         " the adaptive-threshold motivation (arXiv"
+                         " 2602.08043): static misses it, the"
+                         " variance-scaled bound catches it")),
+        FaultModel(
+            name="kv_rot", site="kv_page",
+            actuator="kv_corrupt",
+            workloads=("block_serve",),
+            magnitude=("absolute", 500.0, 2000.0),
+            temporal="transient", rate_per_hour=12.0, correctable=True,
+            description=("stored KV-cache page corruption at rest —"
+                         " caught by the page checksum rows on the"
+                         " next decode read, corrected in place")),
+        FaultModel(
+            name="throughput_sag", site="health",
+            actuator="mark_sick",
+            workloads=("pool_evict",),
+            magnitude=("absolute", 100.0, 200.0),
+            temporal="drift", rate_per_hour=0.5, correctable=False,
+            description=("DVFS-style per-device degradation — no data"
+                         " corruption; the health tracker's score"
+                         " collapses and placement drains the"
+                         " device")),
+    )}
+
+
+MODELS = _build_models()
+
+
+__all__ = ["FAULT_MODELS", "WORKLOADS", "FaultModel", "MODELS",
+           "draw_episode"]

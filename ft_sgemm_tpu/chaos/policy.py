@@ -1,0 +1,123 @@
+"""MTBF-driven policy selection: measured coverage -> recommended knobs.
+
+The campaign measures, per fault model, what was detected, where, how
+fast, and at what cost (``chaos/campaign.py``). This module turns those
+measurements plus the model's MTBF into the three knobs the rest of the
+stack already exposes, with the derivation recorded next to the number
+(DESIGN.md §20):
+
+- **check cadence** (``check_every``, the PR-13 searched axis): the
+  detect/correct check costs overhead ~ 1/every per step, while a
+  sparser cadence widens the detection window and with it the expected
+  rework after a fault (window x fault rate x MTTR). Minimizing
+  ``c/every + every * window_cost / mtbf`` gives the square-root law
+  ``every* ~ sqrt(mtbf)`` — MONOTONE in MTBF: rarer faults buy sparser
+  (cheaper) checking. We use the measured detection window (p95
+  detection latency, floored by MTTR) as the per-fault cost unit.
+- **threshold mode** (the PR-7 static/adaptive tradeoff): adaptive
+  wins exactly when the model's measured static detection rate falls
+  below its adaptive rate (the residual-drift case) — otherwise static
+  is free and recommended.
+- **tier config**: hierarchical data-plane checks are worth their
+  collectives only for models whose measured tier-of-detection
+  includes host/global findings (per-device checks would have missed
+  them); eviction is recommended for persistent/degradation models.
+
+HARD CONSTRAINT — stdlib only, no package-relative imports
+(``contracts.STDLIB_ONLY_MODULES`` lists this file): inputs are the
+plain dicts the campaign emits, so the policy layer runs in the
+jax-free supervisor and in tests without building any workload.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+# Cadence clamp: every=1 is the densest legal detect/correct cadence;
+# 64 K-steps is the sparsest any shipped grid sustains (beyond it the
+# check never runs on small problems).
+MIN_CHECK_EVERY = 1
+MAX_CHECK_EVERY = 64
+
+# The overhead unit: measured PR-13 cadence sweeps put one
+# detect/correct check at ~1% of a K-step's MXU work, so the
+# square-root law is scaled such that an MTBF of ~1 minute of calls
+# still checks densely while multi-hour MTBFs saturate the clamp.
+CHECK_COST_SECONDS = 0.01
+
+
+def recommend_cadence(mtbf_seconds: float,
+                      window_seconds: Optional[float] = None) -> int:
+    """The square-root-law cadence for one measured model.
+
+    ``every* = sqrt(mtbf / window)`` scaled by the check-cost unit,
+    clamped to the legal range. ``window_seconds`` is the measured
+    per-fault cost (p95 detection latency floored by MTTR); None or
+    non-positive falls back to 1s — the clamp still guarantees
+    monotonicity in MTBF, the property the tests pin.
+    """
+    if mtbf_seconds <= 0:
+        return MIN_CHECK_EVERY
+    window = window_seconds if window_seconds and window_seconds > 0 \
+        else 1.0
+    every = math.sqrt(mtbf_seconds * CHECK_COST_SECONDS / window * 100.0)
+    return max(MIN_CHECK_EVERY, min(MAX_CHECK_EVERY, int(round(every))))
+
+
+def recommend(model: dict, rollup: dict) -> dict:
+    """The per-model policy: (cadence, threshold mode, tier config)
+    with its measured justification.
+
+    ``model`` is a :meth:`FaultModel.to_dict` dict (``mtbf_seconds``,
+    ``temporal``, ``correctable``); ``rollup`` is the campaign's
+    per-model rollup (``p95_detection_latency_seconds``,
+    ``mttr_seconds``, ``detection_rate``, ``static_detection_rate``
+    when the cell A/B'd threshold modes, ``tier_of_detection``).
+    Returns a plain dict recorded verbatim in COVERAGE.json.
+    """
+    mtbf = float(model.get("mtbf_seconds") or 0.0)
+    p95 = rollup.get("p95_detection_latency_seconds")
+    mttr = rollup.get("mttr_seconds")
+    window = max(float(p95 or 0.0), float(mttr or 0.0)) or None
+    every = recommend_cadence(mtbf, window)
+
+    det = rollup.get("detection_rate")
+    static_det = rollup.get("static_detection_rate")
+    adaptive = (static_det is not None and det is not None
+                and float(static_det) < float(det))
+    threshold_mode = "adaptive" if adaptive else "static"
+
+    tiers = dict(rollup.get("tier_of_detection") or {})
+    staged = (tiers.get("host", 0) or 0) + (tiers.get("global", 0) or 0)
+    tier_config = "tiered" if staged > 0 else "device"
+    evict = model.get("temporal") in ("persistent", "drift") \
+        and not model.get("correctable", False)
+
+    just = [f"mtbf={mtbf:.0f}s"]
+    if window is not None:
+        just.append(f"detect_window={window:.3f}s")
+    just.append(f"sqrt-law cadence every={every}")
+    if adaptive:
+        just.append(
+            f"static detection {float(static_det):.2f} <"
+            f" adaptive {float(det):.2f} -> adaptive threshold")
+    else:
+        just.append("static threshold sufficient at measured rates")
+    if staged:
+        just.append(
+            f"{staged} host/global-tier detections -> tiered checks")
+    if evict:
+        just.append("persistent/degradation model -> eviction enabled")
+
+    return {
+        "check_every": every,
+        "threshold_mode": threshold_mode,
+        "tier_config": tier_config,
+        "evict": bool(evict),
+        "justification": "; ".join(just),
+    }
+
+
+__all__ = ["MAX_CHECK_EVERY", "MIN_CHECK_EVERY", "recommend",
+           "recommend_cadence"]

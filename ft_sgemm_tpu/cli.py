@@ -69,6 +69,12 @@ Usage:
     python -m ft_sgemm_tpu.cli drill [--smoke] [--evict-device=N] \
         [--requests=N] [--buckets=128,256] [--telemetry=LOG.jsonl] \
         [--out=ARTIFACT.json]
+    python -m ft_sgemm_tpu.cli chaos [--smoke] [--models=a,b] \
+        [--episodes=N] [--clean-episodes=N] [--seed=N] \
+        [--coverage-out=COVERAGE.json] [--out=ARTIFACT.json] \
+        [--telemetry=LOG.jsonl] [--timeline=RUN.timeline.jsonl]
+    python -m ft_sgemm_tpu.cli coverage COVERAGE.json \
+        [--format=text|json]
     python -m ft_sgemm_tpu.cli fleet [--procs=2] [--vdevs=4] \
         [--program=smoke|counters|noop|wedge] [--deadline=SECONDS] \
         [--workdir=DIR]
@@ -248,7 +254,23 @@ panel-recompute flops ratio, and emits the artifact line whose
 ``recovery.*`` facts the run ledger ingests (``cli trend`` then gates
 recovery health longitudinally). Exit 0 iff evicted (not just
 drained), zero incorrect/lost responses, nothing placed on the evicted
-device afterward, and goodput recovered past 0.7x baseline. The ring collective paths' hop schedule is the related
+device afterward, and goodput recovered past 0.7x baseline.
+
+``chaos`` runs the chaos campaign (``ft_sgemm_tpu.chaos``, DESIGN.md
+§20): every declared fault model (``contracts.FAULT_MODELS``) compiled
+onto the existing actuators and swept across its workloads — GEMM
+serve, block serve with the checked KV cache, ``resilient_step``, and
+the health-steered pool — measuring per cell the detection rate,
+injection-to-event detection latency (the
+``fault_detection_latency_seconds`` histogram), tier-of-detection,
+correction rate, MTTR, clean-twin false-positive rate, and goodput
+retention. Prints the coverage table, emits the ``chaos_coverage``
+artifact line (``--out=`` for ledger ingestion; ``cli trend`` then
+gates per-model ``chaos.*`` regressions), and writes the full matrix
+to ``--coverage-out=``. Exit 0 iff every swept model measured a
+detection rate, every CORRECTABLE model detected at rate 1.0, and no
+cell produced an incorrect result or a clean-twin false positive.
+``coverage`` re-renders a saved COVERAGE.json. The ring collective paths' hop schedule is the related
 ``ring_overlap`` axis (``--ring-overlap=serial|overlap`` on the ring
 entry points; ``tune-ring`` searches it — wall-timed on TPU, priced by
 the compute/ICI cost model elsewhere — and banks the winner the
@@ -1797,6 +1819,137 @@ def run_drill(flags, out=None) -> int:
     return 0 if stats.get("ok") else 1
 
 
+def chaos_verdict(doc) -> bool:
+    """The campaign's pass predicate (shared by ``cli chaos`` and
+    ``bench.py --chaos``): every swept model measured a detection rate,
+    every CORRECTABLE model detected at 1.0, and no cell produced an
+    incorrect result or a clean-twin false positive.
+    """
+    models = ((doc.get("context") or {}).get("chaos") or {}).get(
+        "models") or {}
+    if not models:
+        return False
+    for entry in models.values():
+        rollup = entry.get("rollup") or {}
+        det = rollup.get("detection_rate")
+        if det is None:
+            return False
+        if (entry.get("spec") or {}).get("correctable") and det < 1.0:
+            return False
+        if rollup.get("incorrect_results"):
+            return False
+        if rollup.get("false_positive_rate"):
+            return False
+    return True
+
+
+def run_chaos(flags, out=None) -> int:
+    """``chaos`` subcommand: the fault-model coverage campaign
+    (DESIGN.md §20).
+
+    Runs :class:`ft_sgemm_tpu.chaos.ChaosCampaign` over the selected
+    fault models (default: all of ``contracts.FAULT_MODELS``) and
+    prints the coverage table plus the ``chaos_coverage`` artifact line
+    (``--out=`` writes it for ledger ingestion; ``--coverage-out=``
+    writes the full COVERAGE.json matrix). ``--smoke`` shrinks to 2
+    faulted + 1 clean episodes per cell. Exit per
+    :func:`chaos_verdict`.
+    """
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    kw = {}
+    out_path = None
+    coverage_path = None
+    telemetry_log = None
+    tl_path = None
+    try:
+        for f in flags:
+            if f.startswith("--models="):
+                kw["models"] = tuple(
+                    v for v in f.split("=", 1)[1].split(",") if v)
+            elif f.startswith("--episodes="):
+                kw["episodes"] = int(f.split("=", 1)[1])
+            elif f.startswith("--clean-episodes="):
+                kw["clean_episodes"] = int(f.split("=", 1)[1])
+            elif f.startswith("--seed="):
+                kw["seed"] = int(f.split("=", 1)[1])
+            elif f.startswith("--out="):
+                out_path = f.split("=", 1)[1]
+            elif f.startswith("--coverage-out="):
+                coverage_path = f.split("=", 1)[1]
+            elif f.startswith("--telemetry="):
+                telemetry_log = f.split("=", 1)[1]
+            elif f.startswith("--timeline="):
+                tl_path = f.split("=", 1)[1]
+    except ValueError as e:
+        print(f"ft_sgemm: chaos: {e}", file=sys.stderr)
+        return 2
+    if "--smoke" in flags:
+        kw.setdefault("episodes", 2)
+        kw.setdefault("clean_episodes", 1)
+    if telemetry_log:
+        from ft_sgemm_tpu import telemetry
+
+        telemetry.configure(telemetry_log, log_clean=True)
+        kw["registry"] = telemetry.get_registry()
+    recorder = None
+    if tl_path:
+        from ft_sgemm_tpu.telemetry.timeline import TimelineRecorder
+
+        recorder = TimelineRecorder(tl_path)
+        kw["timeline"] = recorder
+    print_device_info(out=sys.stderr)
+    from ft_sgemm_tpu.chaos.campaign import (
+        ChaosCampaign,
+        render_coverage,
+    )
+
+    try:
+        doc = ChaosCampaign(**kw).run()
+    except ValueError as e:
+        print(f"ft_sgemm: chaos: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if recorder is not None:
+            recorder.close()
+        if telemetry_log:
+            from ft_sgemm_tpu import telemetry
+
+            telemetry.disable()
+    print(render_coverage(doc), file=out)
+    line = _json.dumps(doc)
+    print(line, file=out, flush=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    if coverage_path:
+        with open(coverage_path, "w", encoding="utf-8") as fh:
+            _json.dump(doc, fh, indent=1)
+            fh.write("\n")
+    return 0 if chaos_verdict(doc) else 1
+
+
+def run_coverage(path, fmt="text", out=None) -> int:
+    """``coverage`` subcommand: re-render a saved COVERAGE.json."""
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = _json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"ft_sgemm: coverage: {path}: {e}", file=sys.stderr)
+        return 2
+    if fmt == "json":
+        print(_json.dumps(doc, indent=1), file=out)
+        return 0
+    from ft_sgemm_tpu.chaos.campaign import render_coverage
+
+    print(render_coverage(doc), file=out)
+    return 0
+
+
 def run_fleet(flags, out=None) -> int:
     """``fleet`` subcommand: launch a real multi-process fleet.
 
@@ -2119,6 +2272,21 @@ def main(argv=None) -> int:
         return run_serve_bench_cmd(flags)
     if args and args[0] == "drill":
         return run_drill(flags)
+    if args and args[0] == "chaos":
+        return run_chaos(flags)
+    if args and args[0] == "coverage":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        fmt = "text"
+        for f in flags:
+            if f.startswith("--format="):
+                fmt = f.split("=", 1)[1]
+                if fmt not in ("text", "json"):
+                    print(f"--format must be text or json, got {fmt!r}",
+                          file=sys.stderr)
+                    return 2
+        return run_coverage(args[1], fmt=fmt)
     if args and args[0] == "fleet":
         return run_fleet(flags)
     if args and args[0] == "history":

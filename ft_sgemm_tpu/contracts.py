@@ -27,6 +27,8 @@ from __future__ import annotations
 # subsystem's import-graph pass enforces all three statically; the CI
 # smoke proves it dynamically. Paths are repo-relative.
 STDLIB_ONLY_MODULES = (
+    "ft_sgemm_tpu/chaos/models.py",
+    "ft_sgemm_tpu/chaos/policy.py",
     "ft_sgemm_tpu/contracts.py",
     "ft_sgemm_tpu/fleet/launch.py",
     "ft_sgemm_tpu/lint/core.py",
@@ -176,6 +178,29 @@ HOST_TIERS = ("local", "dcn")
 # 2112.09017 panel asymmetry as a placement cost term; "round_robin"
 # ignores distance and health (the A/B control).
 FLEET_PLACEMENTS = ("dcn_cost", "round_robin")
+
+# --- chaos campaign fault models ----------------------------------------
+#
+# The declarative fault-model axis of the chaos campaign plane
+# (``chaos/models.py::FAULT_MODELS`` is the runtime spelling of the same
+# declaration — the BLOCK_PHASES import-free mirror discipline;
+# ``events.AXIS_LABELS["fault_model"]`` mirrors this tuple and the lint
+# axis-drift pass cross-checks all three). Every campaign cell, coverage
+# row, and ``chaos.<model>.*`` ledger measurement is keyed by one of
+# these spellings:
+#   bit_flip            transient single accumulator upset (in-kernel
+#                       correctable — the reference's SDC)
+#   stuck_device        persistent same-column fault pinned to one
+#                       device (defeats localization; eviction path)
+#   multi_device_burst  correlated sub-threshold corruption across
+#                       devices in one instant (host/global tiers)
+#   residual_drift      slow sub-static-threshold residual creep (the
+#                       adaptive-threshold motivation, arXiv 2602.08043)
+#   kv_rot              stored KV-cache page corruption at rest
+#   throughput_sag      DVFS-style per-device slowdown/health decay
+#                       (no data corruption; the health plane's model)
+FAULT_MODELS = ("bit_flip", "stuck_device", "multi_device_burst",
+                "residual_drift", "kv_rot", "throughput_sag")
 
 # --- kernel-axis declaration sources -----------------------------------
 #

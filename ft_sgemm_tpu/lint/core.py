@@ -74,6 +74,7 @@ TIMELINE_PATH = "ft_sgemm_tpu/telemetry/timeline.py"
 REGISTRY_PATH = "ft_sgemm_tpu/telemetry/registry.py"
 BUCKETS_PATH = "ft_sgemm_tpu/serve/buckets.py"
 CLI_PATH = "ft_sgemm_tpu/cli.py"
+CHAOS_MODELS_PATH = "ft_sgemm_tpu/chaos/models.py"
 
 DEFAULT_ALLOWLIST = "lint-allowlist.json"
 
@@ -87,7 +88,8 @@ THREADED_MODULES = ("ft_sgemm_tpu/serve/engine.py",
                     "ft_sgemm_tpu/resilience/elastic.py",
                     "ft_sgemm_tpu/telemetry/monitor.py",
                     "ft_sgemm_tpu/fleet/dispatch.py",
-                    "ft_sgemm_tpu/fleet/worker.py")
+                    "ft_sgemm_tpu/fleet/worker.py",
+                    "ft_sgemm_tpu/chaos/campaign.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -286,6 +288,7 @@ class Declarations:
         self.host_tiers = tuple(contracts.get("HOST_TIERS", ()))
         self.fleet_placements = tuple(
             contracts.get("FLEET_PLACEMENTS", ()))
+        self.fault_models = tuple(contracts.get("FAULT_MODELS", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -510,6 +513,7 @@ AXIS_VAR_SETS = {
     "ladder_rung": "ladder_rungs",
     "host_tier": "host_tiers",
     "fleet_placement": "fleet_placements",
+    "fault_model": "fault_models",
 }
 
 
@@ -760,6 +764,11 @@ def check_axis_drift(repo: Repo, decls: Declarations):
         mirror["host_tier"] = decls.host_tiers
     if decls.fleet_placements:
         mirror["fleet_placement"] = decls.fleet_placements
+    # The chaos-campaign fault-model axis (PR 19): contracts-direct like
+    # the serve/recovery/fleet planes (chaos/models.py holds the runtime
+    # spelling).
+    if decls.fault_models:
+        mirror["fault_model"] = decls.fault_models
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -768,6 +777,20 @@ def check_axis_drift(repo: Repo, decls: Declarations):
         if decls.axis_labels and have != tuple(want):
             f(EVENTS_PATH, 1, f"AXIS_LABELS[{axis}]",
               f"telemetry labels {have} != configs declaration {want}")
+
+    # (4b) the chaos runtime spelling mirrors contracts exactly: the
+    # fault-model axis is declared three times on purpose (contracts,
+    # AXIS_LABELS — both checked above — and chaos/models.py, the only
+    # copy the campaign imports); drift in the runtime copy is a
+    # finding too.
+    chaos_tree = repo.tree(CHAOS_MODELS_PATH)
+    if decls.fault_models and chaos_tree is not None:
+        runtime = tuple(
+            module_literals(chaos_tree).get("FAULT_MODELS", ()))
+        if runtime != decls.fault_models:
+            f(CHAOS_MODELS_PATH, 1, "FAULT_MODELS",
+              f"runtime fault-model spelling {runtime} !="
+              f" contracts.FAULT_MODELS {decls.fault_models}")
 
     # (5) serve routing reads the hoisted tables.
     btree = repo.tree(BUCKETS_PATH)
@@ -830,7 +853,8 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                      "recovery_tier": set(decls.recovery_tiers),
                      "ladder_rung": set(decls.ladder_rungs),
                      "host_tier": set(decls.host_tiers),
-                     "fleet_placement": set(decls.fleet_placements)}
+                     "fleet_placement": set(decls.fleet_placements),
+                     "fault_model": set(decls.fault_models)}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

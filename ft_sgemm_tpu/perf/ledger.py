@@ -64,7 +64,8 @@ from typing import List, Optional
 
 SCHEMA_VERSION = 1
 
-KINDS = ("bench", "smoke", "serve", "multichip", "baseline", "unknown")
+KINDS = ("bench", "smoke", "serve", "multichip", "baseline", "chaos",
+         "unknown")
 
 # Measurement keys whose value is seconds (lower is better) vs
 # throughput (higher is better) — the goodness convention compare.py
@@ -136,6 +137,10 @@ def _infer_kind(doc: dict, ctx: dict, source: Optional[str]) -> str:
     if "n_devices" in doc and "metric" not in doc:
         return "multichip"
     metric = doc.get("metric")
+    # The chaos campaign's coverage artifact (ISSUE 19): identified by
+    # its metric or the context.chaos matrix.
+    if metric == "chaos_coverage" or isinstance(ctx.get("chaos"), dict):
+        return "chaos"
     # serve before smoke: a `--serve --smoke` artifact carries both
     # context flags, and the serve identity is the meaningful one.
     # Both serve workloads land here (gemm requests/s, block tokens/s).
@@ -383,6 +388,42 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
                 "global_tier", "staged_equals_flat", "host_blames",
                 "reshard")
         entry["fleet"] = {k: fleet.get(k) for k in keep if k in fleet}
+    # Chaos campaign (ISSUE 19): the per-model coverage rollups land as
+    # chaos.<model>.* measurements so `cli trend --gate` fails a fault
+    # model whose detection/correction rate or goodput retention
+    # regresses (or whose detection latency / MTTR / false-positive
+    # rate creeps up). Same lint.*/recovery.* stance: OUTSIDE
+    # extract_measurements (the compare.extract_stages mirror pin
+    # stands; a coverage matrix is not an A/B-comparable GEMM stage).
+    # Categorical facts — tier-of-detection and the policy picks — ride
+    # the entry body.
+    chaos = ctx.get("chaos")
+    if isinstance(chaos, dict) and isinstance(chaos.get("models"), dict):
+        keep_chaos = {}
+        for name, model_entry in chaos["models"].items():
+            if not isinstance(model_entry, dict):
+                continue
+            rollup = model_entry.get("rollup")
+            if not isinstance(rollup, dict):
+                continue
+            for key, hib in (
+                    ("detection_rate", True),
+                    ("correction_rate", True),
+                    ("goodput_retention", True),
+                    ("p95_detection_latency_seconds", False),
+                    ("mttr_seconds", False),
+                    ("false_positive_rate", False),
+                    ("incorrect_results", False)):
+                s = _measurement(rollup.get(key), higher_is_better=hib)
+                if s:
+                    entry["measurements"][f"chaos.{name}.{key}"] = s
+            keep_chaos[name] = {
+                "tier_of_detection": rollup.get("tier_of_detection"),
+                "policy": model_entry.get("policy"),
+                "mtbf_seconds": model_entry.get("mtbf_seconds"),
+            }
+        if keep_chaos:
+            entry["chaos"] = keep_chaos
 
     if entry["kind"] == "multichip" and not entry["measurements"] \
             and entry["value"] is None:

@@ -102,6 +102,13 @@ AXIS_LABELS = {
     # the runtime spelling); rides fleet timeline points and dispatch
     # event extras.
     "fleet_placement": ("dcn_cost", "round_robin"),
+    # Chaos-campaign fault model (PR 19) — mirrors
+    # contracts.FAULT_MODELS (chaos/models.py::FAULT_MODELS is the
+    # runtime spelling; the lint axis-drift pass cross-checks all
+    # three). Rides ``extra["fault_model"]`` on campaign events and
+    # labels the ``fault_detection_latency_seconds`` histogram.
+    "fault_model": ("bit_flip", "stuck_device", "multi_device_burst",
+                    "residual_drift", "kv_rot", "throughput_sag"),
 }
 
 
@@ -292,13 +299,31 @@ def registry_from_events(events: Iterable[FaultEvent]):
     ``extra`` carries a ``latency_seconds`` observation — the
     ``serve_latency_seconds`` histogram the engine records live, so one
     request log exports the same p50/p99-bearing series the in-process
-    registry held (no parallel stats path)."""
+    registry held (no parallel stats path). Chaos campaign events whose
+    ``extra`` carries ``detection_latency_seconds`` (labeled by
+    ``fault_model``) rebuild the ``fault_detection_latency_seconds``
+    histogram under the same discipline."""
     from ft_sgemm_tpu.telemetry.registry import (
         LATENCY_BUCKETS, MetricsRegistry)
 
     reg = MetricsRegistry()
     call_outcomes = ("clean", "corrected", "uncorrectable")
     for ev in events:
+        # Chaos detection latencies ride ``extra["detection_latency_
+        # seconds"]`` on campaign events (outcome ``alert``, but any
+        # carrier counts) — rebuilt BEFORE the outcome branch because
+        # the carrier is usually not a call report. Same single-stats-
+        # path discipline as serve_latency_seconds below: the live
+        # campaign observes the identical value into its registry, so
+        # one event log exports the same histogram.
+        det_lat = (ev.extra.get("detection_latency_seconds")
+                   if isinstance(ev.extra, dict) else None)
+        if isinstance(det_lat, (int, float)):
+            model = ev.extra.get("fault_model")
+            labels = {"fault_model": model} if model else {}
+            reg.histogram("fault_detection_latency_seconds",
+                          buckets=LATENCY_BUCKETS,
+                          **labels).observe(det_lat)
         if ev.outcome not in call_outcomes:
             reg.counter("ft_step_events", op=ev.op,
                         outcome=ev.outcome).inc()

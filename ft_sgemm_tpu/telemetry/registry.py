@@ -258,6 +258,8 @@ _METRIC_HELP = {
                           "timeline phase rollup",
     "lint_findings": "Static contract checker findings (cli lint)",
     "lint_seconds": "Static contract checker runtime",
+    "fault_detection_latency_seconds": "Injection-to-detection latency "
+                                       "per chaos fault model",
 }
 
 # Dynamically-named families (``wall.{phase}_seconds``,
@@ -282,6 +284,11 @@ _METRIC_HELP_PREFIXES = {
     "fleet_": "Fleet runtime: cross-host dispatch, host-slot blame/"
               "eviction, and live shard-merge counters "
               "(ft_sgemm_tpu/fleet)",
+    "chaos_": "Chaos campaign: per-cell fault episodes, detections, "
+              "and clean-twin outcomes (ft_sgemm_tpu/chaos)",
+    "coverage_": "Chaos coverage matrix rollups: per-model detection/"
+                 "correction rates and latency facts "
+                 "(ft_sgemm_tpu/chaos)",
 }
 
 

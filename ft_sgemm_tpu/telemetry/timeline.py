@@ -57,7 +57,8 @@ SPAN_KINDS = ("stage", "attempt", "compile")
 # emitter cannot invent a kind the readers (summarize_timeline,
 # traceview, wallclock) have never heard of.
 KINDS = ("stage", "attempt", "compile", "heartbeat", "kill", "serve",
-         "serve_block", "kv_page", "serve_progress", "recovery", "fleet")
+         "serve_block", "kv_page", "serve_progress", "recovery", "fleet",
+         "chaos")
 
 
 class TimelineRecorder:

@@ -195,6 +195,29 @@ def summarize_artifact(path, obj, ledger_entries=None):
         if flops is not None:
             print(f"   {'panel recompute flops':34s} "
                   f"{flops} of full retry")
+    chaos = ctx.get("chaos")
+    if isinstance(chaos, dict) and isinstance(chaos.get("models"), dict):
+        # Chaos campaign coverage (ft_sgemm_tpu/chaos): one row per
+        # fault model — detection rate, p95 detection latency, MTTR,
+        # and the MTBF-derived policy verdict.
+        def _r(v, pat="{:.2f}"):
+            return pat.format(v) if isinstance(v, (int, float)) else "-"
+
+        for name, m in chaos["models"].items():
+            if not isinstance(m, dict):
+                continue
+            roll = m.get("rollup") or {}
+            pol = m.get("policy") or {}
+            verdict = (f"every={pol.get('check_every', '?')}"
+                       f"/{pol.get('threshold_mode', '?')}"
+                       + ("/evict" if pol.get("evict") else ""))
+            print(f"   {'chaos ' + name:34s} "
+                  f"det {_r(roll.get('detection_rate'))}"
+                  f"  p95 "
+                  f"{_r(roll.get('p95_detection_latency_seconds'), '{:.4f}')}s"
+                  f"  mttr {_r(roll.get('mttr_seconds'), '{:.3f}')}s"
+                  f"  fp {_r(roll.get('false_positive_rate'))}"
+                  f"  policy {verdict}")
     for name, e in (ctx.get("errors") or {}).items():
         first = str(e).splitlines()[0] if e else ""
         print(f"   {name:34s} ERROR: {first[:90]}")

@@ -1,0 +1,449 @@
+"""Chaos campaign plane (ISSUE 19): declarative fault models with
+seeded determinism, the measured coverage matrix over real workloads,
+clean-twin zero-false-positive pins, coverage round-trip + ledger
+ingest, MTBF-driven policy monotonicity, and the trend gate failing a
+seeded coverage regression."""
+
+import io
+import json
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu import contracts
+from ft_sgemm_tpu.chaos import (
+    FAULT_MODELS,
+    MODELS,
+    WORKLOADS,
+    FaultModel,
+    draw_episode,
+)
+from ft_sgemm_tpu.chaos import policy
+from ft_sgemm_tpu.cli import chaos_verdict, main as cli_main
+from ft_sgemm_tpu.perf import ledger
+
+# ---------------------------------------------------------------------------
+# Declarations and seeded determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_models_mirror_contracts():
+    """The runtime spelling, the contracts declaration, and the event
+    axis must agree (the lint axis-drift pass enforces the same)."""
+    from ft_sgemm_tpu.telemetry.events import AXIS_LABELS
+
+    assert FAULT_MODELS == contracts.FAULT_MODELS
+    assert tuple(MODELS) == FAULT_MODELS
+    assert set(AXIS_LABELS["fault_model"]) == set(FAULT_MODELS)
+
+
+def test_model_specs_validate():
+    for name, m in MODELS.items():
+        assert m.name == name
+        assert m.mtbf_seconds() > 0
+        assert m.workloads and all(w in WORKLOADS for w in m.workloads)
+    with pytest.raises(ValueError):
+        FaultModel(name="not_a_model", site="x", actuator="y",
+                   workloads=("train_step",),
+                   magnitude=("absolute", 1.0, 2.0),
+                   temporal="transient", rate_per_hour=1.0,
+                   correctable=False, description="")
+    with pytest.raises(ValueError):
+        FaultModel(name="bit_flip", site="x", actuator="y",
+                   workloads=("nope",),
+                   magnitude=("absolute", 1.0, 2.0),
+                   temporal="transient", rate_per_hour=1.0,
+                   correctable=False, description="")
+
+
+def test_draw_episode_deterministic_under_seed():
+    """Same seed, same episode schedule — a coverage regression is a
+    code change, never draw noise."""
+    for name, model in MODELS.items():
+        a = [draw_episode(model, random.Random(7)) for _ in range(4)]
+        b = [draw_episode(model, random.Random(7)) for _ in range(4)]
+        assert a == b, name
+    # Different seeds move at least the continuous magnitude draw.
+    m = MODELS["bit_flip"]
+    assert draw_episode(m, random.Random(1)) \
+        != draw_episode(m, random.Random(2))
+
+
+def test_campaign_cell_stream_is_process_stable():
+    """The per-cell stream seeds from a STRING (sha512-derived), not
+    hash() of a tuple — identical across interpreter runs regardless of
+    PYTHONHASHSEED."""
+    a = random.Random("10:bit_flip:train_step").random()
+    b = random.Random("10:bit_flip:train_step").random()
+    assert a == b
+    assert random.Random("11:bit_flip:train_step").random() != a
+
+
+# ---------------------------------------------------------------------------
+# The measured coverage matrix (one shared campaign run)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def coverage_doc():
+    """One campaign over three models whose tiers span the stack:
+    bit_flip (in-kernel device tier), multi_device_burst (staged
+    host/global tier on the 8-vdev mesh), kv_rot (stored-state
+    kv_page tier), plus throughput_sag (health tier, jax-free)."""
+    from ft_sgemm_tpu.chaos.campaign import ChaosCampaign
+
+    return ChaosCampaign(
+        models=("bit_flip", "multi_device_burst", "kv_rot",
+                "throughput_sag"),
+        workloads=("train_step", "block_serve", "pool_evict"),
+        episodes=2, clean_episodes=1, seed=10).run()
+
+
+def test_coverage_matrix_non_null(coverage_doc):
+    chaos = coverage_doc["context"]["chaos"]
+    assert set(chaos["models"]) == {"bit_flip", "multi_device_burst",
+                                    "kv_rot", "throughput_sag"}
+    for name, entry in chaos["models"].items():
+        roll = entry["rollup"]
+        assert roll["detection_rate"] == 1.0, name
+        assert roll["p95_detection_latency_seconds"] > 0, name
+        assert roll["mttr_seconds"] > 0, name
+        assert roll["incorrect_results"] == 0, name
+        for cell in entry["cells"].values():
+            assert cell["faults_injected"] == 2
+            assert cell["detection_latency_seconds"] is not None
+    assert coverage_doc["value"] == 1.0
+    assert chaos_verdict(coverage_doc)
+
+
+def test_tier_of_detection_per_model(coverage_doc):
+    """Each model is caught where its site says it must be: the
+    transient upset in-kernel (device), the correlated sub-threshold
+    burst only at the staged host/global reduce, KV rot at the page
+    checksum, health sag at the pool."""
+    models = coverage_doc["context"]["chaos"]["models"]
+    assert set(models["bit_flip"]["rollup"]["tier_of_detection"]) \
+        == {"device"}
+    burst_tiers = set(
+        models["multi_device_burst"]["rollup"]["tier_of_detection"])
+    assert burst_tiers and burst_tiers <= {"host", "global"}
+    assert set(models["kv_rot"]["rollup"]["tier_of_detection"]) \
+        == {"kv_page"}
+    assert set(models["throughput_sag"]["rollup"]["tier_of_detection"]) \
+        == {"health"}
+
+
+def test_clean_twins_zero_false_positives(coverage_doc):
+    """Every cell ran a clean twin; none may have alarmed."""
+    for name, entry in coverage_doc["context"]["chaos"]["models"].items():
+        for workload, cell in entry["cells"].items():
+            assert cell["clean_episodes"] >= 1, (name, workload)
+            assert cell["false_positives"] == 0, (name, workload)
+            assert cell["false_positive_rate"] == 0.0, (name, workload)
+
+
+def test_correctable_models_correct_not_just_detect(coverage_doc):
+    models = coverage_doc["context"]["chaos"]["models"]
+    for name in ("bit_flip", "kv_rot"):
+        assert models[name]["spec"]["correctable"]
+        assert models[name]["rollup"]["correction_rate"] == 1.0, name
+
+
+def test_coverage_roundtrip_and_ledger_ingest(coverage_doc, tmp_path):
+    """COVERAGE.json is artifact-shaped: it survives a JSON round trip
+    and the ledger ingests it as kind=chaos with per-model chaos.*
+    measurements (which perf/trend.py then gates for free)."""
+    p = tmp_path / "COVERAGE.json"
+    p.write_text(json.dumps(coverage_doc))
+    doc = json.loads(p.read_text())
+    assert doc == coverage_doc
+
+    entry = ledger.ingest(doc, run_id="r-chaos")
+    assert entry["kind"] == "chaos"
+    meas = entry["measurements"]
+    assert meas["chaos.bit_flip.detection_rate"] == \
+        {"value": 1.0, "higher_is_better": True}
+    assert meas["chaos.kv_rot.mttr_seconds"]["higher_is_better"] is False
+    assert meas["chaos.multi_device_burst.false_positive_rate"] == \
+        {"value": 0.0, "higher_is_better": False}
+    # Categorical facts ride the entry body, not the trend plane.
+    body = entry["chaos"]["multi_device_burst"]
+    assert set(body["tier_of_detection"]) <= {"host", "global"}
+    assert body["policy"]["tier_config"] == "tiered"
+    # ingest never raises on malformed chaos sections.
+    assert ledger.ingest({"metric": "chaos_coverage", "value": 1.0,
+                          "context": {"chaos": {"models": "bogus"}}},
+                         run_id="r-bad")["kind"] == "chaos"
+
+
+def test_policy_recommendations_differ_measurably(coverage_doc):
+    """ISSUE 19 acceptance: the picker recommends measurably different
+    (cadence, threshold) pairs across models."""
+    models = coverage_doc["context"]["chaos"]["models"]
+    picks = {name: (e["policy"]["check_every"],
+                    e["policy"]["threshold_mode"])
+             for name, e in models.items()}
+    assert len(set(picks.values())) >= 2, picks
+    # At a fixed measured window the 60s-MTBF transient checks denser
+    # than the 7200s sag (the campaign windows differ per workload, so
+    # pin the MTBF→cadence ordering at window=1s).
+    assert policy.recommend_cadence(MODELS["bit_flip"].mtbf_seconds(),
+                                    1.0) \
+        < policy.recommend_cadence(
+            MODELS["throughput_sag"].mtbf_seconds(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Policy derivation (pure, jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_cadence_monotone_in_mtbf():
+    cadences = [policy.recommend_cadence(mtbf, 1.0)
+                for mtbf in (1.0, 60.0, 600.0, 3600.0, 86400.0)]
+    assert cadences == sorted(cadences)
+    assert cadences[0] < cadences[-1]
+    assert all(policy.MIN_CHECK_EVERY <= c <= policy.MAX_CHECK_EVERY
+               for c in cadences)
+    assert policy.recommend_cadence(0.0) == policy.MIN_CHECK_EVERY
+    assert policy.recommend_cadence(1e12) == policy.MAX_CHECK_EVERY
+
+
+def test_recommend_threshold_tier_and_evict_branches():
+    spec = MODELS["residual_drift"].to_dict()
+    rollup = {"detection_rate": 1.0, "static_detection_rate": 0.0,
+              "p95_detection_latency_seconds": 0.01,
+              "mttr_seconds": 0.02, "tier_of_detection": {"device": 2}}
+    rec = policy.recommend(spec, rollup)
+    assert rec["threshold_mode"] == "adaptive"
+    assert rec["tier_config"] == "device"
+    assert rec["evict"] is False
+    assert "adaptive" in rec["justification"]
+
+    spec = MODELS["multi_device_burst"].to_dict()
+    rec = policy.recommend(spec, {"detection_rate": 1.0,
+                                  "tier_of_detection": {"host": 2}})
+    assert rec["threshold_mode"] == "static"
+    assert rec["tier_config"] == "tiered"
+
+    spec = MODELS["stuck_device"].to_dict()
+    rec = policy.recommend(spec, {"detection_rate": 1.0})
+    assert rec["evict"] is True
+
+
+def test_chaos_verdict_predicate():
+    def doc(**rollup):
+        return {"context": {"chaos": {"models": {"m": {
+            "spec": {"correctable": True},
+            "rollup": dict({"detection_rate": 1.0,
+                            "incorrect_results": 0,
+                            "false_positive_rate": 0.0}, **rollup)}}}}}
+
+    assert chaos_verdict(doc())
+    assert not chaos_verdict(doc(detection_rate=0.5))
+    assert not chaos_verdict(doc(detection_rate=None))
+    assert not chaos_verdict(doc(incorrect_results=1))
+    assert not chaos_verdict(doc(false_positive_rate=0.5))
+    assert not chaos_verdict({"context": {}})
+
+
+# ---------------------------------------------------------------------------
+# Trend gate on seeded coverage regression
+# ---------------------------------------------------------------------------
+
+
+def _chaos_artifact(det):
+    return {"metric": "chaos_coverage", "value": det, "unit": "rate",
+            "vs_baseline": None,
+            "context": {"platform_used": "cpu", "device_kind": "cpu",
+                        "chaos": {"workloads": ["train_step"],
+                                  "models": {"bit_flip": {
+                            "spec": {"correctable": True},
+                            "mtbf_seconds": 60.0,
+                            "rollup": {"detection_rate": det},
+                            "policy": {},
+                            "cells": {"train_step": {
+                                "detection_rate": det,
+                                "correction_rate": det,
+                                "detection_latency_seconds":
+                                    {"p95": 0.01},
+                                "mttr_seconds": 0.02,
+                                "false_positive_rate": 0.0,
+                                "goodput_retention": 0.97,
+                                "tier_of_detection":
+                                    {"device": 2}}}}}}}}
+
+
+def test_trend_gate_fails_on_coverage_regression(tmp_path, capsys):
+    """ISSUE 19 acceptance: a seeded detection-rate regression trips
+    `cli trend --gate` exit 1."""
+    path = str(tmp_path / "led.jsonl")
+    for i in range(4):
+        ledger.append(path, ledger.ingest(_chaos_artifact(1.0),
+                                          run_id=f"r{i}"))
+    ledger.append(path, ledger.ingest(_chaos_artifact(0.5),
+                                      run_id="regressed"))
+    rc = cli_main(["cli", "trend", path, "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "chaos.bit_flip.detection_rate" in out
+    assert "regression" in out
+
+
+def test_trend_gate_passes_on_stable_coverage(tmp_path, capsys):
+    path = str(tmp_path / "led.jsonl")
+    for i in range(5):
+        ledger.append(path, ledger.ingest(_chaos_artifact(1.0),
+                                          run_id=f"r{i}"))
+    assert cli_main(["cli", "trend", path, "--gate"]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Detection-latency histogram: live export + single-stats-path rebuild
+# ---------------------------------------------------------------------------
+
+
+def test_detection_latency_histogram_rebuild(tmp_path):
+    """`registry_from_events` rebuilds fault_detection_latency_seconds
+    from the JSONL log with the SAME stats the live registry observed —
+    the serve_latency_seconds single-stats-path discipline."""
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.cli import run_telemetry_summary
+    from ft_sgemm_tpu.telemetry import read_events, registry_from_events
+    from ft_sgemm_tpu.telemetry.registry import (
+        LATENCY_BUCKETS, MetricsRegistry, to_prometheus)
+
+    log = tmp_path / "chaos_events.jsonl"
+    live = MetricsRegistry()
+    telemetry.configure(log, registry=live, log_clean=True)
+    try:
+        for lat in (0.002, 0.25):
+            live.histogram("fault_detection_latency_seconds",
+                           buckets=LATENCY_BUCKETS,
+                           fault_model="bit_flip").observe(lat)
+            telemetry.record_step_event(
+                "alert", op="chaos",
+                extra={"fault_model": "bit_flip",
+                       "workload": "train_step",
+                       "detection_latency_seconds": lat})
+        # A chaos event WITHOUT a latency must not feed the histogram.
+        telemetry.record_step_event(
+            "alert", op="chaos", extra={"fault_model": "bit_flip"})
+    finally:
+        telemetry.disable()
+
+    rebuilt = registry_from_events(read_events(log))
+
+    def family(reg):
+        return [s for s in reg.collect()
+                if s["name"] == "fault_detection_latency_seconds"]
+
+    got, want = family(rebuilt), family(live)
+    assert want and got
+    assert got[0]["labels"] == {"fault_model": "bit_flip"}
+    assert got[0]["value"] == want[0]["value"]
+    prom = to_prometheus(rebuilt.collect())
+    assert "fault_detection_latency_seconds_bucket" in prom
+    assert 'fault_model="bit_flip"' in prom
+    # The CLI prom exporter is the same path.
+    buf = io.StringIO()
+    assert run_telemetry_summary(str(log), out=buf, fmt="prom") == 0
+    assert "fault_detection_latency_seconds_bucket" in buf.getvalue()
+
+
+def test_top_tolerates_chaos_gauge_families(capsys):
+    """`cli top` scrapes by name: the new chaos_* / coverage_* families
+    (and the latency histogram) must render-through without crashing."""
+    from ft_sgemm_tpu.cli import run_top
+    from ft_sgemm_tpu.telemetry.monitor import start_monitor
+    from ft_sgemm_tpu.telemetry.registry import (
+        LATENCY_BUCKETS, MetricsRegistry)
+
+    reg = MetricsRegistry()
+    reg.counter("chaos_episodes", fault_model="bit_flip",
+                workload="train_step").inc(3)
+    reg.gauge("coverage_detection_rate", fault_model="bit_flip").set(1.0)
+    reg.histogram("fault_detection_latency_seconds",
+                  buckets=LATENCY_BUCKETS,
+                  fault_model="bit_flip").observe(0.01)
+    mon, server = start_monitor(0, registry=reg, attach=False)
+    try:
+        buf = io.StringIO()
+        assert run_top(server.url, out=buf, interval=0.01,
+                       iterations=1) == 0
+        assert "ft-sgemm top" in buf.getvalue()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Front ends: cli chaos / cli coverage / summarize_bench
+# ---------------------------------------------------------------------------
+
+
+def test_cli_chaos_smoke_pool_only(tmp_path, capsys):
+    """The cheap jax-free slice of `cli chaos --smoke`: pool-tier model
+    only, artifact + COVERAGE.json + chaos timeline spans emitted,
+    exit 0."""
+    art = tmp_path / "chaos_artifact.json"
+    cov = tmp_path / "COVERAGE.json"
+    tl = tmp_path / "run.timeline.jsonl"
+    rc = cli_main(["cli", "chaos", "--smoke",
+                   "--models=throughput_sag",
+                   f"--out={art}", f"--coverage-out={cov}",
+                   f"--timeline={tl}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "throughput_sag" in out
+    doc = json.loads(art.read_text())
+    assert doc["metric"] == "chaos_coverage"
+    assert json.loads(cov.read_text()) == doc
+    kinds = {json.loads(line).get("kind")
+             for line in tl.read_text().splitlines()}
+    assert kinds == {"chaos"}
+
+
+def test_cli_chaos_unknown_model_exits_2(capsys):
+    assert cli_main(["cli", "chaos", "--models=not_a_model"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_coverage_renders_saved_matrix(tmp_path, capsys):
+    p = tmp_path / "COVERAGE.json"
+    p.write_text(json.dumps(_chaos_artifact(1.0)))
+    assert cli_main(["cli", "coverage", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "bit_flip" in out and "chaos coverage" in out
+    assert cli_main(["cli", "coverage",
+                     str(tmp_path / "missing.json")]) == 2
+
+
+def test_summarize_renders_chaos_coverage_rows(tmp_path):
+    """scripts/summarize_bench.py renders per-model coverage rows
+    (model, detection rate, p95 latency, MTTR, policy verdict) from a
+    chaos artifact — the synthetic-artifact regression pin."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = tmp_path / "chaos_artifact.json"
+    doc = _chaos_artifact(1.0)
+    model = doc["context"]["chaos"]["models"]["bit_flip"]
+    model["rollup"].update({"p95_detection_latency_seconds": 0.0123,
+                            "mttr_seconds": 0.045,
+                            "false_positive_rate": 0.0})
+    model["policy"] = {"check_every": 8, "threshold_mode": "static",
+                       "tier_config": "device", "evict": False}
+    p.write_text(json.dumps(doc))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(p)],
+        cwd=root, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "chaos bit_flip" in out.stdout
+    assert "det 1.00" in out.stdout
+    assert "p95 0.0123s" in out.stdout
+    assert "mttr 0.045s" in out.stdout
+    assert "policy every=8/static" in out.stdout
