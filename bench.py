@@ -2687,9 +2687,19 @@ def serve_main(argv):
         lint = _lint_facts()
         if lint is not None:
             serve_extra["lint"] = lint
+        # PR 20: the cost plane rides the monitor snapshot (engines push
+        # CostLedger snapshots into it); lift it to a first-class
+        # context key so the ledger ingests economics.* measurements
+        # and the RunReport renders its own section.
+        econ = context.get("economics")
+        if econ is None and isinstance(context.get("slo"), dict):
+            econ = context["slo"].get("economics")
+        if isinstance(econ, dict):
+            context["economics"] = econ
         context["run_report"] = RunReport(
             manifest=build_manifest(extra=serve_extra),
-            stages=[], slo=context.get("slo")).to_dict()
+            stages=[], slo=context.get("slo"),
+            economics=econ if isinstance(econ, dict) else None).to_dict()
     except Exception as e:  # noqa: BLE001 — the line must still print
         context["errors"]["run_report"] = f"{type(e).__name__}: {e}"
     artifact = {"metric": metric,
@@ -2846,6 +2856,40 @@ def fleet_main(argv):
         deadline_seconds=deadline, wedge_after=max(120.0, deadline / 3)))
     fleet = ((report.get("result") or {}).get("fleet")
              if isinstance(report.get("result"), dict) else None) or {}
+    serve = ((report.get("result") or {}).get("serve")
+             if isinstance(report.get("result"), dict) else None) or {}
+    if fleet and isinstance(serve.get("dispatcher"), dict):
+        # Per-slot request counts, hop-latency percentiles, and the
+        # last measured clock skew (FleetDispatcher.stats()) ride the
+        # artifact so summarize_bench can render the hop decomposition.
+        fleet = dict(fleet, dispatcher=serve["dispatcher"])
+    # PR 20: stitch supervisor + per-rank timelines into ONE
+    # skew-corrected multi-process Perfetto trace — still jax-free
+    # (traceview is stdlib-only/path-loadable, same as launch.py).
+    trace_meta = None
+    try:
+        tv_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "ft_sgemm_tpu", "telemetry", "traceview.py")
+        tv_spec = importlib.util.spec_from_file_location(
+            "_ft_traceview", tv_path)
+        tv = importlib.util.module_from_spec(tv_spec)
+        sys.modules[tv_spec.name] = tv
+        tv_spec.loader.exec_module(tv)
+        trace, trace_path = tv.merge_fleet(workdir)
+        meta = trace["otherData"]
+        trace_meta = {
+            "path": trace_path,
+            "spans": meta.get("spans"),
+            "points": meta.get("points"),
+            "flows": meta.get("flows"),
+            "cross_process_flows": meta.get("cross_process_flows"),
+            "processes": meta.get("processes"),
+            "ranks": meta.get("ranks"),
+            "clock_skew_seconds": meta.get("clock_skew_seconds"),
+        }
+    except Exception as e:  # noqa: BLE001 — stitching never kills the smoke
+        trace_meta = {"error": str(e)}
     localized = fleet.get("localized") or {}
     checks = {
         "ranks_ok": report.get("ok", False),
@@ -2859,6 +2903,15 @@ def fleet_main(argv):
         "goodput_recovered": (
             (fleet.get("goodput_recovery_ratio") or 0) >= 0.7),
         "zero_incorrect": fleet.get("incorrect_responses") == 0,
+        # PR 20: one trace_id must flow ACROSS the process boundary in
+        # the merged trace, and the cost plane must have accounted the
+        # run (useful + overhead fractions share one denominator).
+        "trace_cross_process": bool(
+            (trace_meta or {}).get("cross_process_flows")),
+        "economics_accounted": (
+            isinstance(fleet.get("economics"), dict)
+            and fleet["economics"].get("useful_flops_fraction")
+            is not None),
     }
     if program != "smoke":
         # Non-smoke programs (noop/counters/wedge) only promise their
@@ -2874,6 +2927,9 @@ def fleet_main(argv):
                                           or {}).items()},
         "checks": checks,
         "fleet": fleet or None,
+        "merged_trace": trace_meta,
+        "economics": fleet.get("economics"),
+        "clock_skew_seconds": fleet.get("clock_skew_seconds"),
         "wall_seconds": round(time.monotonic() - t0, 3),
     }
     artifact = {"metric": "fleet_goodput_recovery_ratio",

@@ -87,6 +87,10 @@ Usage:
         [--run-id=ID]
     python -m ft_sgemm_tpu.cli trace-export RUN.timeline.jsonl \
         [--events=LOG.jsonl] [--out=TRACE.json] [--run-id=ID]
+    python -m ft_sgemm_tpu.cli trace-export FLEET_WORKDIR --fleet \
+        [--out=TRACE.json] [--run-id=ID]
+    python -m ft_sgemm_tpu.cli economics ARTIFACT.json \
+        [--format=text|json]
     python -m ft_sgemm_tpu.cli lint [--format=text|json] \
         [--only=CHECK,...] [--allowlist=PATH] [--root=DIR]
 
@@ -306,7 +310,14 @@ run's streamed timeline (+ optional fault-event JSONL via
 compile spans on per-kind tracks, faults as instants with tile coords,
 serve requests as flows joined by ``trace_id`` across
 enqueue→flush→detect→retry — loadable directly in Perfetto or
-``chrome://tracing``.
+``chrome://tracing``. ``trace-export --fleet`` takes a fleet WORKDIR
+instead and stitches the supervisor timeline plus every rank's
+(skew-corrected by the per-host clock offsets the dispatcher measured,
+rank-namespaced so identical span names never alias) into ONE
+multi-process trace whose flows cross process rows. ``economics``
+renders the cost plane a serving artifact embeds (useful-flops
+fraction, overhead breakdown by cause, tokens-correct throughput per
+device — ``perf/economics.py``).
 
 Static analysis (``ft_sgemm_tpu.lint``, DESIGN.md §14): ``lint`` runs
 the repo-native static contract checker — five AST passes verifying the
@@ -934,6 +945,28 @@ def run_trace_export(args, flags, out=None) -> int:
             out_path = f.split("=", 1)[1]
         elif f.startswith("--run-id="):
             run_id = f.split("=", 1)[1]
+    if "--fleet" in flags:
+        # args[0] is a fleet WORKDIR: stitch supervisor + every rank's
+        # timeline into one skew-corrected multi-process trace.
+        try:
+            trace, path = traceview.merge_fleet(
+                timeline_path, out_path=out_path, run_id=run_id)
+        except OSError as e:
+            print(f"ft_sgemm: cannot read fleet workdir: {e}",
+                  file=sys.stderr)
+            return 2
+        meta = trace["otherData"]
+        skew = meta.get("clock_skew_seconds") or {}
+        print(f"fleet trace written to {path}: {meta['spans']} spans,"
+              f" {meta['points']} points, {meta['flows']} flows"
+              f" ({meta['cross_process_flows']} cross-process),"
+              f" ranks {meta.get('ranks')},"
+              f" clock skew {skew}", file=out)
+        if not (meta["spans"] or meta["points"]):
+            print("ft_sgemm: fleet workdir held no records",
+                  file=sys.stderr)
+            return 1
+        return 0
     try:
         trace, path = traceview.export_trace(
             timeline_path, events_path=events_path, out_path=out_path,
@@ -950,6 +983,77 @@ def run_trace_export(args, flags, out=None) -> int:
     if not (meta["spans"] or meta["points"] or meta["fault_events"]):
         print("ft_sgemm: timeline held no records", file=sys.stderr)
         return 1
+    return 0
+
+
+def _find_economics(doc):
+    """Locate the economics block in a bench artifact, tolerantly: the
+    fleet path (``context.fleet.economics``), the serve paths, or a
+    bare CostLedger snapshot handed in directly."""
+    ctx = doc.get("context", doc) if isinstance(doc, dict) else {}
+    for keys in (("economics",), ("fleet", "economics"),
+                 ("serve", "economics"),
+                 ("serve", "engine", "economics"),
+                 ("slo", "economics")):
+        cur = ctx
+        for k in keys:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, dict):
+            return cur
+    return None
+
+
+def run_economics(args, flags, out=None) -> int:
+    """``economics`` subcommand: render the cost plane a serving/fleet
+    artifact embeds (``perf/economics.py``) — useful-vs-overhead flops
+    split, overhead breakdown by cause, tokens-correct throughput per
+    device. Exit 2 = unreadable artifact; 1 = no economics block (a run
+    without the cost plane is a named outcome, not an empty table)."""
+    import json as _json
+
+    out = sys.stdout if out is None else out
+    try:
+        with open(args[0], "r", encoding="utf-8") as fh:
+            doc = _json.load(fh)
+    except (OSError, _json.JSONDecodeError) as e:
+        print(f"ft_sgemm: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    econ = _find_economics(doc)
+    if econ is None:
+        print("ft_sgemm: artifact holds no economics block",
+              file=sys.stderr)
+        return 1
+    if "--format=json" in flags:
+        _json.dump(econ, out, indent=2, sort_keys=True)
+        out.write("\n")
+        return 0
+    print("request cost economics", file=out)
+    print(f"  requests             {econ.get('requests', '-')}"
+          f"  (ok {econ.get('requests_ok', '-')})", file=out)
+    print(f"  useful flops         "
+          f"{econ.get('useful_flops_fraction', '-')}"
+          f"  of total {econ.get('flops_total', '-')}", file=out)
+    fracs = econ.get("overhead_fractions") or {}
+    for cause in sorted(fracs):
+        if fracs[cause] is not None:
+            print(f"    overhead[{cause}] {fracs[cause]}", file=out)
+    print(f"  tokens               {econ.get('tokens', '-')}"
+          f"  correct {econ.get('tokens_correct', '-')}", file=out)
+    tcs = econ.get("tokens_correct_per_second_per_device")
+    if tcs is not None:
+        print(f"  tokens-correct/s/device {tcs}"
+              f"  (devices {econ.get('devices', '-')},"
+              f" wall {econ.get('wall_seconds', '-')}s)", file=out)
+    per_dev = econ.get("per_device") or {}
+    if per_dev:
+        print(f"  {'device':<30s} {'reqs':>6s} {'useful':>12s}"
+              f" {'overhead':>12s} {'tok-ok':>7s}", file=out)
+        for dev in sorted(per_dev):
+            row = per_dev[dev] if isinstance(per_dev[dev], dict) else {}
+            print(f"  {str(dev):<30s} {row.get('requests', 0):>6}"
+                  f" {row.get('flops_productive', 0):>12.4g}"
+                  f" {row.get('flops_overhead', 0):>12.4g}"
+                  f" {row.get('tokens_correct', 0):>7}", file=out)
     return 0
 
 
@@ -2181,6 +2285,48 @@ def _render_top(url: str, out, since: int, poll: int) -> int:
                   f"{value('serve_block_retries', 0, bucket=b):>7} "
                   f"{fmt(pct.get('p50')):>10s} {fmt(pct.get('p99')):>10s}",
                   file=out)
+    # Cost plane (PR 20) — economics_* gauges the engines publish per
+    # request; absent on processes without the cost plane, line skipped.
+    uff = value("economics_useful_flops_fraction")
+    if uff is not None:
+        tcs = value("economics_tokens_correct_per_second_per_device")
+        print(f"economics: useful flops {uff}"
+              f"  requests {value('economics_requests', '-')}"
+              f"  tokens-correct {value('economics_tokens_correct', '-')}"
+              + (f"  tok-correct/s/dev {tcs}" if tcs is not None else ""),
+              file=out)
+        causes = sorted(
+            find("economics_overhead_flops_fraction"),
+            key=lambda s: -s["value"])
+        if causes:
+            print("  overhead: " + "  ".join(
+                f"{s['labels'].get('overhead_cause', '?')}={s['value']}"
+                for s in causes), file=out)
+    # Fleet rows (PR 20) — per-host clock skew + hop latency, present
+    # only when the process runs the fleet dispatcher.
+    skews = sorted(find("fleet_clock_skew_seconds"),
+                   key=lambda s: s["labels"].get("host", ""))
+    if skews:
+        print("fleet: clock skew " + "  ".join(
+            f"host{s['labels'].get('host', '?')}={s['value']:+.4f}s"
+            for s in skews), file=out)
+        from ft_sgemm_tpu.contracts import FLEET_HOPS
+        for hop in FLEET_HOPS:
+            rows = list(find(f"fleet_hop_{hop}_seconds"))
+            vals = [s["value"] for s in rows if isinstance(s["value"], dict)]
+            if not vals:
+                continue
+            merged = {"buckets": vals[0]["buckets"],
+                      "counts": [sum(v["counts"][i] for v in vals)
+                                 for i in range(len(vals[0]["counts"]))],
+                      "sum": sum(v["sum"] for v in vals),
+                      "count": sum(v["count"] for v in vals)}
+            if not merged["count"]:
+                continue
+            pct = histogram_percentiles(merged, quantiles=(0.5, 0.95))
+            print(f"  hop {hop:<16s} p50 {pct.get('p50', 0):.4g}s"
+                  f"  p95 {pct.get('p95', 0):.4g}s"
+                  f"  n {merged['count']:.0f}", file=out)
     dh = sorted(find("device_health"),
                 key=lambda s: s["value"])
     if dh:
@@ -2303,6 +2449,11 @@ def main(argv=None) -> int:
             print(__doc__)
             return 2
         return run_trace_export(args[1:], flags)
+    if args and args[0] == "economics":
+        if len(args) < 2:
+            print(__doc__)
+            return 2
+        return run_economics(args[1:], flags)
     if args and args[0] == "top":
         if len(args) < 2:
             print(__doc__)

@@ -33,6 +33,7 @@ STDLIB_ONLY_MODULES = (
     "ft_sgemm_tpu/fleet/launch.py",
     "ft_sgemm_tpu/lint/core.py",
     "ft_sgemm_tpu/perf/compile_cache.py",
+    "ft_sgemm_tpu/perf/economics.py",
     "ft_sgemm_tpu/perf/ledger.py",
     "ft_sgemm_tpu/perf/trend.py",
     "ft_sgemm_tpu/perf/wallclock.py",
@@ -178,6 +179,40 @@ HOST_TIERS = ("local", "dcn")
 # 2112.09017 panel asymmetry as a placement cost term; "round_robin"
 # ignores distance and health (the A/B control).
 FLEET_PLACEMENTS = ("dcn_cost", "round_robin")
+
+# The per-hop latency decomposition of one fleet-dispatched request
+# (``fleet/dispatch.py::FLEET_HOPS`` is the runtime spelling — the
+# BLOCK_PHASES import-free mirror discipline; ``events.AXIS_LABELS
+# ["hop"]`` mirrors this tuple and the lint axis-drift pass
+# cross-checks all three). Each hop is one ``fleet_hop_<hop>_seconds``
+# histogram family, ordered along the request's path:
+#   queue_wait      submit -> coordinator slot-worker dequeue
+#   rtt             DCN wire round trip minus the remote's wall time
+#                   (the 2112.09017 ICI/DCN asymmetry, measured)
+#   remote_queue    remote wire-receive -> remote execute start
+#   remote_execute  the remote rank's own execute wall time
+#   retry           extra wall spent re-executing after detection
+FLEET_HOPS = ("queue_wait", "rtt", "remote_queue", "remote_execute",
+              "retry")
+
+# --- request cost economics ---------------------------------------------
+#
+# The closed overhead-cause axis of the cost plane
+# (``perf/economics.py::OVERHEAD_CAUSES`` is the runtime spelling — the
+# BLOCK_PHASES mirror discipline; ``events.AXIS_LABELS
+# ["overhead_cause"]`` mirrors this tuple and the lint axis-drift pass
+# cross-checks all three). Every non-productive flop a request spends
+# is attributed to exactly one of these causes, and every
+# ``economics_overhead_flops_fraction{overhead_cause=}`` gauge and
+# ledger overhead-fraction key is one of these spellings:
+#   encode       ABFT checksum-row encode (the always-on premium)
+#   check        detect/correct epilogue flops (always-on premium)
+#   retry        full re-execution of bounded retry attempts
+#   recompute    recovery-ladder rung flops (recover_local's pinned
+#                accounting)
+#   kv_reverify  stored-state re-verification + KV page restores
+OVERHEAD_CAUSES = ("encode", "check", "retry", "recompute",
+                   "kv_reverify")
 
 # --- chaos campaign fault models ----------------------------------------
 #

@@ -40,6 +40,15 @@ from typing import Callable, Optional, Sequence
 HOST_TIERS = ("local", "dcn")
 FLEET_PLACEMENTS = ("dcn_cost", "round_robin")
 
+# Runtime spelling of contracts.FLEET_HOPS (lint axis-drift checks this
+# copy and events.AXIS_LABELS["hop"] against it). Each hop is one
+# ``fleet_hop_<hop>_seconds`` histogram family, ordered along the
+# request's path: coordinator queue wait, DCN wire round trip (minus
+# the remote's wall time), remote receive->execute gap, remote execute
+# wall, and extra wall re-executing after a detection.
+FLEET_HOPS = ("queue_wait", "rtt", "remote_queue", "remote_execute",
+              "retry")
+
 
 @dataclasses.dataclass
 class HostSlot:
@@ -88,6 +97,9 @@ class FleetDispatcher:
         self._queues = {s.host: queue.Queue() for s in self.slots}
         self._inflight = {s.host: 0 for s in self.slots}
         self._batches = {s.host: 0 for s in self.slots}
+        self._requests = {s.host: 0 for s in self.slots}
+        self._skew = {}  # host -> last wire-handshake clock skew (s)
+        self._hop_hist = {}  # (host, hop) -> registry Histogram
         self._evicted: set = set()
         self._rr = 0
         self._stop = threading.Event()
@@ -131,9 +143,27 @@ class FleetDispatcher:
     def submit(self, spec: dict) -> Future:
         slot = self.choose()
         fut: Future = Future()
+        # Trace context crosses the wire INSIDE the spec (the JSON-lines
+        # hop carries whole specs, so no envelope change): reuse the
+        # caller's / ambient ID, else mint one. ``t_submit`` is the
+        # coordinator's wall clock — the queue_wait hop's start, and the
+        # send-timestamp the merged trace anchors the flow on.
+        if spec.get("trace_id") is None:
+            from ft_sgemm_tpu.serve import tracing
+
+            spec["trace_id"] = (tracing.current_trace_id()
+                                or tracing.new_trace_id())
+        spec.setdefault("t_submit", time.time())
+        with self._lock:
+            self._requests[slot.host] += 1
         if self.registry is not None:
             self.registry.counter("fleet_dispatch_requests",
                                   host_tier=slot.host_tier).inc()
+        if self.timeline is not None:
+            self.timeline.point("fleet", f"submit_host{slot.host}",
+                                trace_id=spec["trace_id"],
+                                host=slot.host,
+                                host_tier=slot.host_tier)
         self._queues[slot.host].put((spec, fut))
         return fut
 
@@ -159,6 +189,7 @@ class FleetDispatcher:
                 continue
             with self._lock:
                 self._inflight[slot.host] += 1
+            t_dequeue = time.time()
             try:
                 reply = slot.runner(spec)
             except Exception as e:  # noqa: BLE001 — reply path owns errors
@@ -168,12 +199,66 @@ class FleetDispatcher:
                 with self._lock:
                     self._inflight[slot.host] -= 1
                     self._batches[slot.host] += 1
+            try:
+                self._note_hops(slot, spec, reply, t_dequeue,
+                                time.time() - t_dequeue)
+            except Exception:  # noqa: BLE001 — observability only
+                pass
             if self.on_reply is not None:
                 try:
                     self.on_reply(slot.host, spec, reply)
                 except Exception:  # noqa: BLE001 — observability only
                     pass
             fut.set_result(reply)
+
+    # -- per-hop latency + clock skew --------------------------------------
+
+    def _observe_hop(self, slot: HostSlot, hop: str, v) -> None:
+        if not isinstance(v, (int, float)) or v < 0:
+            return
+        from ft_sgemm_tpu.telemetry.registry import LATENCY_BUCKETS
+
+        h = self.registry.histogram(f"fleet_hop_{hop}_seconds",
+                                    buckets=LATENCY_BUCKETS,
+                                    host=str(slot.host),
+                                    host_tier=slot.host_tier)
+        h.observe(float(v))
+        with self._lock:
+            self._hop_hist[(slot.host, hop)] = h
+
+    def _note_hops(self, slot: HostSlot, spec: dict, reply: dict,
+                   t_dequeue: float, runner_seconds: float) -> None:
+        """Decompose one completed request into the FLEET_HOPS latency
+        taxonomy and record the remote rank's wire-handshake clock skew.
+        Every field is read tolerantly — a reply from an older/foreign
+        runner simply contributes fewer hops, never an error."""
+        if self.registry is None or not isinstance(reply, dict):
+            return
+        t_submit = spec.get("t_submit")
+        if isinstance(t_submit, (int, float)):
+            self._observe_hop(slot, "queue_wait", t_dequeue - t_submit)
+        self._observe_hop(slot, "remote_execute", reply.get("seconds"))
+        self._observe_hop(slot, "retry", reply.get("retry_seconds"))
+        wire = reply.get("wire")
+        if isinstance(wire, dict):
+            # The remote runner already solved the NTP-midpoint
+            # handshake (fleet/worker.py::_remote_runner): rtt is the
+            # wire round trip minus the remote's hold time, skew the
+            # midpoint clock offset — refreshed on every connection.
+            self._observe_hop(slot, "rtt", wire.get("rtt_seconds"))
+            self._observe_hop(slot, "remote_queue",
+                              wire.get("remote_queue_seconds"))
+            skew = wire.get("skew_seconds")
+            if isinstance(skew, (int, float)):
+                with self._lock:
+                    self._skew[slot.host] = float(skew)
+                self.registry.gauge("fleet_clock_skew_seconds",
+                                    host=str(slot.host)).set(float(skew))
+        elif slot.host_tier == "local":
+            # The coordinator's own pool: no wire, no skew — the whole
+            # runner wall IS the execute+queue hop already recorded.
+            with self._lock:
+                self._skew.setdefault(slot.host, 0.0)
 
     # -- host eviction -----------------------------------------------------
 
@@ -221,8 +306,12 @@ class FleetDispatcher:
     # -- introspection / shutdown -----------------------------------------
 
     def stats(self) -> dict:
+        from ft_sgemm_tpu.telemetry.registry import histogram_percentiles
+
         with self._lock:
-            return {
+            hop_hist = {k: h.value for k, h in self._hop_hist.items()}
+            skew = dict(self._skew)
+            out = {
                 "placement": self.placement,
                 "evicted_hosts": sorted(self._evicted),
                 "per_host": {
@@ -230,9 +319,25 @@ class FleetDispatcher:
                              "dcn_distance": s.dcn_distance,
                              "queued": self._queues[s.host].qsize(),
                              "inflight": self._inflight[s.host],
-                             "batches": self._batches[s.host]}
+                             "batches": self._batches[s.host],
+                             "requests": self._requests[s.host]}
                     for s in self.slots},
             }
+        for s in self.slots:
+            row = out["per_host"][s.host]
+            if s.host in skew:
+                row["clock_skew_seconds"] = skew[s.host]
+            # Percentile ESTIMATES from the single stats path — the
+            # same registry histogram buckets /metrics exports, never a
+            # second latency accumulator (DESIGN.md §11 discipline).
+            hops = {}
+            for hop in FLEET_HOPS:
+                value = hop_hist.get((s.host, hop))
+                if value and value.get("count"):
+                    hops[hop] = histogram_percentiles(value)
+            if hops:
+                row["hop_percentiles"] = hops
+        return out
 
     def stop(self) -> None:
         self._stop.set()

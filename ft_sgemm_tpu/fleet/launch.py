@@ -306,6 +306,10 @@ def launch_fleet(spec: FleetSpec) -> dict:
         "wall_seconds": round(time.monotonic() - t0, 3),
         "ranks": ranks,
         "result": ranks.get(0, {}).get("result"),
+        # Where traceview.merge_fleet stitches the per-rank timelines
+        # (+ this supervisor's) into ONE skew-corrected Perfetto trace.
+        "workdir": workdir,
+        "merged_trace": os.path.join(workdir, "fleet.trace.json"),
     }
     fleet_tl.point("fleet", "collected",
                    ok=report["ok"],

@@ -19,6 +19,10 @@ Programs (``FT_SGEMM_FLEET_PROGRAM``):
   boundary, cross-process ``inject_coords`` localization into per-rank
   event shards, and the fleet checksum tiers with an in-flight DCN
   corruption detected at — only at — the global tier.
+- ``trace``    — the cross-process trace-join drill: the TCP serve hop
+  with a forced detect->retry on the remote rank, so one trace_id flows
+  coordinator -> remote execute -> remote retry in the merged Perfetto
+  trace (the tier-1 shape of the smoke program's serve tier).
 - ``smoke``    — ``counters`` plus the serve acts: per-process pools
   behind the coordinator's :class:`~ft_sgemm_tpu.fleet.dispatch.
   FleetDispatcher` (DCN distance as placement cost), host-granularity
@@ -424,8 +428,12 @@ class _PoolExecutor:
         import jax
         import numpy as np
 
+        from ft_sgemm_tpu.ops.common import gemm_cost_breakdown
+        from ft_sgemm_tpu.perf.economics import gemm_request_cost
         from ft_sgemm_tpu.utils import verify_matrix
 
+        trace_id = spec.get("trace_id")
+        t_exec_start = time.time()
         rng = np.random.default_rng(int(spec.get("seed", 0)))
         nn = self.bucket
         a = rng.standard_normal((nn, nn), dtype=np.float32)
@@ -434,6 +442,8 @@ class _PoolExecutor:
         injected = bool(spec.get("inject")) or (
             spec.get("inject_host") is not None
             and int(spec["inject_host"]) == self.ctx.rank)
+        force_retry = (spec.get("force_retry_host") is not None
+                       and int(spec["force_retry_host"]) == self.ctx.rank)
         index = self.pool.choose()
         device = self.pool.devices[index]
         fn = self._get_compiled(index, injected)
@@ -441,8 +451,28 @@ class _PoolExecutor:
         bj = jax.device_put(b, device)
         cj = jax.device_put(c, device)
         t0 = time.monotonic()
+        retries = 0
+        retry_detections = 0
+        retry_seconds = 0.0
+        if force_retry:
+            # Deterministic detect->retry hop for the trace-join drill:
+            # run the injected variant once, DISCARD the (corrected)
+            # attempt as a detection would, and re-execute clean below.
+            # The discarded attempt's wall and flops are the request's
+            # retry overhead; its detections ride a separate reply key
+            # so the coordinator's blame feed sees only real faults.
+            bad = self._get_compiled(index, True)(aj, bj, cj)
+            np.asarray(bad.c)
+            retry_detections = int(bad.num_detected)
+            retries = 1
+            self.ctx.tl.point("fleet", f"rank{self.ctx.rank}:retry",
+                              trace_id=trace_id,
+                              detections=retry_detections)
+            retry_t0 = time.monotonic()
         res = fn(aj, bj, cj)
         got = np.asarray(res.c)
+        if force_retry:
+            retry_seconds = time.monotonic() - retry_t0
         det = int(res.num_detected)
         unc = int(res.num_uncorrectable)
         want = (a.astype(np.float64) @ b.astype(np.float64).T).astype(
@@ -454,11 +484,40 @@ class _PoolExecutor:
         with self._lock:
             self._served += 1
             self._served_detections += det
-        return {"ok": bool(ok_v and unc == 0), "correct": bool(ok_v),
+        seconds = round(time.monotonic() - t0, 6)
+        ok = bool(ok_v and unc == 0)
+        if trace_id is not None:
+            # The remote half of the cross-process trace join: the same
+            # trace_id the coordinator stamped at submit, on this
+            # rank's OWN timeline (merge_fleet stitches the flow).
+            self.ctx.tl.point("fleet", f"rank{self.ctx.rank}:execute",
+                              trace_id=trace_id, detections=det,
+                              seconds=seconds,
+                              device=self.pool.labels[index])
+        # Request cost economics: the executor prices its own work with
+        # the shared component cost model (fp32 operands, this pool's
+        # kernel strategy) and ships the accounting home in the reply —
+        # the coordinator's CostLedger never re-prices remote work.
+        parts = gemm_cost_breakdown(nn, nn, nn, 4,
+                                    block=(128, 128, 128),
+                                    strategy="weighted")
+        productive, overhead = gemm_request_cost(parts, retries=retries)
+        return {"ok": ok, "correct": bool(ok_v),
                 "detections": det, "uncorrectable": unc,
                 "host": self.ctx.rank,
                 "device": self.pool.labels[index],
-                "seconds": round(time.monotonic() - t0, 6)}
+                "seconds": seconds,
+                "trace_id": trace_id,
+                "t_exec_start": t_exec_start,
+                "retries": retries,
+                "retry_detections": retry_detections,
+                "retry_seconds": round(retry_seconds, 6),
+                "economics": {
+                    "flops_productive": productive,
+                    "overhead": overhead,
+                    "tokens": nn,
+                    "tokens_correct": nn if ok else 0,
+                    "seconds": seconds}}
 
     def stats(self) -> dict:
         with self._lock:
@@ -474,6 +533,7 @@ def _serve_remote(ctx: _Ctx, executor: _PoolExecutor) -> dict:
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
             line = self.rfile.readline()
+            t_wire_recv = time.time()
             if not line:
                 return
             try:
@@ -485,6 +545,13 @@ def _serve_remote(ctx: _Ctx, executor: _PoolExecutor) -> dict:
                 stop.set()
             else:
                 reply = executor.run(spec)
+            # The remote half of the NTP-midpoint clock handshake: this
+            # rank's wall clock at wire receive and wire send ride every
+            # reply; the caller (_remote_runner) holds the other two
+            # timestamps and solves for skew + rtt per connection.
+            reply["wire"] = {"t_wire_recv": t_wire_recv,
+                             "t_wire_send": time.time(),
+                             "t_exec_start": reply.get("t_exec_start")}
             self.wfile.write((json.dumps(reply) + "\n").encode("utf-8"))
 
     class Server(socketserver.ThreadingTCPServer):
@@ -511,6 +578,7 @@ def _serve_remote(ctx: _Ctx, executor: _PoolExecutor) -> dict:
 
 def _remote_runner(port: int):
     def run(spec: dict) -> dict:
+        t_send = time.time()
         with socket.create_connection(("127.0.0.1", port),
                                       timeout=120.0) as conn:
             conn.sendall((json.dumps(spec) + "\n").encode("utf-8"))
@@ -520,26 +588,53 @@ def _remote_runner(port: int):
                 if not chunk:
                     break
                 buf += chunk
-        return json.loads(buf.decode("utf-8"))
+        t_recv = time.time()
+        reply = json.loads(buf.decode("utf-8"))
+        wire = reply.get("wire")
+        if isinstance(wire, dict):
+            tr, tw = wire.get("t_wire_recv"), wire.get("t_wire_send")
+            if isinstance(tr, (int, float)) and isinstance(
+                    tw, (int, float)):
+                # NTP midpoint: the remote clock's offset assuming the
+                # two wire legs are symmetric — the estimate's error is
+                # bounded by half the leg asymmetry (DESIGN.md §21).
+                # Refreshed on EVERY connection; the dispatcher records
+                # the latest as fleet_clock_skew_seconds{host=}.
+                wire["skew_seconds"] = ((tr - t_send) + (tw - t_recv)) / 2.0
+                # rtt = wire round trip minus the remote's hold time
+                # (both differences on one clock each, so skew cancels).
+                wire["rtt_seconds"] = max(
+                    (t_recv - t_send) - (tw - tr), 0.0)
+                texec = wire.get("t_exec_start")
+                if isinstance(texec, (int, float)):
+                    wire["remote_queue_seconds"] = max(texec - tr, 0.0)
+        return reply
 
     return run
 
 
 def _drive(dispatcher, n_requests: int, seed0: int,
-           inject_host=None, timeout: float = 240.0) -> dict:
+           inject_host=None, force_retry_host=None,
+           timeout: float = 240.0) -> dict:
     """Burst-submit ``n_requests`` specs, wait for every reply, return
     the phase stats (the drill's _drive_phase shape, fleet-side)."""
     t0 = time.monotonic()
     futs = [dispatcher.submit({"seed": seed0 + i,
-                               "inject_host": inject_host})
+                               "inject_host": inject_host,
+                               "force_retry_host": force_retry_host})
             for i in range(n_requests)]
     first_ok = None
-    correct = incorrect = 0
+    correct = incorrect = retried = 0
+    trace_ids: list = []
     by_host: dict = {}
     for fut in futs:
         reply = fut.result(timeout=timeout)
         hh = reply.get("host")
         by_host[hh] = by_host.get(hh, 0) + 1
+        if reply.get("retries"):
+            retried += 1
+            if reply.get("trace_id"):
+                trace_ids.append(reply["trace_id"])
         if reply.get("ok") and reply.get("correct"):
             correct += 1
             if first_ok is None:
@@ -549,9 +644,36 @@ def _drive(dispatcher, n_requests: int, seed0: int,
     wall = time.monotonic() - t0
     return {"submitted": n_requests, "correct": correct,
             "incorrect": incorrect, "by_host": by_host,
+            "retried": retried, "retried_trace_ids": trace_ids[:8],
             "wall_seconds": round(wall, 3),
             "first_correct_ts": first_ok,
             "goodput_rps": round(correct / wall, 3) if wall > 0 else None}
+
+
+def _wire_slots(ctx: _Ctx, executor: "_PoolExecutor"):
+    """Build the dispatcher's host slots: rank 0 runs in-process, every
+    other rank is reached over its published TCP serve port (waits for
+    the rank's ``serve.json``)."""
+    from ft_sgemm_tpu.fleet.dispatch import HostSlot
+
+    slots = [HostSlot(host=0, runner=executor.run,
+                      host_tier="local", dcn_distance=0.0)]
+    ports = {}
+    deadline = time.monotonic() + 180.0
+    for r in range(1, ctx.nprocs):
+        path = os.path.join(ctx.workdir, f"rank{r}", "serve.json")
+        while time.monotonic() < deadline:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    ports[r] = json.load(fh)["port"]
+                break
+            except (OSError, json.JSONDecodeError, KeyError):
+                time.sleep(0.1)
+        if r not in ports:
+            raise TimeoutError(f"rank{r} never published its port")
+        slots.append(HostSlot(host=r, runner=_remote_runner(ports[r]),
+                              host_tier="dcn", dcn_distance=1.0))
+    return slots, ports
 
 
 def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
@@ -561,7 +683,7 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
     import numpy as np
 
     from ft_sgemm_tpu import telemetry
-    from ft_sgemm_tpu.fleet.dispatch import FleetDispatcher, HostSlot
+    from ft_sgemm_tpu.fleet.dispatch import FleetDispatcher
     from ft_sgemm_tpu.resilience import (ElasticController,
                                          EvictionPolicy, surviving_mesh)
     from ft_sgemm_tpu.telemetry.monitor import DeviceHealthTracker
@@ -571,23 +693,7 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
     faulty_host = ctx.nprocs - 1
 
     with ctx.tl.span("serve_wire", kind="stage") as info:
-        slots = [HostSlot(host=0, runner=executor.run,
-                          host_tier="local", dcn_distance=0.0)]
-        ports = {}
-        deadline = time.monotonic() + 180.0
-        for r in range(1, ctx.nprocs):
-            path = os.path.join(ctx.workdir, f"rank{r}", "serve.json")
-            while time.monotonic() < deadline:
-                try:
-                    with open(path, "r", encoding="utf-8") as fh:
-                        ports[r] = json.load(fh)["port"]
-                    break
-                except (OSError, json.JSONDecodeError, KeyError):
-                    time.sleep(0.1)
-            if r not in ports:
-                raise TimeoutError(f"rank{r} never published its port")
-            slots.append(HostSlot(host=r, runner=_remote_runner(ports[r]),
-                                  host_tier="dcn", dcn_distance=1.0))
+        slots, ports = _wire_slots(ctx, executor)
         info["value"] = {"ports": ports}
 
     fleet_health = DeviceHealthTracker()
@@ -598,6 +704,10 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
     blamed: dict = {}
     blame_lock = threading.Lock()
 
+    from ft_sgemm_tpu.perf.economics import CostLedger
+
+    econ = CostLedger()
+
     def on_reply(host, spec, reply):
         if reply.get("detections", 0) > 0 or not reply.get("ok", False):
             controller.note_device_blame(host,
@@ -605,6 +715,13 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
             registry.counter("fleet_device_blames").inc()
             with blame_lock:
                 blamed[host] = blamed.get(host, 0) + 1
+        # The cost plane rides the same reply feed as blame: every
+        # rank prices its own work (executor.run's economics block) and
+        # the coordinator only aggregates.
+        econ.merge_reply(reply.get("economics"),
+                         device=reply.get("device"),
+                         host=host, ok=bool(reply.get("ok")),
+                         trace_id=reply.get("trace_id"))
 
     dispatcher = FleetDispatcher(slots, health=fleet_health,
                                  registry=registry, timeline=ctx.tl,
@@ -617,6 +734,21 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
             assert len(base["by_host"]) == ctx.nprocs, base["by_host"]
             info["value"] = {"goodput_rps": base["goodput_rps"],
                              "by_host": base["by_host"]}
+
+        with ctx.tl.span("serve_trace", kind="stage") as info:
+            # The cross-process trace drill: forced detect->retry on the
+            # remote rank so ONE trace_id flows coordinator submit ->
+            # remote execute -> remote retry in the merged Perfetto
+            # trace (ISSUE-20's flow-join acceptance). Discarded-attempt
+            # detections ride a separate reply key, so the blame feed
+            # stays quiet until the real fault phase below.
+            tr = _drive(dispatcher, max(6, n_req // 3), seed0=3000,
+                        force_retry_host=faulty_host)
+            facts["trace"] = tr
+            assert tr["incorrect"] == 0, tr
+            assert tr["retried"] > 0, tr
+            info["value"] = {"retried": tr["retried"],
+                             "trace_ids": tr["retried_trace_ids"][:3]}
 
         with ctx.tl.span("serve_fault", kind="stage") as info:
             controller.mark_fault()
@@ -711,7 +843,103 @@ def _serve_coordinator(ctx: _Ctx, executor: _PoolExecutor, jax) -> dict:
                 pass
         dispatcher.stop()
     facts["dispatcher"] = dispatcher.stats()
+    # Publish the aggregated cost view as live economics_* gauges (the
+    # monitor /metrics + cli top feed) and keep the snapshot as a fact
+    # — bench.py forwards it as the artifact's economics context block.
+    facts["economics"] = econ.publish(registry)
     return facts
+
+
+def _trace_coordinator(ctx: _Ctx, executor: _PoolExecutor) -> dict:
+    """Rank 0's trace-drill acts: wire the TCP slots, drive one
+    baseline burst and one forced detect->retry burst on the remote
+    rank — just enough wire traffic for ``traceview.merge_fleet`` to
+    join one trace_id across the process boundary. The tier-1 shape of
+    the smoke program's serve acts (no eviction/reshard)."""
+    from ft_sgemm_tpu import telemetry
+    from ft_sgemm_tpu.fleet.dispatch import FleetDispatcher
+    from ft_sgemm_tpu.perf.economics import CostLedger
+
+    facts: dict = {}
+    n_req = int(ctx.args.get("requests", 8))
+    remote = ctx.nprocs - 1
+
+    with ctx.tl.span("serve_wire", kind="stage") as info:
+        slots, ports = _wire_slots(ctx, executor)
+        info["value"] = {"ports": ports}
+
+    registry = telemetry.get_registry()
+    econ = CostLedger()
+
+    def on_reply(host, spec, reply):
+        econ.merge_reply(reply.get("economics"),
+                         device=reply.get("device"),
+                         host=host, ok=bool(reply.get("ok")),
+                         trace_id=reply.get("trace_id"))
+
+    dispatcher = FleetDispatcher(slots, registry=registry,
+                                 timeline=ctx.tl, on_reply=on_reply)
+    try:
+        with ctx.tl.span("serve_baseline", kind="stage") as info:
+            base = _drive(dispatcher, n_req, seed0=1000)
+            facts["baseline"] = base
+            assert base["incorrect"] == 0, base
+            info["value"] = {"by_host": base["by_host"]}
+
+        with ctx.tl.span("serve_trace", kind="stage") as info:
+            tr = _drive(dispatcher, max(4, n_req // 2), seed0=3000,
+                        force_retry_host=remote)
+            facts["trace"] = tr
+            assert tr["incorrect"] == 0, tr
+            assert tr["retried"] > 0, tr
+            info["value"] = {"retried": tr["retried"],
+                             "trace_ids": tr["retried_trace_ids"][:3]}
+    finally:
+        for slot in slots[1:]:
+            try:
+                slot.runner({"op": "stop"})
+            except OSError:
+                pass
+        dispatcher.stop()
+    facts["dispatcher"] = dispatcher.stats()
+    facts["economics"] = econ.publish(registry)
+    return facts
+
+
+def run_trace(ctx: _Ctx) -> int:
+    """The cross-process trace-join drill: real jax.distributed ranks,
+    the real TCP serve hop, one forced retry on the remote rank — the
+    minimal program whose merged trace must show one trace_id flowing
+    coordinator -> remote execute -> remote retry (tests/test_fleet.py
+    runs it tier-1; the smoke program carries the full acceptance)."""
+    jax = _init_distributed(ctx)
+    with ctx.tl.span("serve_pool", kind="stage") as info:
+        executor = _PoolExecutor(ctx)
+        info["value"] = {"devices": list(executor.pool.labels)}
+    from ft_sgemm_tpu import telemetry
+
+    with telemetry.session(os.path.join(ctx.rankdir,
+                                        "events_serve.jsonl")):
+        if ctx.rank == 0:
+            serve = _trace_coordinator(ctx, executor)
+        else:
+            serve = _serve_remote(ctx, executor)
+    result = {"ok": True, "rank": ctx.rank,
+              "process_count": jax.process_count(), "serve": serve}
+    if ctx.rank == 0:
+        disp = serve.get("dispatcher", {})
+        skew = {str(h): row["clock_skew_seconds"]
+                for h, row in (disp.get("per_host") or {}).items()
+                if isinstance(row, dict) and isinstance(
+                    row.get("clock_skew_seconds"), (int, float))}
+        result["fleet"] = {
+            "economics": serve.get("economics"),
+            "clock_skew_seconds": skew,
+            "trace_retried": serve.get("trace", {}).get("retried"),
+            "trace_ids": serve.get("trace", {}).get("retried_trace_ids"),
+        }
+    ctx.write_result(result)
+    return 0
 
 
 def run_smoke(ctx: _Ctx) -> int:
@@ -742,7 +970,16 @@ def _fleet_facts(ctx: _Ctx, facts: dict, serve: dict) -> dict:
     ledger measurements."""
     base = serve.get("baseline", {})
     rec = serve.get("recovery", {})
+    disp = serve.get("dispatcher", {})
+    skew = {str(h): row["clock_skew_seconds"]
+            for h, row in (disp.get("per_host") or {}).items()
+            if isinstance(row, dict) and isinstance(
+                row.get("clock_skew_seconds"), (int, float))}
     return {
+        "economics": serve.get("economics"),
+        "clock_skew_seconds": skew,
+        "trace_retried": serve.get("trace", {}).get("retried"),
+        "trace_ids": serve.get("trace", {}).get("retried_trace_ids"),
         "processes": ctx.nprocs,
         "vdevs_per_process": ctx.vdevs,
         "staged_equals_flat": facts.get("staged_equals_flat"),
@@ -766,7 +1003,8 @@ def _fleet_facts(ctx: _Ctx, facts: dict, serve: dict) -> dict:
 
 
 PROGRAMS = {"wedge": run_wedge, "noop": run_noop,
-            "counters": run_counters, "smoke": run_smoke}
+            "counters": run_counters, "smoke": run_smoke,
+            "trace": run_trace}
 
 
 def main() -> int:

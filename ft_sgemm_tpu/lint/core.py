@@ -75,6 +75,8 @@ REGISTRY_PATH = "ft_sgemm_tpu/telemetry/registry.py"
 BUCKETS_PATH = "ft_sgemm_tpu/serve/buckets.py"
 CLI_PATH = "ft_sgemm_tpu/cli.py"
 CHAOS_MODELS_PATH = "ft_sgemm_tpu/chaos/models.py"
+FLEET_DISPATCH_PATH = "ft_sgemm_tpu/fleet/dispatch.py"
+ECONOMICS_PATH = "ft_sgemm_tpu/perf/economics.py"
 
 DEFAULT_ALLOWLIST = "lint-allowlist.json"
 
@@ -289,6 +291,9 @@ class Declarations:
         self.fleet_placements = tuple(
             contracts.get("FLEET_PLACEMENTS", ()))
         self.fault_models = tuple(contracts.get("FAULT_MODELS", ()))
+        self.fleet_hops = tuple(contracts.get("FLEET_HOPS", ()))
+        self.overhead_causes = tuple(
+            contracts.get("OVERHEAD_CAUSES", ()))
 
         self.strategies = tuple(configs.get("STRATEGIES", ()))
         self.encode_modes = tuple(configs.get("ENCODE_MODES", ()))
@@ -514,6 +519,8 @@ AXIS_VAR_SETS = {
     "host_tier": "host_tiers",
     "fleet_placement": "fleet_placements",
     "fault_model": "fault_models",
+    "hop": "fleet_hops",
+    "overhead_cause": "overhead_causes",
 }
 
 
@@ -769,6 +776,14 @@ def check_axis_drift(repo: Repo, decls: Declarations):
     # spelling).
     if decls.fault_models:
         mirror["fault_model"] = decls.fault_models
+    # The fleet-hop and overhead-cause axes (PR 20): contracts-direct
+    # like the fleet/chaos planes (fleet/dispatch.py::FLEET_HOPS and
+    # perf/economics.py::OVERHEAD_CAUSES hold the runtime spellings,
+    # checked in (4b) below).
+    if decls.fleet_hops:
+        mirror["hop"] = decls.fleet_hops
+    if decls.overhead_causes:
+        mirror["overhead_cause"] = decls.overhead_causes
     if not decls.axis_labels:
         f(EVENTS_PATH, 1, "AXIS_LABELS",
           "telemetry axis-label schema missing")
@@ -791,6 +806,20 @@ def check_axis_drift(repo: Repo, decls: Declarations):
             f(CHAOS_MODELS_PATH, 1, "FAULT_MODELS",
               f"runtime fault-model spelling {runtime} !="
               f" contracts.FAULT_MODELS {decls.fault_models}")
+    # Same triple-declaration discipline for the PR-20 axes: the fleet
+    # hop taxonomy (fleet/dispatch.py names the histogram families from
+    # it) and the cost-plane overhead causes (perf/economics.py is the
+    # only copy the ledger validates against).
+    for path, symbol, want in (
+            (FLEET_DISPATCH_PATH, "FLEET_HOPS", decls.fleet_hops),
+            (ECONOMICS_PATH, "OVERHEAD_CAUSES", decls.overhead_causes)):
+        tree = repo.tree(path)
+        if want and tree is not None:
+            runtime = tuple(module_literals(tree).get(symbol, ()))
+            if runtime != want:
+                f(path, 1, symbol,
+                  f"runtime {symbol} spelling {runtime} !="
+                  f" contracts.{symbol} {want}")
 
     # (5) serve routing reads the hoisted tables.
     btree = repo.tree(BUCKETS_PATH)
@@ -854,7 +883,9 @@ def check_axis_drift(repo: Repo, decls: Declarations):
                      "ladder_rung": set(decls.ladder_rungs),
                      "host_tier": set(decls.host_tiers),
                      "fleet_placement": set(decls.fleet_placements),
-                     "fault_model": set(decls.fault_models)}
+                     "fault_model": set(decls.fault_models),
+                     "hop": set(decls.fleet_hops),
+                     "overhead_cause": set(decls.overhead_causes)}
     for rel in sorted(repo.trees):
         if not (rel.startswith("ft_sgemm_tpu/") or rel == "bench.py"
                 or rel.startswith("scripts/")):

@@ -1,0 +1,319 @@
+"""Request cost economics: flops-accounted useful-vs-overhead ledger.
+
+ROADMAP item 2's headline metric is tokens-correct-per-second-per-device
+under injected faults, and the PR-15 recompute ladder already prices
+every recovery rung in flops (``resilience/recompute.py::recover_local``
+returns ``recomputed_flops`` / ``full_retry_flops``) — but no plane
+attributes a REQUEST's total cost to its causes. This module is that
+plane: every served request rolls into one :class:`CostRecord` —
+productive GEMM/attention flops from the same component cost model the
+roofline uses (``ops/common.gemm_cost_breakdown``), plus the overhead
+flops each fault-tolerance mechanism spent on its behalf — and a
+:class:`CostLedger` aggregates the records per device/host/bucket into
+the three numbers the arXiv 2507.16676 end-to-end stance asks for:
+
+- **useful-flops fraction** — productive / (productive + overhead);
+- **overhead breakdown by cause** — each cause's flops divided by the
+  SAME grand total, so ``useful + sum(overhead fractions) == 1``
+  exactly and the breakdown can never sum past 1 by construction;
+- **tokens-correct-per-second-per-device** — correct output tokens over
+  the observed wall window, normalized by distinct devices touched.
+
+The closed overhead-cause axis is :data:`OVERHEAD_CAUSES` (mirrored by
+``contracts.OVERHEAD_CAUSES`` and ``events.AXIS_LABELS
+["overhead_cause"]`` — the BLOCK_PHASES import-free mirror discipline,
+cross-checked by the lint axis-drift pass):
+
+  encode        ABFT checksum-encode flops (the always-on premium)
+  check         detect/correct epilogue flops (always-on premium)
+  retry         full re-execution flops of bounded retry attempts
+  recompute     recovery-ladder rung flops (recover_local's accounting)
+  kv_reverify   stored-state re-verification + page-restore flops
+
+Callers compute the component flops with the tools they already have
+(``gemm_cost_breakdown`` for GEMM requests, :func:`attention_cost` for
+block requests, a ``RecoveryOutcome`` for ladder runs) and hand the
+numbers in; the ledger itself never prices anything — one cost model,
+one accounting plane, no second opinion.
+
+Economics rides the RUN LEDGER (``perf/ledger.py`` ``economics.*``
+measurements, trend-gated like GFLOPS), not a new artifact: the
+useful-flops fraction is a longitudinal health series exactly like
+recovery MTTR, and inventing a second history file would fork the
+trend plane (DESIGN.md §21).
+
+HARD CONSTRAINT — timeline.py discipline: stdlib only, no
+package-relative imports. The jax-free supervisor side (bench.py,
+``cli economics``, scripts) loads this file directly via
+``importlib.util.spec_from_file_location``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+# Runtime spelling of contracts.OVERHEAD_CAUSES (the lint axis-drift
+# pass cross-checks both against events.AXIS_LABELS["overhead_cause"]).
+OVERHEAD_CAUSES = ("encode", "check", "retry", "recompute", "kv_reverify")
+
+
+def _f(v) -> float:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else 0.0
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """One request's flops accounting: what was useful, what each
+    fault-tolerance mechanism spent on its behalf, and whether the
+    tokens it produced were correct."""
+
+    flops_productive: float = 0.0
+    overhead: Dict[str, float] = dataclasses.field(default_factory=dict)
+    tokens: int = 0
+    tokens_correct: int = 0
+    seconds: Optional[float] = None
+    device: Optional[str] = None
+    host: Optional[object] = None
+    bucket: Optional[str] = None
+    trace_id: Optional[str] = None
+    request_id: Optional[object] = None
+    ok: bool = True
+
+    def __post_init__(self):
+        unknown = [c for c in self.overhead if c not in OVERHEAD_CAUSES]
+        if unknown:
+            raise ValueError(
+                f"unknown overhead cause(s) {unknown!r}; the closed axis"
+                f" is {OVERHEAD_CAUSES}")
+        self.flops_productive = _f(self.flops_productive)
+        self.overhead = {c: _f(v) for c, v in self.overhead.items()}
+
+    @property
+    def flops_overhead(self) -> float:
+        return sum(self.overhead.values())
+
+    @property
+    def flops_total(self) -> float:
+        return self.flops_productive + self.flops_overhead
+
+
+def gemm_request_cost(parts: dict, *, retries: int = 0,
+                      recompute_flops: float = 0.0) -> Tuple[float, dict]:
+    """(productive, overhead-by-cause) of one GEMM request from a
+    ``gemm_cost_breakdown`` dict. The plain GEMM (``flops_base``) is the
+    productive work; encode/check are the always-on ABFT premium; each
+    bounded retry re-executes the WHOLE pass (base + premium — the
+    transient-SDC model re-runs everything); ladder recompute flops are
+    whatever ``recover_local`` priced."""
+    base = _f(parts.get("flops_base"))
+    encode = _f(parts.get("flops_encode"))
+    check = _f(parts.get("flops_check"))
+    overhead = {"encode": encode, "check": check}
+    if retries:
+        overhead["retry"] = int(retries) * (base + encode + check)
+    if recompute_flops:
+        overhead["recompute"] = _f(recompute_flops)
+    return base, overhead
+
+
+def attention_cost(lq: int, lk: int, d: int, dv: int) -> dict:
+    """Component flops of one checked attention block call, in the
+    ``gemm_cost_breakdown`` key vocabulary. Productive work is the two
+    dense products (``Q@K^T`` then ``P@V``: ``2*lq*lk*(d+dv)``); the
+    ABFT premium is the operand checksum-row encode (one reduction over
+    each of K, V, and Q: ``2*(lk*(d+dv) + lq*d)``) and the per-query
+    residual check over scores and output (``2*lq*(lk+dv)``). Pinned
+    here (and in tests/test_economics.py) as THE accounting the block
+    engine reports — the attention mirror of the GEMM cost model."""
+    lq, lk, d, dv = int(lq), int(lk), int(d), int(dv)
+    return {
+        "flops_base": 2 * lq * lk * (d + dv),
+        "flops_encode": 2 * (lk * (d + dv) + lq * d),
+        "flops_check": 2 * lq * (lk + dv),
+    }
+
+
+def kv_reverify_flops(*, restores: int = 0, reread_rows: int = 0,
+                      page_size: int = 0, d: int = 0,
+                      dv: int = 0) -> float:
+    """Flops of the stored-state ladder: each page restore reseals one
+    page's checksum rows (``2*page_size*(d+dv)``), and every re-read
+    pass re-reduces the whole cached stream (``2*reread_rows*(d+dv)``).
+    """
+    width = int(d) + int(dv)
+    return float(2 * int(restores) * int(page_size) * width
+                 + 2 * int(reread_rows) * width)
+
+
+def recovery_overhead(outcome) -> float:
+    """The ``recompute`` overhead flops of one ladder run — exactly
+    ``RecoveryOutcome.recomputed_flops`` (attribute or dict key), the
+    pinned accounting of ``resilience/recompute.py::recover_local``."""
+    if isinstance(outcome, dict):
+        return _f(outcome.get("recomputed_flops"))
+    return _f(getattr(outcome, "recomputed_flops", 0.0))
+
+
+class CostLedger:
+    """Thread-safe roll-up of :class:`CostRecord`\\ s into the
+    per-device/host/bucket economics view. ``add`` never raises past
+    record validation; ``snapshot`` is pure derivation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records = 0
+        self._productive = 0.0
+        self._overhead = {c: 0.0 for c in OVERHEAD_CAUSES}
+        self._tokens = 0
+        self._tokens_correct = 0
+        self._seconds = 0.0
+        self._requests_ok = 0
+        self._t0: Optional[float] = None
+        self._t1: Optional[float] = None
+        self._per: Dict[str, Dict[object, dict]] = {
+            "device": {}, "host": {}, "bucket": {}}
+
+    def add(self, record: Optional[CostRecord] = None, **fields) -> CostRecord:
+        """Roll one request in (pass a record, or the CostRecord fields
+        directly). Returns the record for chaining."""
+        rec = record if record is not None else CostRecord(**fields)
+        now = time.monotonic()
+        with self._lock:
+            self._records += 1
+            self._productive += rec.flops_productive
+            for cause, v in rec.overhead.items():
+                self._overhead[cause] += v
+            self._tokens += int(rec.tokens)
+            self._tokens_correct += int(rec.tokens_correct)
+            if rec.seconds is not None:
+                self._seconds += _f(rec.seconds)
+            if rec.ok:
+                self._requests_ok += 1
+            self._t0 = now if self._t0 is None else self._t0
+            self._t1 = now
+            for axis, key in (("device", rec.device), ("host", rec.host),
+                              ("bucket", rec.bucket)):
+                if key is None:
+                    continue
+                row = self._per[axis].setdefault(
+                    key, {"requests": 0, "flops_productive": 0.0,
+                          "flops_overhead": 0.0, "tokens_correct": 0})
+                row["requests"] += 1
+                row["flops_productive"] += rec.flops_productive
+                row["flops_overhead"] += rec.flops_overhead
+                row["tokens_correct"] += int(rec.tokens_correct)
+        return rec
+
+    def merge_reply(self, economics: dict, **fields) -> Optional[CostRecord]:
+        """Roll in a wire-shaped economics dict (the fleet reply block:
+        ``{"flops_productive", "overhead": {...}, "tokens",
+        "tokens_correct", "seconds"}``). Hostile shapes are dropped —
+        a remote rank's missing accounting must not kill dispatch."""
+        if not isinstance(economics, dict):
+            return None
+        try:
+            overhead = economics.get("overhead")
+            return self.add(
+                flops_productive=_f(economics.get("flops_productive")),
+                overhead={c: _f(v) for c, v in overhead.items()
+                          if c in OVERHEAD_CAUSES}
+                if isinstance(overhead, dict) else {},
+                tokens=int(_f(economics.get("tokens"))),
+                tokens_correct=int(_f(economics.get("tokens_correct"))),
+                seconds=economics.get("seconds")
+                if isinstance(economics.get("seconds"), (int, float))
+                else None,
+                **fields)
+        except (TypeError, ValueError):
+            return None
+
+    def snapshot(self, *, wall_seconds: Optional[float] = None,
+                 devices: Optional[int] = None) -> dict:
+        """The aggregated economics view. Every fraction divides by the
+        SAME grand total (productive + all overhead), so
+        ``useful_flops_fraction + sum(overhead_fractions.values())``
+        is exactly 1.0 when any flops were recorded — the breakdown
+        sums to <= 1 by construction, never by luck."""
+        with self._lock:
+            productive = self._productive
+            overhead = dict(self._overhead)
+            records = self._records
+            tokens = self._tokens
+            tokens_correct = self._tokens_correct
+            seconds = self._seconds
+            requests_ok = self._requests_ok
+            wall = (self._t1 - self._t0
+                    if self._t0 is not None and self._t1 is not None
+                    else None)
+            per = {axis: {k: dict(v) for k, v in rows.items()}
+                   for axis, rows in self._per.items()}
+        if wall_seconds is not None:
+            wall = float(wall_seconds)
+        total = productive + sum(overhead.values())
+        n_dev = (int(devices) if devices is not None
+                 else max(len(per["device"]), 1))
+        tcpspd = None
+        if wall is not None and wall > 0:
+            tcpspd = round(tokens_correct / wall / max(n_dev, 1), 3)
+        snap = {
+            "requests": records,
+            "requests_ok": requests_ok,
+            "flops_productive": productive,
+            "flops_overhead": overhead,
+            "flops_total": total,
+            "useful_flops_fraction": (round(productive / total, 6)
+                                      if total > 0 else None),
+            "overhead_fractions": {
+                c: (round(v / total, 6) if total > 0 else None)
+                for c, v in overhead.items()},
+            "overhead_flops_fraction": (
+                round(sum(overhead.values()) / total, 6)
+                if total > 0 else None),
+            "tokens": tokens,
+            "tokens_correct": tokens_correct,
+            "busy_seconds": round(seconds, 6),
+            "wall_seconds": (round(wall, 6) if wall is not None else None),
+            "devices": n_dev if per["device"] or devices is not None
+            else None,
+            "tokens_correct_per_second_per_device": tcpspd,
+            "per_device": per["device"],
+            "per_host": per["host"],
+            "per_bucket": per["bucket"],
+        }
+        return snap
+
+    def publish(self, registry, *, wall_seconds: Optional[float] = None,
+                devices: Optional[int] = None) -> dict:
+        """Set the live ``economics_*`` gauges on a telemetry registry
+        (duck-typed: anything with ``.gauge(name, **labels).set(v)``) —
+        the ``cli top`` feed. Returns the snapshot it published."""
+        snap = self.snapshot(wall_seconds=wall_seconds, devices=devices)
+        try:
+            if snap["useful_flops_fraction"] is not None:
+                registry.gauge("economics_useful_flops_fraction").set(
+                    snap["useful_flops_fraction"])
+            registry.gauge("economics_flops_total").set(
+                snap["flops_total"])
+            registry.gauge("economics_requests").set(snap["requests"])
+            registry.gauge("economics_tokens_correct").set(
+                snap["tokens_correct"])
+            if snap["tokens_correct_per_second_per_device"] is not None:
+                registry.gauge(
+                    "economics_tokens_correct_per_second_per_device"
+                ).set(snap["tokens_correct_per_second_per_device"])
+            for cause, frac in snap["overhead_fractions"].items():
+                if frac is not None:
+                    registry.gauge("economics_overhead_flops_fraction",
+                                   overhead_cause=cause).set(frac)
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
+        return snap
+
+
+__all__ = ["OVERHEAD_CAUSES", "CostLedger", "CostRecord",
+           "attention_cost", "gemm_request_cost", "kv_reverify_flops",
+           "recovery_overhead"]

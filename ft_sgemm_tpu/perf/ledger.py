@@ -388,6 +388,28 @@ def _ingest_inner(doc, *, run_id, source) -> dict:
                 "global_tier", "staged_equals_flat", "host_blames",
                 "reshard")
         entry["fleet"] = {k: fleet.get(k) for k in keep if k in fleet}
+    # Request cost economics (ISSUE 20): the flops-accounted cost view
+    # rides the ledger as economics.* measurements — NOT a new artifact:
+    # the useful-flops fraction is a longitudinal health series exactly
+    # like recovery MTTR, and a second history file would fork the
+    # trend plane (DESIGN.md §21). The trend plane gates the useful
+    # fraction and per-device correct-token throughput up, the overhead
+    # fraction down; the full cause breakdown and rollups ride the
+    # entry body.
+    econ = ctx.get("economics")
+    if not isinstance(econ, dict) and isinstance(fleet, dict):
+        econ = fleet.get("economics")
+    if isinstance(econ, dict):
+        for key, hib in (("useful_flops_fraction", True),
+                         ("tokens_correct_per_second_per_device", True),
+                         ("overhead_flops_fraction", False)):
+            s = _measurement(econ.get(key), higher_is_better=hib)
+            if s:
+                entry["measurements"][f"economics.{key}"] = s
+        keep = ("requests", "requests_ok", "flops_total",
+                "flops_productive", "overhead_fractions", "tokens",
+                "tokens_correct", "devices", "wall_seconds")
+        entry["economics"] = {k: econ.get(k) for k in keep if k in econ}
     # Chaos campaign (ISSUE 19): the per-model coverage rollups land as
     # chaos.<model>.* measurements so `cli trend --gate` fails a fault
     # model whose detection/correction rate or goodput retention

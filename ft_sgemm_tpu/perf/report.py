@@ -195,6 +195,9 @@ class RunReport:
     timeline: Optional[dict] = None
     wall: Optional[dict] = None
     slo: Optional[dict] = None
+    # A serving run's cost-plane roll-up (perf/economics.py
+    # ``CostLedger.snapshot()``) — the "Cost economics" section.
+    economics: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = {"schema": self.schema, "manifest": self.manifest,
@@ -205,6 +208,8 @@ class RunReport:
             d["wall"] = self.wall
         if self.slo is not None:
             d["slo"] = self.slo
+        if self.economics is not None:
+            d["economics"] = self.economics
         return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -219,7 +224,8 @@ class RunReport:
                          schema=int(d.get("schema", SCHEMA_VERSION)),
                          timeline=d.get("timeline"),
                          wall=d.get("wall"),
-                         slo=d.get("slo"))
+                         slo=d.get("slo"),
+                         economics=d.get("economics"))
 
     @staticmethod
     def from_json(text: str) -> "RunReport":
@@ -307,6 +313,32 @@ class RunReport:
                 md += ["", "| device | health |", "|---|---|"]
                 for dev in sorted(dh, key=lambda d: dh[d]):
                     md.append(f"| {dev} | {dh[dev]:.3f} |")
+        econ = self.economics
+        if econ:
+            md += ["", "## Cost economics", ""]
+            uff = econ.get("useful_flops_fraction")
+            if uff is not None:
+                md.append(f"- **useful flops fraction**: {uff}")
+            for key, label in (
+                    ("requests", "requests"),
+                    ("requests_ok", "requests ok"),
+                    ("flops_total", "total flops"),
+                    ("tokens_correct", "tokens correct"),
+                    ("tokens_correct_per_second_per_device",
+                     "tokens-correct/s/device"),
+                    ("devices", "devices"),
+                    ("wall_seconds", "wall (s)")):
+                v = econ.get(key)
+                if v is not None:
+                    md.append(f"- **{label}**: {v}")
+            fracs = {c: v for c, v in
+                     (econ.get("overhead_fractions") or {}).items()
+                     if v}
+            if fracs:
+                md += ["", "| overhead cause | fraction of total flops |",
+                       "|---|---|"]
+                for cause in sorted(fracs, key=lambda c: -fracs[c]):
+                    md.append(f"| {cause} | {100 * fracs[cause]:.2f}% |")
         wa = self.wall
         if wa and wa.get("fractions"):
             md += ["", "## Wall attribution", ""]

@@ -63,6 +63,12 @@ from ft_sgemm_tpu.serve.engine import (
     _as_recorder,
     _device_label,
 )
+from ft_sgemm_tpu.perf.economics import (
+    CostLedger,
+    attention_cost,
+    gemm_request_cost,
+    kv_reverify_flops,
+)
 from ft_sgemm_tpu.serve.kv_cache import PagedKVCache
 from ft_sgemm_tpu.serve.tracing import new_trace_id, trace_scope
 from ft_sgemm_tpu.telemetry.registry import (
@@ -303,6 +309,10 @@ class BlockEngine:
         self._per_bucket: Dict[str, dict] = {
             b.key: {"requests": 0, "batches": 0, "retries": 0}
             for b in self.buckets}
+        # Cost plane: every request is priced with the same component
+        # cost model the checker uses (attention_cost), so the useful /
+        # overhead split is exact by construction, not sampled.
+        self.economics = CostLedger()
 
     # -- executors: one AOT executable per (bucket, variant) ----------------
 
@@ -1008,6 +1018,29 @@ class BlockEngine:
             self.registry.histogram("serve_block_latency_seconds",
                                     buckets=LATENCY_BUCKETS,
                                     **labels).observe(latency)
+        try:
+            # Cost plane: the bucket shape is what actually executed
+            # (padding flops are real work), retries re-execute the
+            # full checked kernel, and the kv ladder's restores +
+            # re-reads are priced as "kv_reverify" overhead.
+            parts = attention_cost(bucket.lq, bucket.lk, self.d, self.dv)
+            productive, overhead = gemm_request_cost(parts,
+                                                     retries=retries)
+            overhead["kv_reverify"] = kv_reverify_flops(
+                restores=kv_info["restores"],
+                reread_rows=kv_info.get("attempts", 0) * bucket.lk,
+                page_size=self.kv.page_size, d=self.d, dv=self.dv)
+            self.economics.add(
+                flops_productive=productive, overhead=overhead,
+                tokens=tokens, tokens_correct=tokens if ok else 0,
+                seconds=latency, device=_device_label(out),
+                bucket=bucket.key, trace_id=trace_id,
+                request_id=request.request_id, ok=ok)
+            self.economics.publish(self.registry)
+            if self.monitor is not None:
+                self.monitor.observe_economics(self.economics.snapshot())
+        except Exception:  # noqa: BLE001 — accounting never fails serving
+            pass
         request_extra = {
             "trace_id": trace_id,
             "request_id": request.request_id,
@@ -1085,6 +1118,9 @@ class BlockEngine:
         with self._kv_lock:
             out["kv"] = self.kv.stats()
         out["ring"] = self.ring
+        out["economics"] = self.economics.snapshot(
+            devices=self.pool.active_devices()
+            if self.pool is not None else None)
         if self.pool is not None:
             out["pool"] = self.pool.stats()
         return out

@@ -58,6 +58,7 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ft_sgemm_tpu.perf.economics import CostLedger, gemm_request_cost
 from ft_sgemm_tpu.serve.buckets import Bucket, select_bucket
 from ft_sgemm_tpu.serve.tracing import new_trace_id, trace_scope
 from ft_sgemm_tpu.telemetry.registry import (
@@ -328,6 +329,10 @@ class ServeEngine:
         self._per_bucket: Dict[str, dict] = {
             b.key: {"requests": 0, "batches": 0, "retries": 0}
             for b in self.buckets}
+        # The request cost plane (perf/economics.py): every completed
+        # request rolls its productive + overhead flops in; stats() and
+        # the live economics_* gauges read the same ledger.
+        self.economics = CostLedger()
 
     # -- kernel family per (bucket, variant) --------------------------------
 
@@ -949,6 +954,36 @@ class ServeEngine:
                 "layer": bucket.key, "tiles": blame_tiles,
                 "device": _device_label(res.c), "ts": time.time(),
                 "extra": dict(request_extra, ok=ok)})
+        try:
+            # Cost plane: price the request with the SAME component
+            # cost model the roofline uses. The bucket shape (not the
+            # ragged request shape) is what actually executed — padding
+            # flops are spent for real, so they are what gets split
+            # into productive vs overhead. Tokens = the request's own
+            # output rows (the ragged m), correct only when the final
+            # result verified.
+            from ft_sgemm_tpu.ops.common import gemm_cost_breakdown
+
+            itemsize = {"bfloat16": 2, "int8": 1,
+                        "float8_e4m3fn": 1}.get(bucket.in_dtype, 4)
+            tile = self._bucket_tile(bucket)
+            parts = gemm_cost_breakdown(
+                bucket.m, bucket.n, bucket.k, itemsize,
+                block=(tile.bm, tile.bn, tile.bk),
+                strategy=bucket.strategy)
+            productive, overhead = gemm_request_cost(parts,
+                                                     retries=retries)
+            self.economics.add(
+                flops_productive=productive, overhead=overhead,
+                tokens=m, tokens_correct=m if ok else 0,
+                seconds=latency, device=_device_label(res.c),
+                bucket=bucket.key, trace_id=trace_id,
+                request_id=request.request_id, ok=ok)
+            self.economics.publish(self.registry)
+            if self.monitor is not None:
+                self.monitor.observe_economics(self.economics.snapshot())
+        except Exception:  # noqa: BLE001 — accounting never fails serving
+            pass
         out = np.asarray(res.c)[:m, :n]
         result = ServeResult(
             request_id=request.request_id, bucket_key=bucket.key,
@@ -983,6 +1018,7 @@ class ServeEngine:
         out["per_bucket"] = per_bucket
         out["prewarmed"] = self._prewarmed
         out["latency"] = self.latency_percentiles()
+        out["economics"] = self.economics.snapshot()
         if self.pool is not None:
             out["pool"] = self.pool.stats()
         return out

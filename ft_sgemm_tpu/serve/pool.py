@@ -267,6 +267,13 @@ class DevicePool:
         with self._lock:
             return len(self._queues[index])
 
+    def active_devices(self) -> int:
+        """Devices still eligible for placement (total minus evicted) —
+        the denominator the cost plane uses so per-device throughput
+        reflects the fleet that is actually serving."""
+        with self._lock:
+            return max(len(self.devices) - len(self._evicted), 1)
+
     def stats(self) -> dict:
         """Per-device placement snapshot + the drain picture."""
         with self._lock:

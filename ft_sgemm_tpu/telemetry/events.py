@@ -102,6 +102,22 @@ AXIS_LABELS = {
     # the runtime spelling); rides fleet timeline points and dispatch
     # event extras.
     "fleet_placement": ("dcn_cost", "round_robin"),
+    # Per-hop latency decomposition of a fleet-dispatched request —
+    # mirrors contracts.FLEET_HOPS (fleet/dispatch.py::FLEET_HOPS is
+    # the runtime spelling; the lint axis-drift pass cross-checks all
+    # three). Each hop names one fleet_hop_<hop>_seconds histogram
+    # family and rides ``extra["hop"]`` on fleet trace events, ordered
+    # along the request's path.
+    "hop": ("queue_wait", "rtt", "remote_queue", "remote_execute",
+            "retry"),
+    # Cost-plane overhead cause — mirrors contracts.OVERHEAD_CAUSES
+    # (perf/economics.py::OVERHEAD_CAUSES is the runtime spelling; the
+    # lint axis-drift pass cross-checks all three). Labels the
+    # economics_overhead_flops_fraction gauge and the ledger
+    # overhead-fraction keys: every non-productive flop is attributed
+    # to exactly one of these spellings.
+    "overhead_cause": ("encode", "check", "retry", "recompute",
+                       "kv_reverify"),
     # Chaos-campaign fault model (PR 19) — mirrors
     # contracts.FAULT_MODELS (chaos/models.py::FAULT_MODELS is the
     # runtime spelling; the lint axis-drift pass cross-checks all

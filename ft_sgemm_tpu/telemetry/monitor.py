@@ -446,6 +446,7 @@ class Monitor:
         self._emit_alert = emit_alert
         self._attached = False
         self._health_alerted: set = set()
+        self._economics: Optional[dict] = None
         self.started_unix = time.time()
 
     # -- collaborators (lazy, injectable) -----------------------------------
@@ -703,11 +704,24 @@ class Monitor:
                 "slo": s, "devices": rows,
                 "uptime_seconds": round(time.time() - self.started_unix, 3)}
 
+    def observe_economics(self, snapshot: dict) -> None:
+        """Latest cost-plane roll-up (a ``CostLedger.snapshot()`` dict)
+        — kept so the SLO view and the cost view travel together in
+        the artifact-embedded monitor snapshot."""
+        if isinstance(snapshot, dict):
+            self._economics = snapshot
+
     def snapshot(self) -> dict:
         """The artifact-embedded final view (``bench.py --serve`` ->
         ``context.slo`` and the RunReport SLO section)."""
         hs = self.health_status()
         scores = {d: r["score"] for d, r in hs["devices"].items()}
+        if self._economics is not None:
+            return dict(self._snapshot_base(hs, scores),
+                        economics=self._economics)
+        return self._snapshot_base(hs, scores)
+
+    def _snapshot_base(self, hs: dict, scores: dict) -> dict:
         return {
             "status": hs["status"],
             "reasons": hs["reasons"],

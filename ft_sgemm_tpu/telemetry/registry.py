@@ -286,6 +286,9 @@ _METRIC_HELP_PREFIXES = {
               "(ft_sgemm_tpu/fleet)",
     "chaos_": "Chaos campaign: per-cell fault episodes, detections, "
               "and clean-twin outcomes (ft_sgemm_tpu/chaos)",
+    "economics_": "Request cost economics: useful-vs-overhead flops "
+                  "fractions and tokens-correct throughput "
+                  "(perf/economics.py)",
     "coverage_": "Chaos coverage matrix rollups: per-model detection/"
                  "correction rates and latency facts "
                  "(ft_sgemm_tpu/chaos)",

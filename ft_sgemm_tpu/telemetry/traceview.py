@@ -69,18 +69,30 @@ def _tid_for(kind: Optional[str], name: Optional[str]) -> int:
     return _TID.get(kind or "", _TID["other"])
 
 
+def _pid_of(rec: dict) -> int:
+    """The Chrome-trace process a record belongs to: merged multi-rank
+    input carries ``_pid`` (merge_fleet stamps one per rank); single-run
+    input has none and everything lands on the classic PID."""
+    pid = rec.get("_pid")
+    return pid if isinstance(pid, int) and pid > 0 else PID
+
+
 def _pair_spans(records) -> Tuple[List[dict], List[dict]]:
-    """Pair start/end records per (kind, name) stack, keeping EVERY
+    """Pair start/end records per (pid, kind, name) stack, keeping EVERY
     field of both records (``summarize_timeline`` drops span-start extras
-    like the flush span's ``trace_ids``; the trace needs them)."""
+    like the flush span's ``trace_ids``; the trace needs them). The pid
+    in the key is the multi-rank aliasing fix: two ranks emitting
+    IDENTICAL span names (every rank runs ``program:smoke``) must never
+    close each other's spans in a merged trace."""
     open_spans: dict = {}
     spans: List[dict] = []
     for rec in records:
         kind, name, phase = rec.get("kind"), rec.get("name"), rec.get("phase")
+        key = (_pid_of(rec), kind, name)
         if phase == "start":
-            open_spans.setdefault((kind, name), []).append(rec)
+            open_spans.setdefault(key, []).append(rec)
         elif phase == "end":
-            stack = open_spans.get((kind, name))
+            stack = open_spans.get(key)
             start = stack.pop() if stack else None
             merged = dict(start or {})
             merged.update({k: v for k, v in rec.items()
@@ -121,11 +133,18 @@ def _span_args(span: dict) -> dict:
     return args
 
 
-def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
+def build_trace(records, events=None, *, run_id: Optional[str] = None,
+                process_names: Optional[dict] = None) -> dict:
     """Merge timeline records (+ optional fault events) into one
     Chrome-trace document ``{"traceEvents": [...], "displayTimeUnit":
     "ms", "otherData": {...}}``. Never raises on hostile record shapes —
-    records without a usable ``t`` are counted dropped."""
+    records without a usable ``t`` are counted dropped.
+
+    Records/events may carry ``_pid`` (merge_fleet stamps one per rank):
+    each distinct pid becomes its own Chrome-trace process with its own
+    track metadata, named from ``process_names[pid]`` when given. Flow
+    events (``s``/``t``/``f``) keep the pid of the slice they anchor to,
+    which is how one trace_id draws an arrow ACROSS process rows."""
     records = [r for r in (records or []) if isinstance(r, dict)]
     events = [e for e in (events or []) if isinstance(e, dict)]
     times = [r.get("t") for r in records] + [e.get("ts") for e in events]
@@ -140,20 +159,26 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
     out: List[dict] = []
     dropped = 0
     proc = run_id or "ft_sgemm_run"
-    out.append({"ph": "M", "pid": PID, "tid": 0, "ts": 0,
-                "name": "process_name", "args": {"name": proc}})
-    for track, tid in TRACKS:
-        out.append({"ph": "M", "pid": PID, "tid": tid, "ts": 0,
-                    "name": "thread_name", "args": {"name": track}})
+    pids = sorted({_pid_of(r) for r in records}
+                  | {_pid_of(e) for e in events} | {PID})
+    names = dict(process_names or {})
+    for pid in pids:
+        pname = names.get(pid) or (proc if pid == PID
+                                   else f"{proc}:p{pid}")
+        out.append({"ph": "M", "pid": pid, "tid": 0, "ts": 0,
+                    "name": "process_name", "args": {"name": pname}})
+        for track, tid in TRACKS:
+            out.append({"ph": "M", "pid": pid, "tid": tid, "ts": 0,
+                        "name": "thread_name", "args": {"name": track}})
 
     spans, in_flight = _pair_spans(records)
-    # trace_id -> [(ts, tid, hop_name)] — the flow hops, gathered as the
-    # slices they anchor to are emitted.
+    # trace_id -> [(ts, pid, tid, hop_name)] — the flow hops, gathered
+    # as the slices they anchor to are emitted.
     flows: dict = {}
 
-    def hop(trace_id, ts, tid, name):
+    def hop(trace_id, ts, pid, tid, name):
         if isinstance(trace_id, str) and ts is not None:
-            flows.setdefault(trace_id, []).append((ts, tid, name))
+            flows.setdefault(trace_id, []).append((ts, pid, tid, name))
 
     for span in spans:
         ts = ts_us(span.get("t_start"))
@@ -168,21 +193,22 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
         dur = (int(round(float(sec) * 1e6))
                if isinstance(sec, (int, float)) and sec > 0
                else (te - ts if te is not None and te > ts else 1))
+        pid = _pid_of(span)
         tid = _tid_for(span.get("kind"), span.get("name"))
-        out.append({"ph": "X", "pid": PID, "tid": tid, "ts": ts,
+        out.append({"ph": "X", "pid": pid, "tid": tid, "ts": ts,
                     "dur": max(1, dur), "cat": span.get("kind") or "span",
                     "name": str(span.get("name")),
                     "args": _span_args(span)})
         for trace_id in (span.get("trace_ids") or []):
             # The flush hop lands 1µs INSIDE the batch slice so the
             # flow arrow binds to it, not to a neighbour.
-            hop(trace_id, ts + 1, tid, "flush")
+            hop(trace_id, ts + 1, pid, tid, "flush")
     for span in in_flight:
         ts = ts_us(span.get("t_start"))
         if ts is None:
             dropped += 1
             continue
-        out.append({"ph": "B", "pid": PID,
+        out.append({"ph": "B", "pid": _pid_of(span),
                     "tid": _tid_for(span.get("kind"), span.get("name")),
                     "ts": ts, "cat": span.get("kind") or "span",
                     "name": str(span.get("name")),
@@ -198,26 +224,27 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
             continue
         points += 1
         kind, name = rec.get("kind"), rec.get("name")
+        pid = _pid_of(rec)
         args = {k: v for k, v in rec.items()
-                if k not in ("kind", "name", "phase", "t")}
+                if k not in ("kind", "name", "phase", "t", "_pid")}
         if kind == "kill":
-            out.append({"ph": "i", "pid": PID, "tid": _TID["other"],
+            out.append({"ph": "i", "pid": pid, "tid": _TID["other"],
                         "ts": ts, "s": "p", "cat": "kill",
                         "name": f"KILL: {name}", "args": args})
             continue
         if kind == "heartbeat":
-            out.append({"ph": "i", "pid": PID, "tid": _TID["heartbeat"],
+            out.append({"ph": "i", "pid": pid, "tid": _TID["heartbeat"],
                         "ts": ts, "s": "t", "cat": "heartbeat",
                         "name": str(name), "args": args})
             continue
         tid = _tid_for(kind, name)
         # Points become 1µs slices (not bare instants) so flow arrows
         # have a slice to bind to in Perfetto's legacy importer.
-        out.append({"ph": "X", "pid": PID, "tid": tid, "ts": ts,
+        out.append({"ph": "X", "pid": pid, "tid": tid, "ts": ts,
                     "dur": 1, "cat": str(kind), "name": str(name),
                     "args": args})
         if args.get("trace_id"):
-            hop(args["trace_id"], ts, tid, str(name))
+            hop(args["trace_id"], ts, pid, tid, str(name))
 
     fault_count = 0
     for ev in events:
@@ -226,30 +253,34 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
             dropped += 1
             continue
         fault_count += 1
+        pid = _pid_of(ev)
         args = {k: ev[k] for k in ("outcome", "op", "strategy", "layer",
                                    "tiles", "residual", "threshold",
                                    "detected", "corrected",
                                    "uncorrectable", "device", "extra")
                 if ev.get(k) is not None}
         name = f"{ev.get('op') or 'event'}:{ev.get('outcome')}"
-        out.append({"ph": "X", "pid": PID, "tid": _TID["faults"],
+        out.append({"ph": "X", "pid": pid, "tid": _TID["faults"],
                     "ts": ts, "dur": 1, "cat": "fault", "name": name,
                     "args": args})
         trace_id = (ev.get("extra") or {}).get("trace_id") \
             if isinstance(ev.get("extra"), dict) else None
-        hop(trace_id, ts, _TID["faults"],
+        hop(trace_id, ts, pid, _TID["faults"],
             "detect" if ev.get("op") in ("serve_gemm", "serve_block")
             else f"kv_{ev.get('outcome')}" if ev.get("op") == "kv_page"
             else str(ev.get("outcome")))
 
     flow_events = 0
+    cross_process_flows = 0
     for trace_id, hops in sorted(flows.items()):
         if len(hops) < 2:
             continue  # a flow needs two ends to draw an arrow
         hops.sort()
-        for i, (ts, tid, name) in enumerate(hops):
+        if len({pid for _, pid, _, _ in hops}) > 1:
+            cross_process_flows += 1
+        for i, (ts, pid, tid, name) in enumerate(hops):
             ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
-            ev = {"ph": ph, "pid": PID, "tid": tid, "ts": ts,
+            ev = {"ph": ph, "pid": pid, "tid": tid, "ts": ts,
                   "cat": "serve.flow", "name": "serve_request",
                   "id": trace_id, "args": {"hop": name}}
             if ph == "f":
@@ -268,7 +299,10 @@ def build_trace(records, events=None, *, run_id: Optional[str] = None) -> dict:
             "spans": len(spans), "in_flight": len(in_flight),
             "points": points, "fault_events": fault_count,
             "flows": sum(1 for h in flows.values() if len(h) >= 2),
-            "flow_events": flow_events, "dropped": dropped,
+            "flow_events": flow_events,
+            "processes": len(pids),
+            "cross_process_flows": cross_process_flows,
+            "dropped": dropped,
         },
     }
 
@@ -330,5 +364,117 @@ def export_trace(timeline_path: str,
     return trace, path
 
 
+def _read_fleet_skew(workdir: str) -> dict:
+    """Per-rank clock-skew estimates (rank -> seconds, remote minus
+    coordinator) from the coordinator's result artifact — the last
+    handshake value the dispatcher recorded per host. Missing/hostile
+    shapes degrade to {} (no correction), never an error."""
+    path = os.path.join(workdir, "rank0", "result.json")
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            res = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(res, dict):
+        return {}
+    serve = res.get("serve")
+    disp = serve.get("dispatcher") if isinstance(serve, dict) else None
+    per = disp.get("per_host") if isinstance(disp, dict) else None
+    out: dict = {}
+    for host, row in (per or {}).items():
+        if not isinstance(row, dict):
+            continue
+        skew = row.get("clock_skew_seconds")
+        if isinstance(skew, (int, float)):
+            try:
+                out[int(host)] = float(skew)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def merge_fleet(workdir: str, out_path: Optional[str] = None,
+                run_id: Optional[str] = None) -> Tuple[dict, str]:
+    """Stitch a fleet run's per-rank timelines (+ fault-event shards)
+    and the supervisor's own timeline into ONE Perfetto trace.
+
+    - the supervisor (``fleet.timeline.jsonl``) keeps the classic PID;
+      rank ``r`` becomes Chrome-trace process ``2 + r``, every record
+      namespaced ``rank{r}:`` so merged traces never alias (and
+      ``_pair_spans`` keys on pid besides — identical span names across
+      ranks stay separate spans);
+    - remote-rank wall clocks are SKEW-CORRECTED before merging: each
+      rank's timestamps shift by minus the dispatcher's last
+      NTP-midpoint estimate for that host (``_read_fleet_skew``; rank 0
+      is the reference clock and shifts by zero), so one trace_id's
+      hops order correctly across the wire;
+    - flows then join coordinator submit -> remote execute -> remote
+      retry across process rows (``otherData.cross_process_flows``
+      counts them).
+
+    Returns ``(trace, out_path)`` like :func:`export_trace`; the
+    default output is ``<workdir>/fleet.trace.json``.
+    """
+    skew = _read_fleet_skew(workdir)
+    records: List[dict] = []
+    events: List[dict] = []
+    names = {PID: "fleet-supervisor"}
+    sup = os.path.join(workdir, "fleet.timeline.jsonl")
+    if os.path.exists(sup):
+        for rec in _read_timeline(sup):
+            rec["_pid"] = PID
+            records.append(rec)
+    ranks = []
+    try:
+        entries = sorted(os.listdir(workdir))
+    except OSError:
+        entries = []
+    for entry in entries:
+        if entry.startswith("rank") and entry[4:].isdigit():
+            ranks.append(int(entry[4:]))
+    for r in sorted(ranks):
+        rankdir = os.path.join(workdir, f"rank{r}")
+        pid = PID + 1 + r
+        names[pid] = f"rank{r}"
+        offset = skew.get(r, 0.0) if r != 0 else 0.0
+        prefix = f"rank{r}:"
+        tl_path = os.path.join(rankdir, "timeline.jsonl")
+        if os.path.exists(tl_path):
+            for rec in _read_timeline(tl_path):
+                rec["_pid"] = pid
+                if isinstance(rec.get("t"), (int, float)):
+                    rec["t"] = rec["t"] - offset
+                nm = rec.get("name")
+                if isinstance(nm, str) and not nm.startswith(prefix):
+                    rec["name"] = prefix + nm
+                records.append(rec)
+        for entry in sorted(os.listdir(rankdir)
+                            if os.path.isdir(rankdir) else []):
+            if not (entry.startswith("events") and
+                    entry.endswith(".jsonl")):
+                continue
+            try:
+                shard = _read_fault_events(os.path.join(rankdir, entry))
+            except OSError:
+                continue
+            for ev in shard:
+                ev["_pid"] = pid
+                if isinstance(ev.get("ts"), (int, float)):
+                    ev["ts"] = ev["ts"] - offset
+                events.append(ev)
+    trace = build_trace(records, events,
+                        run_id=run_id or "fleet",
+                        process_names=names)
+    trace["otherData"]["ranks"] = sorted(ranks)
+    trace["otherData"]["clock_skew_seconds"] = {
+        str(h): s for h, s in sorted(skew.items())}
+    path = out_path or os.path.join(workdir, "fleet.trace.json")
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    return trace, path
+
+
 __all__ = ["PID", "TRACKS", "build_trace", "default_out_path",
-           "export_trace"]
+           "export_trace", "merge_fleet"]

@@ -218,6 +218,52 @@ def summarize_artifact(path, obj, ledger_entries=None):
                   f"  mttr {_r(roll.get('mttr_seconds'), '{:.3f}')}s"
                   f"  fp {_r(roll.get('false_positive_rate'))}"
                   f"  policy {verdict}")
+    econ = ctx.get("economics")
+    if not isinstance(econ, dict) and isinstance(ctx.get("fleet"), dict):
+        econ = ctx["fleet"].get("economics")
+    if isinstance(econ, dict):
+        # Request cost economics (perf/economics.py): the useful-vs-
+        # overhead flops split and the correct-token throughput.
+        def _e(v, pat="{:.4f}"):
+            return pat.format(v) if isinstance(v, (int, float)) else "-"
+
+        print(f"   {'economics useful flops':34s} "
+              f"{_e(econ.get('useful_flops_fraction'))}"
+              f"  of {_e(econ.get('flops_total'), '{:.4g}')} total"
+              f"  ({econ.get('requests', '?')} requests)")
+        fracs = econ.get("overhead_fractions")
+        if isinstance(fracs, dict):
+            bits = "  ".join(
+                f"{c}={_e(v)}" for c, v in sorted(fracs.items())
+                if isinstance(v, (int, float)) and v)
+            if bits:
+                print(f"   {'economics overhead':34s} {bits}")
+        tcs = econ.get("tokens_correct_per_second_per_device")
+        if tcs is not None:
+            print(f"   {'tokens-correct/s/device':34s} {_e(tcs, '{:.3f}')}"
+                  f"  ({econ.get('tokens_correct', '?')} correct of "
+                  f"{econ.get('tokens', '?')})")
+    disp = (ctx.get("fleet") or {}).get("dispatcher") \
+        if isinstance(ctx.get("fleet"), dict) else None
+    if isinstance(disp, dict) and isinstance(disp.get("per_host"), dict):
+        # Fleet hop decomposition + measured clock skew per host
+        # (fleet/dispatch.py stats()).
+        for h, row in sorted(disp["per_host"].items(),
+                             key=lambda kv: str(kv[0])):
+            if not isinstance(row, dict):
+                continue
+            skew = row.get("clock_skew_seconds")
+            pcts = row.get("hop_percentiles") or {}
+            bits = "  ".join(
+                f"{name}[p95]={p.get('p95'):.4g}s"
+                for name, p in sorted(pcts.items())
+                if isinstance(p, dict)
+                and isinstance(p.get("p95"), (int, float)))
+            print(f"   {'fleet host ' + str(h):34s} "
+                  f"reqs {row.get('requests', '?')}"
+                  + (f"  skew {skew:+.4f}s"
+                     if isinstance(skew, (int, float)) else "")
+                  + (f"  {bits}" if bits else ""))
     for name, e in (ctx.get("errors") or {}).items():
         first = str(e).splitlines()[0] if e else ""
         print(f"   {name:34s} ERROR: {first[:90]}")

@@ -1,0 +1,385 @@
+"""Request cost economics tests (ISSUE 20): the flops-accounted
+useful-vs-overhead ledger. Pins the component pricing against the
+repo's own cost models (``gemm_cost_breakdown``, ``recover_local``'s
+recomputed_flops), the sums-to-one-by-construction snapshot invariant,
+useful-fraction degradation on a REAL BlockEngine under injected
+faults, the wire-shape tolerance of ``merge_reply``, the live gauge
+publish, and the ledger ingest + trend-gate ride of ``economics.*``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ft_sgemm_tpu.cli import main as cli_main
+from ft_sgemm_tpu.ops.common import gemm_cost_breakdown
+from ft_sgemm_tpu.perf import ledger
+from ft_sgemm_tpu.perf.economics import (
+    OVERHEAD_CAUSES,
+    CostLedger,
+    CostRecord,
+    attention_cost,
+    gemm_request_cost,
+    kv_reverify_flops,
+    recovery_overhead,
+)
+from ft_sgemm_tpu.resilience.recompute import recover_local
+from ft_sgemm_tpu.telemetry import MetricsRegistry
+from ft_sgemm_tpu.telemetry.registry import to_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Component pricing: one cost model, no second opinion
+# ---------------------------------------------------------------------------
+
+
+def test_gemm_request_cost_matches_cost_breakdown_exactly():
+    """The request price IS the roofline's component decomposition:
+    base is productive, encode+check are the premium, each retry
+    re-executes the whole pass."""
+    parts = gemm_cost_breakdown(512, 512, 512, 4,
+                                block=(128, 128, 128),
+                                strategy="rowcol")
+    productive, overhead = gemm_request_cost(parts, retries=2,
+                                             recompute_flops=123.0)
+    assert productive == parts["flops_base"]
+    assert overhead["encode"] == parts["flops_encode"]
+    assert overhead["check"] == parts["flops_check"]
+    assert overhead["retry"] == 2 * (parts["flops_base"]
+                                     + parts["flops_encode"]
+                                     + parts["flops_check"])
+    assert overhead["recompute"] == 123.0
+    # Clean request: no retry/recompute keys at all.
+    _, clean = gemm_request_cost(parts)
+    assert set(clean) == {"encode", "check"}
+
+
+def test_attention_cost_formula_pinned():
+    lq, lk, d, dv = 128, 256, 16, 16
+    parts = attention_cost(lq, lk, d, dv)
+    assert parts["flops_base"] == 2 * lq * lk * (d + dv)
+    assert parts["flops_encode"] == 2 * (lk * (d + dv) + lq * d)
+    assert parts["flops_check"] == 2 * lq * (lk + dv)
+
+
+def test_kv_reverify_flops_pinned():
+    got = kv_reverify_flops(restores=2, reread_rows=40, page_size=8,
+                            d=16, dv=16)
+    assert got == 2 * 2 * 8 * 32 + 2 * 40 * 32
+
+
+def test_recovery_overhead_is_recover_local_accounting(rng):
+    """The ladder's own flops accounting is the recompute price —
+    economics never reprices a recovery."""
+    m, n, k = 64, 256, 64
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    bad = (a @ b.T)
+    bad[3, 7] += 1000.0
+    bad[9, 9] -= 750.0  # multi-element, one panel -> panel_recompute
+    _, outcome = recover_local(a, b, bad, num_panels=8)
+    assert outcome.rung == "panel_recompute"
+    assert recovery_overhead(outcome) == outcome.recomputed_flops
+    assert recovery_overhead(outcome) > 0
+    # Dict shape (the wire form) prices identically.
+    assert recovery_overhead(
+        {"recomputed_flops": outcome.recomputed_flops}) \
+        == outcome.recomputed_flops
+
+
+def test_cost_record_rejects_unknown_cause():
+    with pytest.raises(ValueError, match="unknown overhead cause"):
+        CostRecord(flops_productive=1.0, overhead={"cosmic_rays": 1.0})
+    assert "cosmic_rays" not in OVERHEAD_CAUSES
+
+
+# ---------------------------------------------------------------------------
+# Snapshot invariants: fractions sum to 1 by construction
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_fractions_sum_to_one_exactly():
+    led = CostLedger()
+    led.add(flops_productive=700.0,
+            overhead={"encode": 100.0, "check": 50.0, "retry": 150.0},
+            tokens=128, tokens_correct=128, device="cpu:0", bucket="b0")
+    led.add(flops_productive=300.0, overhead={"kv_reverify": 200.0},
+            tokens=64, tokens_correct=32, device="cpu:1", bucket="b0",
+            host=1, ok=False)
+    snap = led.snapshot(wall_seconds=2.0)
+    total = 700 + 100 + 50 + 150 + 300 + 200
+    assert snap["flops_total"] == total
+    assert snap["useful_flops_fraction"] == round(1000 / total, 6)
+    fracs = snap["overhead_fractions"]
+    assert set(fracs) == set(OVERHEAD_CAUSES)
+    # The construction pin: useful + every overhead share == 1 exactly
+    # (same denominator everywhere), so the breakdown can't sum past 1.
+    assert snap["useful_flops_fraction"] + sum(fracs.values()) \
+        == pytest.approx(1.0, abs=1e-9)
+    assert snap["overhead_flops_fraction"] \
+        == pytest.approx(1.0 - snap["useful_flops_fraction"], abs=1e-5)
+    assert snap["requests"] == 2 and snap["requests_ok"] == 1
+    assert snap["tokens_correct"] == 160
+    # 160 correct tokens / 2 s wall / 2 distinct devices.
+    assert snap["devices"] == 2
+    assert snap["tokens_correct_per_second_per_device"] \
+        == pytest.approx(40.0)
+    assert snap["per_device"]["cpu:0"]["requests"] == 1
+    assert snap["per_bucket"]["b0"]["requests"] == 2
+    assert snap["per_host"][1]["tokens_correct"] == 32
+
+
+def test_empty_ledger_snapshot_is_none_not_garbage():
+    snap = CostLedger().snapshot()
+    assert snap["useful_flops_fraction"] is None
+    assert snap["tokens_correct_per_second_per_device"] is None
+    assert snap["flops_total"] == 0
+
+
+def test_merge_reply_tolerates_hostile_shapes():
+    led = CostLedger()
+    assert led.merge_reply(None) is None
+    assert led.merge_reply("nope") is None
+    assert led.merge_reply({"overhead": "broken",
+                            "flops_productive": "x"}) is not None
+    rec = led.merge_reply({"flops_productive": 10.0,
+                           "overhead": {"retry": 5.0, "bogus": 99.0},
+                           "tokens": 4, "tokens_correct": 4,
+                           "seconds": 0.1}, host=1)
+    assert rec is not None
+    assert rec.overhead == {"retry": 5.0}  # unknown causes dropped
+    snap = led.snapshot()
+    assert snap["flops_productive"] == 10.0
+    assert snap["flops_overhead"]["retry"] == 5.0
+
+
+def test_publish_sets_live_gauges():
+    led = CostLedger()
+    led.add(flops_productive=900.0, overhead={"retry": 100.0},
+            tokens=10, tokens_correct=10, device="cpu:0")
+    reg = MetricsRegistry()
+    snap = led.publish(reg, wall_seconds=1.0, devices=2)
+    text = to_prometheus(reg.collect())
+    assert "economics_useful_flops_fraction 0.9" in text
+    assert 'economics_overhead_flops_fraction{overhead_cause="retry"}' \
+        in text
+    assert "economics_tokens_correct_per_second_per_device 5" in text
+    assert snap["useful_flops_fraction"] == 0.9
+
+
+# ---------------------------------------------------------------------------
+# Real engine: faults make the useful fraction fall
+# ---------------------------------------------------------------------------
+
+
+def test_useful_fraction_falls_under_faults_on_real_engine(rng):
+    """End-to-end accounting on a REAL BlockEngine: a clean prefill
+    prices only the always-on premium; adversarial in-flight faults add
+    retry flops and stored-KV corruption adds kv_reverify flops — the
+    useful-flops fraction strictly falls and the causes are named."""
+    from ft_sgemm_tpu.serve import (BlockEngine, BlockRequest,
+                                    default_block_bucket_set)
+    d = 16
+    eng = BlockEngine(default_block_bucket_set((128, 256), d=d),
+                      max_batch=2, max_wait=0.02, retry_backoff=0.001,
+                      kv_page_size=16)
+    eng.start()
+    try:
+        def qkv(n):
+            return (rng.standard_normal((n, d)).astype(np.float32),
+                    rng.standard_normal((n, d)).astype(np.float32),
+                    rng.standard_normal((n, d)).astype(np.float32))
+
+        q, k, v = qkv(40)
+        pre = BlockRequest("prefill", q, k, v)
+        assert eng.submit(pre).result(timeout=300).ok
+        clean = eng.economics.snapshot()
+        assert clean["requests"] == 1
+        assert 0 < clean["useful_flops_fraction"] < 1
+        assert clean["overhead_fractions"]["retry"] == 0
+        # Adversarial inject: uncorrectable in flight -> bounded retry.
+        q2, k2, v2 = qkv(200)
+        res = eng.submit(BlockRequest("prefill", q2, k2, v2,
+                                      variant="adversarial")).result(300)
+        assert res.ok and res.retries >= 1
+        # Stored-state fault: multi-element page corruption -> restore.
+        eng.corrupt_kv(pre.seq_id, page=0, row=2, cols=(1, 5, 9),
+                       magnitude=400.0)
+        q1, k1, v1 = qkv(1)
+        res = eng.submit(BlockRequest("decode", q1, k1, v1,
+                                      seq_id=pre.seq_id)).result(300)
+        assert res.ok and res.kv_restores >= 1
+        snap = eng.economics.snapshot()
+        assert snap["requests"] == 3
+        assert snap["useful_flops_fraction"] \
+            < clean["useful_flops_fraction"]
+        assert snap["flops_overhead"]["retry"] > 0
+        assert snap["flops_overhead"]["kv_reverify"] > 0
+        assert snap["useful_flops_fraction"] \
+            + sum(snap["overhead_fractions"].values()) \
+            == pytest.approx(1.0, abs=1e-4)
+        # The engine's stats() carries the same view for bench context.
+        st = eng.stats()
+        assert st["economics"]["requests"] == 3
+        # And the live gauges made it onto the engine registry.
+        text = to_prometheus(eng.registry.collect())
+        assert "economics_useful_flops_fraction" in text
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Ledger ride: economics.* measurements + trend gate
+# ---------------------------------------------------------------------------
+
+
+def _econ_artifact(useful, tcpspd=50.0):
+    return {"metric": "fleet_smoke", "value": 1.0, "unit": "ok",
+            "context": {"platform_used": "cpu", "device_kind": "cpu",
+                        "economics": {
+                            "useful_flops_fraction": useful,
+                            "overhead_flops_fraction":
+                                round(1.0 - useful, 6),
+                            "tokens_correct_per_second_per_device":
+                                tcpspd,
+                            "requests": 8, "requests_ok": 8,
+                            "flops_total": 1e9,
+                            "overhead_fractions": {"retry": 0.1},
+                            "tokens_correct": 1024}}}
+
+
+def test_ledger_ingests_economics_measurements():
+    entry = ledger.ingest(_econ_artifact(0.85), run_id="r0")
+    m = entry["measurements"]
+    assert m["economics.useful_flops_fraction"]["value"] == 0.85
+    assert m["economics.useful_flops_fraction"]["higher_is_better"]
+    assert m["economics.overhead_flops_fraction"]["value"] == 0.15
+    assert not m["economics.overhead_flops_fraction"]["higher_is_better"]
+    assert m["economics.tokens_correct_per_second_per_device"][
+        "value"] == 50.0
+    assert entry["economics"]["overhead_fractions"] == {"retry": 0.1}
+    # The fleet-nested spelling ingests identically.
+    econ = _econ_artifact(0.85)["context"]["economics"]
+    art = {"metric": "fleet_smoke", "value": 1.0, "unit": "ok",
+           "context": {"platform_used": "cpu", "device_kind": "cpu",
+                       "fleet": {"economics": econ}}}
+    nested = ledger.ingest(art, run_id="r1")
+    assert nested["measurements"]["economics.useful_flops_fraction"][
+        "value"] == 0.85
+
+
+def test_trend_gate_fails_on_useful_fraction_regression(tmp_path,
+                                                        capsys):
+    """ISSUE 20 acceptance: a seeded useful-flops-fraction collapse
+    trips `cli trend --gate` exit 1 on the economics series."""
+    path = str(tmp_path / "led.jsonl")
+    for i in range(4):
+        ledger.append(path, ledger.ingest(_econ_artifact(0.9),
+                                          run_id=f"r{i}"))
+    ledger.append(path, ledger.ingest(_econ_artifact(0.45),
+                                      run_id="regressed"))
+    rc = cli_main(["cli", "trend", path, "--gate"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "economics.useful_flops_fraction" in out
+    assert "regression" in out
+
+
+def test_trend_gate_passes_on_stable_economics(tmp_path, capsys):
+    path = str(tmp_path / "led.jsonl")
+    for i in range(5):
+        ledger.append(path, ledger.ingest(_econ_artifact(0.9),
+                                          run_id=f"r{i}"))
+    assert cli_main(["cli", "trend", path, "--gate"]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# CLI report + stdlib discipline
+# ---------------------------------------------------------------------------
+
+
+def test_cli_economics_report(tmp_path, capsys):
+    art = tmp_path / "artifact.json"
+    art.write_text(json.dumps(_econ_artifact(0.85)), encoding="utf-8")
+    assert cli_main(["cli", "economics", str(art)]) == 0
+    out = capsys.readouterr().out
+    assert "useful flops" in out and "85" in out
+    assert "retry" in out
+    # JSON mode round-trips the block.
+    assert cli_main(["cli", "economics", str(art),
+                     "--format=json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["useful_flops_fraction"] == 0.85
+    # Missing block -> rc 1; unreadable -> rc 2.
+    bare = tmp_path / "bare.json"
+    bare.write_text("{}", encoding="utf-8")
+    assert cli_main(["cli", "economics", str(bare)]) == 1
+    capsys.readouterr()
+    assert cli_main(["cli", "economics",
+                     str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+def test_economics_module_is_loadable_without_the_package(tmp_path):
+    """timeline.py discipline: the jax-free supervisor loads the cost
+    plane directly from its file path — no package import, no jax."""
+    script = tmp_path / "load_economics.py"
+    script.write_text(
+        "import importlib.util, os, sys\n"
+        f"path = os.path.join({REPO!r}, 'ft_sgemm_tpu', 'perf',"
+        " 'economics.py')\n"
+        "for mod in list(sys.modules):\n"
+        "    assert not mod.startswith('ft_sgemm_tpu'), mod\n"
+        "spec = importlib.util.spec_from_file_location('_econ', path)\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "sys.modules['_econ'] = m\n"
+        "spec.loader.exec_module(m)\n"
+        "led = m.CostLedger()\n"
+        "led.add(flops_productive=9.0, overhead={'retry': 1.0})\n"
+        "snap = led.snapshot(wall_seconds=1.0)\n"
+        "assert snap['useful_flops_fraction'] == 0.9, snap\n"
+        "assert 'jax' not in sys.modules\n"
+        "assert 'numpy' not in sys.modules\n"
+        "print('OK')\n", encoding="utf-8")
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+def test_cli_top_renders_economics_and_fleet_rows():
+    """`cli top` surfaces the live cost plane (economics_* gauges) and
+    the fleet rows (per-host clock skew, merged hop percentiles) from a
+    real /metrics scrape."""
+    import io
+
+    from ft_sgemm_tpu.cli import run_top
+    from ft_sgemm_tpu.telemetry.monitor import start_monitor
+    from ft_sgemm_tpu.telemetry.registry import LATENCY_BUCKETS
+
+    reg = MetricsRegistry()
+    led = CostLedger()
+    led.add(flops_productive=900.0, overhead={"retry": 100.0},
+            tokens=64, tokens_correct=64, device="cpu:0")
+    led.publish(reg, wall_seconds=1.0)
+    reg.gauge("fleet_clock_skew_seconds", host="1").set(0.012)
+    reg.histogram("fleet_hop_rtt_seconds", buckets=LATENCY_BUCKETS,
+                  host="1", host_tier="dcn").observe(0.004)
+    mon, server = start_monitor(0, registry=reg, attach=False)
+    try:
+        buf = io.StringIO()
+        assert run_top(server.url, out=buf, interval=0.01,
+                       iterations=1) == 0
+        txt = buf.getvalue()
+        assert "economics: useful flops 0.9" in txt
+        assert "overhead:" in txt and "retry=0.1" in txt
+        assert "fleet: clock skew host1=+0.0120s" in txt
+        assert "hop rtt" in txt and "n 1" in txt
+    finally:
+        server.close()

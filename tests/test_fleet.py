@@ -183,6 +183,69 @@ def test_fleet_counters_two_real_processes(tmp_path):
     assert any(lbl.startswith("host1:") for lbl in facts["health_labels"])
 
 
+def test_fleet_trace_join_two_real_processes(tmp_path):
+    """ISSUE 20 acceptance, tier-1 shape: a REAL 2-proc launch of the
+    ``trace`` program must show one trace_id on BOTH sides of the wire
+    and a merged Perfetto trace whose flows cross process rows with
+    skew-corrected monotone hops."""
+    from ft_sgemm_tpu.telemetry import traceview
+
+    workdir = tmp_path / "t"
+    report = launch_fleet(FleetSpec(
+        procs=2, vdevs=2, program="trace", workdir=str(workdir),
+        deadline_seconds=420.0, wedge_after=180.0))
+    assert report["ok"], report["ranks"]
+    serve = report["result"]["serve"]
+    tids = serve["trace"]["retried_trace_ids"]
+    assert tids, serve["trace"]
+    # The coordinator kept the retried ids; the remote rank's own
+    # timeline carries the SAME ids on its execute and retry points —
+    # the trace context really crossed the TCP hop.
+    recs = [json.loads(line) for line in
+            (workdir / "rank1" / "timeline.jsonl").read_text(
+                encoding="utf-8").splitlines() if line.strip()]
+    remote_ids = {r.get("trace_id") for r in recs if r.get("trace_id")}
+    joined = set(tids) & remote_ids
+    assert joined, (tids, sorted(remote_ids)[:5])
+    assert any(r.get("trace_id") in joined
+               and str(r.get("name", "")).endswith(":retry")
+               for r in recs), "remote retry point must carry the id"
+    # The dispatcher measured the remote host's clock skew over the
+    # SAME connection the requests rode.
+    skew = report["result"]["fleet"]["clock_skew_seconds"]
+    assert "1" in skew and isinstance(skew["1"], float), skew
+    # The run's economics accounted the forced retries: overhead
+    # breakdown shares one denominator with the useful fraction.
+    econ = report["result"]["fleet"]["economics"]
+    assert econ["useful_flops_fraction"] is not None
+    assert econ["overhead_fractions"]["retry"] > 0
+    total = econ["useful_flops_fraction"] + sum(
+        v for v in econ["overhead_fractions"].values() if v)
+    assert abs(total - 1.0) < 1e-4, econ
+
+    # ONE merged Perfetto document: supervisor + both ranks as separate
+    # trace processes, flows joining hops across them.
+    trace, path = traceview.merge_fleet(str(workdir))
+    assert path == str(workdir / "fleet.trace.json")
+    meta = trace["otherData"]
+    assert meta["ranks"] == [0, 1]
+    assert meta["processes"] >= 3, meta  # supervisor + 2 ranks
+    assert meta["cross_process_flows"] >= 1, meta
+    ev = trace["traceEvents"]
+    ts_all = [e["ts"] for e in ev if e.get("ph") != "M"]
+    assert ts_all == sorted(ts_all) and all(t >= 0 for t in ts_all)
+    rank0_pid = traceview.PID + 1
+    for tid in joined:
+        hops = [e for e in ev
+                if e.get("ph") in ("s", "t", "f") and e.get("id") == tid]
+        assert len(hops) >= 2, tid
+        assert len({h["pid"] for h in hops}) >= 2, hops
+        # Skew-corrected order: the coordinator's submit is the flow
+        # SOURCE; the remote hops follow it in corrected time.
+        assert hops[0]["pid"] == rank0_pid, hops
+        assert "submit" in hops[0]["args"]["hop"], hops[0]
+
+
 # ---------------------------------------------------------------------------
 # Dispatcher: placement, blame, migrate-on-evict (in-process)
 # ---------------------------------------------------------------------------
@@ -241,6 +304,52 @@ def test_dispatcher_evict_host_migrates_queued_requests():
             d.submit({"i": 100})
     finally:
         release.set()
+        d.stop()
+
+
+def test_dispatcher_stats_requests_hops_and_skew():
+    """ISSUE 20 satellite: stats() reports per-slot request counts,
+    hop-latency percentile estimates from the single registry stats
+    path, and the last measured clock skew per remote host."""
+    from ft_sgemm_tpu.telemetry import MetricsRegistry
+
+    def local(spec):
+        return {"ok": True, "host": 0, "seconds": 0.001}
+
+    def remote(spec):
+        return {"ok": True, "host": 1, "seconds": 0.004,
+                "retry_seconds": 0.002,
+                "wire": {"rtt_seconds": 0.003,
+                         "remote_queue_seconds": 0.0005,
+                         "skew_seconds": -0.25}}
+
+    reg = MetricsRegistry()
+    d = FleetDispatcher(
+        [_slot(0, local, host_tier="local", dcn_distance=0.0),
+         _slot(1, remote, host_tier="dcn", dcn_distance=1.0)],
+        placement="round_robin", registry=reg)
+    try:
+        futs = [d.submit({"i": i}) for i in range(6)]
+        assert all(f.result(timeout=30.0)["ok"] for f in futs)
+        st = d.stats()
+        assert st["per_host"][0]["requests"] == 3
+        assert st["per_host"][1]["requests"] == 3
+        assert st["per_host"][1]["clock_skew_seconds"] == -0.25
+        # Local slot: no wire handshake, skew pinned at zero.
+        assert st["per_host"][0]["clock_skew_seconds"] == 0.0
+        hops = st["per_host"][1]["hop_percentiles"]
+        # Every taxonomy hop the reply carried has a percentile row...
+        for name in ("queue_wait", "rtt", "remote_queue",
+                     "remote_execute", "retry"):
+            assert hops[name]["p95"] >= 0, name
+        # ...estimated from the SAME histogram buckets /metrics exports.
+        from ft_sgemm_tpu.telemetry.registry import to_prometheus
+        text = to_prometheus(reg.collect())
+        assert "fleet_hop_rtt_seconds_bucket" in text
+        assert 'fleet_clock_skew_seconds{host="1"} -0.25' in text
+        # The local slot never fabricates wire hops.
+        assert "rtt" not in st["per_host"][0].get("hop_percentiles", {})
+    finally:
         d.stop()
 
 

@@ -104,3 +104,62 @@ def test_summarizer_surfaces_slo_section(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0
     assert "slo status" not in out.stdout
+
+
+def test_summarizer_surfaces_economics_and_fleet_hops(tmp_path):
+    """ISSUE 20 satellite: the summarizer renders the cost-economics
+    rows (useful-flops fraction, overhead causes, correct-token
+    throughput) and the per-host fleet rows (request counts, measured
+    clock skew, hop p95s) — tolerantly, so a hostile/partial dispatcher
+    block renders what it can instead of crashing."""
+    p = tmp_path / "fleet_artifact.json"
+    p.write_text(json.dumps({
+        "metric": "fleet_smoke", "value": 1.0, "unit": "ok",
+        "vs_baseline": None,
+        "context": {
+            "economics": {
+                "useful_flops_fraction": 0.8542,
+                "flops_total": 2.5e9, "requests": 16,
+                "overhead_fractions": {"encode": 0.06, "check": 0.02,
+                                       "retry": 0.0658, "recompute": 0,
+                                       "kv_reverify": 0},
+                "tokens_correct_per_second_per_device": 41.5,
+                "tokens_correct": 2048, "tokens": 2048},
+            "fleet": {"dispatcher": {"per_host": {
+                "0": {"requests": 9},
+                "1": {"requests": 7,
+                      "clock_skew_seconds": 0.0123,
+                      "hop_percentiles": {
+                          "rtt": {"p50": 0.001, "p95": 0.0042},
+                          "remote_execute": {"p95": "broken"}}},
+                "2": "hostile-not-a-dict"}}}},
+    }))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(p)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "economics useful flops" in out.stdout
+    assert "0.8542" in out.stdout and "16 requests" in out.stdout
+    assert "retry=0.0658" in out.stdout
+    # Zero-valued causes are noise, not rows.
+    assert "recompute" not in out.stdout
+    assert "tokens-correct/s/device" in out.stdout
+    assert "41.5" in out.stdout and "2048 correct" in out.stdout
+    assert "fleet host 0" in out.stdout and "reqs 9" in out.stdout
+    assert "fleet host 1" in out.stdout
+    assert "skew +0.0123s" in out.stdout
+    assert "rtt[p95]=0.0042s" in out.stdout
+    # The broken percentile dict and hostile host row render nothing —
+    # and crash nothing.
+    assert "remote_execute" not in out.stdout
+    # Artifacts without the blocks render none of the rows.
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({
+        "metric": "fleet_smoke", "value": 1.0, "unit": "ok",
+        "vs_baseline": None, "context": {}}))
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_bench.py", str(bare)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "economics" not in out.stdout
+    assert "fleet host" not in out.stdout

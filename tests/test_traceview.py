@@ -134,6 +134,105 @@ def test_hostile_records_never_raise():
     assert trace["otherData"]["dropped"] >= 2
 
 
+def test_merged_multi_rank_spans_never_alias():
+    """Satellite bugfix pin (ISSUE 20): two ranks emitting IDENTICAL
+    span names must pair within their own process — rank 1's end must
+    never close rank 0's still-open span in a merged trace."""
+    r2s = {"kind": "stage", "name": "program:smoke", "phase": "start",
+           "t": 100.0, "_pid": 2}
+    r2e = {"kind": "stage", "name": "program:smoke", "phase": "end",
+           "t": 103.0, "_pid": 2}
+    r3s = {"kind": "stage", "name": "program:smoke", "phase": "start",
+           "t": 100.5, "_pid": 3}
+    r3e = {"kind": "stage", "name": "program:smoke", "phase": "end",
+           "t": 101.0, "_pid": 3}
+    # Interleaved in the aliasing order: start0, start1, end1, end0.
+    trace = traceview.build_trace([r2s, r3s, r3e, r2e])
+    meta = trace["otherData"]
+    assert meta["spans"] == 2 and meta["in_flight"] == 0
+    assert meta["processes"] == 3  # implicit PID + the two ranks
+    durs = {e["pid"]: e["dur"] for e in trace["traceEvents"]
+            if e["ph"] == "X" and e["name"] == "program:smoke"}
+    # Each rank's span keeps ITS OWN duration, not its neighbour's.
+    assert durs[3] == 500000 and durs[2] == 3000000, durs
+
+
+def test_killed_rank_in_flight_span_stays_its_own():
+    trace = traceview.build_trace([
+        {"kind": "stage", "name": "program:smoke", "phase": "start",
+         "t": 10.0, "_pid": 2},
+        {"kind": "stage", "name": "program:smoke", "phase": "start",
+         "t": 10.1, "_pid": 3},
+        # pid 2 completes; pid 3 was killed mid-span — its bar must
+        # stay on ITS process row, not swallow the completed one's end.
+        {"kind": "stage", "name": "program:smoke", "phase": "end",
+         "t": 12.0, "_pid": 2},
+    ])
+    assert trace["otherData"]["spans"] == 1
+    assert trace["otherData"]["in_flight"] == 1
+    b = [e for e in trace["traceEvents"] if e["ph"] == "B"]
+    assert b and b[0]["pid"] == 3
+
+
+def test_merge_fleet_namespaces_skew_corrects_and_flows(tmp_path):
+    """merge_fleet contract on a synthetic workdir: rank-namespaced
+    names (never doubled), timestamps shifted by minus the dispatcher's
+    measured skew, one trace_id flowing across process rows in the
+    corrected order."""
+    wd = tmp_path / "fleet"
+    (wd / "rank0").mkdir(parents=True)
+    (wd / "rank1").mkdir()
+    base, skew = 1000.0, 5.0
+
+    def w(path, rows):
+        with open(path, "w", encoding="utf-8") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    w(wd / "fleet.timeline.jsonl",
+      [{"kind": "fleet", "name": "spawn:rank1", "phase": "point",
+        "t": base}])
+    w(wd / "rank0" / "timeline.jsonl",
+      [{"kind": "stage", "name": "program:trace", "phase": "start",
+        "t": base},
+       {"kind": "fleet", "name": "submit_host1", "phase": "point",
+        "t": base + 0.5, "trace_id": "tx"},
+       {"kind": "stage", "name": "program:trace", "phase": "end",
+        "t": base + 2.0}])
+    # The remote clock runs 5s AHEAD: its records carry wall t + skew.
+    w(wd / "rank1" / "timeline.jsonl",
+      [{"kind": "stage", "name": "program:trace", "phase": "start",
+        "t": base + skew},
+       {"kind": "fleet", "name": "rank1:execute", "phase": "point",
+        "t": base + 1.0 + skew, "trace_id": "tx"},
+       {"kind": "fleet", "name": "rank1:retry", "phase": "point",
+        "t": base + 1.5 + skew, "trace_id": "tx"}])
+    (wd / "rank0" / "result.json").write_text(json.dumps(
+        {"serve": {"dispatcher": {"per_host": {
+            "1": {"clock_skew_seconds": skew}}}}}), encoding="utf-8")
+
+    trace, path = traceview.merge_fleet(str(wd))
+    assert path == str(wd / "fleet.trace.json")
+    meta = trace["otherData"]
+    assert meta["ranks"] == [0, 1]
+    assert meta["clock_skew_seconds"] == {"1": 5.0}
+    assert meta["cross_process_flows"] == 1
+    ev = trace["traceEvents"]
+    hops = [e for e in ev
+            if e.get("cat") == "serve.flow" and e.get("id") == "tx"]
+    assert [h["args"]["hop"] for h in hops] == [
+        "rank0:submit_host1", "rank1:execute", "rank1:retry"]
+    assert [h["ph"] for h in hops] == ["s", "t", "f"]
+    assert len({h["pid"] for h in hops}) == 2
+    # Skew-corrected: execute lands 0.5s after submit on the SHARED
+    # clock, not 5.5s on the remote's fast clock.
+    assert hops[1]["ts"] - hops[0]["ts"] == 500000
+    names = {e["name"] for e in ev if e["ph"] != "M"}
+    assert "rank0:program:trace" in names
+    assert "rank1:program:trace" in names
+    assert not any(n.startswith("rank1:rank1:") for n in names)
+
+
 def test_acceptance_serve_run_flow_joins_enqueue_flush_retry(tmp_path,
                                                             rng):
     """ISSUE 10 acceptance: `cli trace-export` of a REAL serve run
